@@ -1,0 +1,25 @@
+"""Test harness config: force CPU with 8 virtual devices.
+
+This is the TPU analogue of the reference's loopback master/slave trick
+(SURVEY §4): distributed semantics are exercised on a virtual 8-device mesh
+without hardware.  Must run before jax is imported anywhere.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_prng():
+    from veles_tpu import prng
+    prng.reset()
+    prng.seed_all(1)
+    yield
+    prng.reset()

@@ -1,0 +1,73 @@
+"""Tier-1 config-tree tests (ref behavior: veles/config.py, SURVEY §4)."""
+
+import io
+
+from veles_tpu.config import Config, Tune, get, parse_override
+
+
+def test_autocreate_and_set():
+    cfg = Config("root")
+    cfg.loader.minibatch_size = 100
+    assert cfg.loader.minibatch_size == 100
+    assert get(cfg.loader.minibatch_size) == 100
+
+
+def test_get_default_for_unset_leaf():
+    cfg = Config("root")
+    assert get(cfg.never.set_before, 42) == 42
+    assert get(cfg.never.set_before) is None
+
+
+def test_update_recursive_merge():
+    cfg = Config("root")
+    cfg.a.x = 1
+    cfg.update({"a": {"y": 2}, "b": 3})
+    assert cfg.a.x == 1
+    assert cfg.a.y == 2
+    assert cfg.b == 3
+
+
+def test_dict_assignment_becomes_subtree():
+    cfg = Config("root")
+    cfg.layers = [{"type": "all2all", "n": 100}]
+    assert cfg.layers[0]["type"] == "all2all"
+    cfg.decision = {"max_epochs": 3}
+    assert cfg.decision.max_epochs == 3
+
+
+def test_tune_unwrap():
+    t = Tune(0.01, 0.001, 0.1)
+    assert get(t) == 0.01
+    assert t.minv == 0.001 and t.maxv == 0.1
+
+
+def test_parse_override_literal_and_string():
+    cfg = Config("root")
+    parse_override("root.loader.minibatch_size=64", cfg)
+    parse_override("root.name=hello", cfg)
+    parse_override("root.lr=0.05", cfg)
+    assert cfg.loader.minibatch_size == 64
+    assert cfg.name == "hello"
+    assert abs(cfg.lr - 0.05) < 1e-12
+
+
+def test_print(capsys=None):
+    cfg = Config("root")
+    cfg.a.b = 1
+    out = io.StringIO()
+    cfg.print_(file=out)
+    assert "a:" in out.getvalue() and "b: 1" in out.getvalue()
+
+
+def test_logger_does_not_touch_root_handlers():
+    import logging
+    sentinel = logging.NullHandler()
+    logging.root.addHandler(sentinel)
+    try:
+        from veles_tpu.units import TrivialUnit
+        from veles_tpu.workflow import Workflow
+        u = TrivialUnit(Workflow(None, name="wf"), name="u")
+        u.info("hello")
+        assert sentinel in logging.root.handlers
+    finally:
+        logging.root.removeHandler(sentinel)

@@ -1,0 +1,64 @@
+"""Tier-1 Vector coherence tests (ref behavior: veles/memory.py map/unmap)."""
+
+import numpy
+import pickle
+
+from veles_tpu.memory import Vector, roundup
+
+
+def test_roundup():
+    assert roundup(10, 8) == 16
+    assert roundup(16, 8) == 16
+    assert roundup(1, 128) == 128
+
+
+def test_host_roundtrip_and_shape():
+    v = Vector(numpy.arange(6, dtype=numpy.float32).reshape(2, 3))
+    assert v.shape == (2, 3)
+    assert v.size == 6
+    assert len(v) == 2
+    numpy.testing.assert_array_equal(v.mem, [[0, 1, 2], [3, 4, 5]])
+
+
+def test_device_upload_and_download():
+    v = Vector(numpy.ones((4, 4), dtype=numpy.float32))
+    dev = v.devmem
+    assert tuple(dev.shape) == (4, 4)
+    host = v.map_read()
+    numpy.testing.assert_array_equal(host, numpy.ones((4, 4)))
+
+
+def test_host_write_then_device_sees_it():
+    v = Vector(numpy.zeros(4, dtype=numpy.float32))
+    _ = v.devmem                       # uploaded
+    v.map_write()[0] = 7               # host write invalidates device copy
+    assert float(v.devmem[0]) == 7.0   # re-upload happens
+
+
+def test_assign_device_makes_device_canonical():
+    import jax.numpy as jnp
+    v = Vector(numpy.zeros(3, dtype=numpy.float32))
+    v.assign_device(jnp.asarray([1.0, 2.0, 3.0]))
+    numpy.testing.assert_allclose(v.mem, [1, 2, 3])
+
+
+def test_setitem_getitem():
+    v = Vector(shape=(3,), dtype=numpy.float32)
+    v[1] = 5
+    assert v[1] == 5.0
+
+
+def test_empty_and_reset():
+    v = Vector()
+    assert v.is_empty and not v
+    v.reset(numpy.zeros(2))
+    assert not v.is_empty and v
+
+
+def test_pickle_roundtrip_via_numpy():
+    import jax.numpy as jnp
+    v = Vector()
+    v.assign_device(jnp.arange(5, dtype=jnp.float32))
+    blob = pickle.dumps(v)
+    v2 = pickle.loads(blob)
+    numpy.testing.assert_allclose(v2.mem, numpy.arange(5))

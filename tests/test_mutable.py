@@ -1,0 +1,42 @@
+"""Tier-1 Bool gate expression tests (ref behavior: veles/mutable.py)."""
+
+import pytest
+
+from veles_tpu.mutable import Bool
+
+
+def test_plain_bool_assign():
+    b = Bool()
+    assert not b
+    b <<= True
+    assert b
+    b.unset()
+    assert not b
+
+
+def test_derived_and_or_invert_track_sources():
+    a, b = Bool(False), Bool(False)
+    both = a & b
+    either = a | b
+    nota = ~a
+    assert not both and not either and nota
+    a <<= True
+    assert not both and either and not nota
+    b <<= True
+    assert both and either
+
+
+def test_derived_is_not_assignable():
+    a = Bool(True)
+    expr = ~a
+    with pytest.raises(ValueError):
+        expr <<= True
+    with pytest.raises(ValueError):
+        expr.set(True)
+
+
+def test_compose_with_raw_python_bool():
+    a = Bool(True)
+    assert (a & True) and (a | False)
+    a <<= False
+    assert not (a & True)

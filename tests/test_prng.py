@@ -1,0 +1,59 @@
+"""Tier-1 PRNG stream tests: determinism, independence, snapshot."""
+
+import numpy
+
+from veles_tpu import prng
+
+
+def test_same_seed_same_stream():
+    a = prng.RandomGenerator("x", seed=7)
+    b = prng.RandomGenerator("x", seed=7)
+    numpy.testing.assert_array_equal(a.permutation(10), b.permutation(10))
+
+
+def test_named_streams_are_decorrelated():
+    a = prng.RandomGenerator("alpha", seed=7)
+    b = prng.RandomGenerator("beta", seed=7)
+    assert not numpy.array_equal(a.permutation(100), b.permutation(100))
+
+
+def test_registry_get_and_seed_all():
+    s1 = prng.get("loader")
+    s2 = prng.get("loader")
+    assert s1 is s2
+    prng.seed_all(99)
+    v1 = prng.get("loader").randint(0, 1 << 30)
+    prng.seed_all(99)
+    v2 = prng.get("loader").randint(0, 1 << 30)
+    assert v1 == v2
+
+
+def test_fill_inplace():
+    arr = numpy.zeros((5, 5), dtype=numpy.float32)
+    prng.get("init").fill(arr, -0.1, 0.1)
+    assert arr.min() >= -0.1 and arr.max() <= 0.1
+    assert arr.std() > 0
+
+
+def test_device_keys_unique_and_deterministic():
+    a = prng.RandomGenerator("d", seed=3)
+    k1, k2 = a.key(), a.key()
+    assert not numpy.array_equal(numpy.asarray(k1), numpy.asarray(k2))
+    b = prng.RandomGenerator("d", seed=3)
+    numpy.testing.assert_array_equal(numpy.asarray(b.key()),
+                                     numpy.asarray(k1))
+
+
+def test_state_dict_roundtrip():
+    s = prng.get("snap")
+    s.permutation(5)
+    saved = prng.state_dict()
+    before = s.permutation(100)
+    prng.load_state_dict(saved)
+    after = prng.get("snap").permutation(100)
+    numpy.testing.assert_array_equal(before, after)
+
+
+def test_get_after_seed_all_honors_default_seed():
+    prng.seed_all(42)
+    assert prng.get("fresh_stream").initial_seed == 42

@@ -1,0 +1,156 @@
+"""Tier-1 graph mechanics: linking, gating, attribute aliasing.
+
+Mirrors the reference's veles/tests/test_units.py coverage (SURVEY §4).
+"""
+
+import pytest
+
+from veles_tpu.units import Unit, TrivialUnit
+from veles_tpu.workflow import Workflow, Repeater
+
+
+class Recorder(Unit):
+    def __init__(self, workflow, log, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.log = log
+
+    def run(self):
+        self.log.append(self.name)
+
+
+def test_link_from_and_open_gate_and_semantics():
+    wf = Workflow(None, name="wf")
+    a = TrivialUnit(wf, name="a")
+    b = TrivialUnit(wf, name="b")
+    c = TrivialUnit(wf, name="c")
+    c.link_from(a, b)
+    assert not c.open_gate(a)      # only one of two fired
+    assert c.open_gate(b)          # both fired -> opens
+    assert not c.open_gate(a)      # marks were reset by the open
+
+
+def test_repeater_or_semantics():
+    wf = Workflow(None, name="wf")
+    a = TrivialUnit(wf, name="a")
+    b = TrivialUnit(wf, name="b")
+    r = Repeater(wf, name="rep")
+    r.link_from(a, b)
+    assert r.open_gate(a)
+    assert r.open_gate(b)
+
+
+def test_self_link_rejected():
+    wf = Workflow(None, name="wf")
+    a = TrivialUnit(wf, name="a")
+    with pytest.raises(ValueError):
+        a.link_from(a)
+
+
+def test_unlink():
+    wf = Workflow(None, name="wf")
+    a = TrivialUnit(wf, name="a")
+    b = TrivialUnit(wf, name="b")
+    b.link_from(a)
+    assert b in a.links_to
+    b.unlink_from(a)
+    assert b not in a.links_to and a not in b.links_from
+
+
+def test_link_attrs_read_and_two_way_write():
+    wf = Workflow(None, name="wf")
+    src = TrivialUnit(wf, name="src")
+    dst = TrivialUnit(wf, name="dst")
+    src.output = 123
+    dst.link_attrs(src, ("input", "output"))
+    assert dst.input == 123
+    src.output = 456
+    assert dst.input == 456
+    dst.input = 789              # two-way: writes through to src
+    assert src.output == 789
+
+
+def test_link_attrs_same_name_and_shadow_removed():
+    wf = Workflow(None, name="wf")
+    src = TrivialUnit(wf, name="src")
+    dst = TrivialUnit(wf, name="dst")
+    src.value = 1
+    dst.value = 99               # local value must be dropped by the link
+    dst.link_attrs(src, "value")
+    assert dst.value == 1
+
+
+def test_missing_attr_raises():
+    wf = Workflow(None, name="wf")
+    u = TrivialUnit(wf, name="u")
+    with pytest.raises(AttributeError):
+        u.no_such_attribute
+
+
+def test_gate_block_stops_propagation():
+    wf = Workflow(None, name="wf")
+    log = []
+    a = Recorder(wf, log, name="a")
+    b = Recorder(wf, log, name="b")
+    c = Recorder(wf, log, name="c")
+    a.link_from(wf.start_point)
+    b.link_from(a)
+    c.link_from(b)
+    b.gate_block <<= True
+    wf.end_point.link_from(c)
+    wf.run()
+    assert log == ["a"]          # b blocked, c never reached
+
+
+def test_gate_skip_propagates_without_running():
+    wf = Workflow(None, name="wf")
+    log = []
+    a = Recorder(wf, log, name="a")
+    b = Recorder(wf, log, name="b")
+    c = Recorder(wf, log, name="c")
+    a.link_from(wf.start_point)
+    b.link_from(a)
+    c.link_from(b)
+    b.gate_skip <<= True
+    wf.end_point.link_from(c)
+    wf.run()
+    assert log == ["a", "c"]
+
+
+def test_gate_expression_flips_mid_run():
+    wf = Workflow(None, name="wf")
+    log = []
+
+    class Flipper(Recorder):
+        def run(self):
+            super().run()
+            gate.set(True)
+
+    from veles_tpu.mutable import Bool
+    gate = Bool(False)
+    a = Flipper(wf, log, name="a")
+    b = Recorder(wf, log, name="b")
+    a.link_from(wf.start_point)
+    b.link_from(a)
+    b.gate_skip = ~(~gate)       # derived expression evaluated at fire time
+    wf.end_point.link_from(b)
+    wf.run()
+    assert log == ["a"]          # flipped during a.run -> b skipped
+
+
+def test_link_attrs_overrides_class_level_default():
+    wf = Workflow(None, name="wf")
+
+    class WithDefault(Unit):
+        value = "CLASS_DEFAULT"
+
+    src = TrivialUnit(wf, name="src")
+    src.value = 42
+    dst = WithDefault(wf, name="dst")
+    dst.link_attrs(src, "value")
+    assert dst.value == 42            # alias beats the class attribute
+
+
+def test_registry_qualified_names():
+    from veles_tpu.units import UnitRegistry
+    key = "%s.%s" % (TrivialUnit.__module__, "TrivialUnit")
+    assert UnitRegistry.units[key] is TrivialUnit

@@ -1,0 +1,146 @@
+"""Tier-1 workflow scheduler tests: ordering, cycles, stop, stats, graph."""
+
+from veles_tpu.units import Unit, TrivialUnit
+from veles_tpu.workflow import Workflow, Repeater
+from veles_tpu.mutable import Bool
+
+
+class Recorder(Unit):
+    def __init__(self, workflow, log, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.log = log
+
+    def run(self):
+        self.log.append(self.name)
+
+
+def test_linear_chain_order():
+    wf = Workflow(None, name="wf")
+    log = []
+    units = [Recorder(wf, log, name="u%d" % i) for i in range(4)]
+    units[0].link_from(wf.start_point)
+    for prev, nxt in zip(units, units[1:]):
+        nxt.link_from(prev)
+    wf.end_point.link_from(units[-1])
+    wf.run()
+    assert log == ["u0", "u1", "u2", "u3"]
+    assert wf.is_finished
+
+
+def test_diamond_join_runs_once():
+    wf = Workflow(None, name="wf")
+    log = []
+    a = Recorder(wf, log, name="a")
+    b = Recorder(wf, log, name="b")
+    c = Recorder(wf, log, name="c")
+    d = Recorder(wf, log, name="d")
+    a.link_from(wf.start_point)
+    b.link_from(a)
+    c.link_from(a)
+    d.link_from(b, c)            # AND join
+    wf.end_point.link_from(d)
+    wf.run()
+    assert log.count("d") == 1
+    assert set(log) == {"a", "b", "c", "d"}
+
+
+def test_repeater_cycle_terminates_via_gate():
+    """The canonical training-loop shape: repeater -> body -> repeater,
+    end point gated on a completion Bool (SURVEY §1: the training loop is a
+    cycle in the graph)."""
+    wf = Workflow(None, name="wf")
+    log = []
+    complete = Bool(False)
+
+    class Body(Recorder):
+        def run(self):
+            super().run()
+            if len(self.log) >= 5:
+                complete.set(True)
+
+    rep = Repeater(wf, name="rep")
+    body = Body(wf, log, name="body")
+    rep.link_from(wf.start_point)
+    body.link_from(rep)
+    rep.link_from(body)          # closes the cycle
+    wf.end_point.link_from(body)
+    wf.end_point.gate_block = ~complete
+    body.gate_block = complete
+    wf.run()
+    assert log == ["body"] * 5
+    assert wf.is_finished
+
+
+def test_stop_mid_run():
+    wf = Workflow(None, name="wf")
+    log = []
+
+    class Stopper(Recorder):
+        def run(self):
+            super().run()
+            self.workflow.stop()
+
+    rep = Repeater(wf, name="rep")
+    s = Stopper(wf, log, name="s")
+    rep.link_from(wf.start_point)
+    s.link_from(rep)
+    rep.link_from(s)
+    wf.run()
+    assert log == ["s"]
+    assert not wf.is_finished
+
+
+def test_initialize_deferred_ordering():
+    from veles_tpu.workflow import DeferredInitError
+
+    wf = Workflow(None, name="wf")
+    order = []
+
+    class Producer(TrivialUnit):
+        def initialize(self, **kwargs):
+            self.ready = True
+            order.append("producer")
+            super().initialize(**kwargs)
+
+    class Consumer(TrivialUnit):
+        def initialize(self, **kwargs):
+            if not getattr(producer, "ready", False):
+                raise DeferredInitError()
+            order.append("consumer")
+            super().initialize(**kwargs)
+
+    # Construction order is consumer-first to force the deferral path.
+    consumer = Consumer(wf, name="consumer")
+    producer = Producer(wf, name="producer")
+    wf.initialize()
+    assert order == ["producer", "consumer"]
+
+
+def test_run_stats_accounting():
+    wf = Workflow(None, name="wf")
+    log = []
+    a = Recorder(wf, log, name="a")
+    a.link_from(wf.start_point)
+    wf.end_point.link_from(a)
+    wf.run()
+    assert a.run_count == 1
+    assert a.run_time >= 0.0
+    wf.print_stats()
+
+
+def test_generate_graph_dot():
+    wf = Workflow(None, name="wf")
+    a = TrivialUnit(wf, name="a")
+    a.link_from(wf.start_point)
+    wf.end_point.link_from(a)
+    dot = wf.generate_graph()
+    assert "digraph" in dot and "->" in dot and '"a"' in dot
+
+
+def test_duplicate_unit_names_get_suffixed():
+    wf = Workflow(None, name="wf")
+    a = TrivialUnit(wf)
+    b = TrivialUnit(wf)
+    c = TrivialUnit(wf)
+    names = {a.name, b.name, c.name}
+    assert len(names) == 3            # snapshot state keys stay unique

@@ -1,0 +1,26 @@
+"""veles_tpu — a TPU-native dataflow-graph ML framework.
+
+A ground-up rebuild of the capabilities of the reference platform
+(PathosHeeman/veles, a fork of Samsung VELES: see SURVEY.md): a model plus its
+data pipeline, training loop, evaluation, plotting and snapshotting is ONE
+graph of ``Unit`` nodes (a ``Workflow``) — but the execution substrate is
+idiomatic JAX/XLA:
+
+- device state lives in HBM as ``jax.Array`` (``veles_tpu.memory.Vector``),
+- every numeric op is a pure function jitted by XLA (no OpenCL/CUDA kernel
+  trio — the numpy oracle and the TPU path are the same function),
+- the hot training cycle is traced once into a fused ``train_step`` /
+  ``eval_step`` while the host scheduler runs the outer graph (Decision
+  gating, snapshotting, plotting) exactly like the reference's event loop,
+- distribution is SPMD over a ``jax.sharding.Mesh`` with XLA collectives over
+  ICI instead of master–slave ZeroMQ averaging (ref: veles/server.py,
+  veles/client.py [H] per SURVEY §2.5).
+"""
+
+__version__ = "0.1.0"
+
+from veles_tpu.config import Config, root, get, Tune  # noqa: F401
+from veles_tpu.mutable import Bool, LinkableAttribute  # noqa: F401
+from veles_tpu.units import Unit, TrivialUnit, UnitRegistry  # noqa: F401
+from veles_tpu.workflow import Workflow, StartPoint, EndPoint, Repeater  # noqa: F401
+from veles_tpu.memory import Vector, roundup  # noqa: F401

@@ -1,0 +1,106 @@
+"""Logging mixin used by every framework object.
+
+Ref: veles/logger.py::Logger [H] (SURVEY §2.1): per-class log channels with
+``self.info/debug/warning/error`` convenience methods and a colored console
+formatter.  The optional MongoDB event sink of the reference is replaced by an
+optional JSON-lines file sink (no mongo in this stack).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+import time
+
+_COLORS = {
+    logging.DEBUG: "\033[37m",
+    logging.INFO: "\033[32m",
+    logging.WARNING: "\033[33m",
+    logging.ERROR: "\033[31m",
+    logging.CRITICAL: "\033[1;31m",
+}
+_RESET = "\033[0m"
+
+
+class ColoredFormatter(logging.Formatter):
+    def format(self, record):
+        message = super().format(record)
+        if sys.stderr.isatty():
+            color = _COLORS.get(record.levelno, "")
+            return "%s%s%s" % (color, message, _RESET)
+        return message
+
+
+class JsonLinesHandler(logging.Handler):
+    """Append-only structured event sink (stands in for the mongo sink)."""
+
+    def __init__(self, path):
+        super().__init__()
+        self._file = open(path, "a", encoding="utf-8")
+
+    def emit(self, record):
+        try:
+            self._file.write(json.dumps({
+                "t": time.time(),
+                "level": record.levelname,
+                "logger": record.name,
+                "msg": record.getMessage(),
+            }) + "\n")
+            self._file.flush()
+        except Exception:  # pragma: no cover - never raise from logging
+            self.handleError(record)
+
+
+#: all framework loggers live under this namespace so configuring them never
+#: disturbs the host application's root logging setup
+NAMESPACE = "veles"
+
+_configured = False
+
+
+def setup_logging(level=logging.INFO, events_file=None):
+    """Configure the framework's logger namespace (NOT the root logger)."""
+    global _configured
+    base = logging.getLogger(NAMESPACE)
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(ColoredFormatter(
+        "%(asctime)s %(levelname).1s %(name)s: %(message)s", "%H:%M:%S"))
+    base.handlers = [handler]
+    if events_file:
+        base.addHandler(JsonLinesHandler(events_file))
+    base.setLevel(level)
+    base.propagate = False
+    _configured = True
+
+
+class Logger:
+    """Mixin granting named logging channels to any class."""
+
+    @property
+    def logger(self):
+        logger = getattr(self, "_logger_", None)
+        if logger is None:
+            if not _configured:
+                setup_logging()
+            name = getattr(self, "name", None) or type(self).__name__
+            channel = ("%s.%s" % (type(self).__name__, name)
+                       if name != type(self).__name__ else name)
+            logger = logging.getLogger("%s.%s" % (NAMESPACE, channel))
+            self._logger_ = logger
+        return logger
+
+    def debug(self, msg, *args):
+        self.logger.debug(msg, *args)
+
+    def info(self, msg, *args):
+        self.logger.info(msg, *args)
+
+    def warning(self, msg, *args):
+        self.logger.warning(msg, *args)
+
+    def error(self, msg, *args):
+        self.logger.error(msg, *args)
+
+    def exception(self, msg, *args):
+        self.logger.exception(msg, *args)

@@ -1,0 +1,164 @@
+"""Vector — the host/device tensor pair.
+
+Ref: veles/memory.py::Vector/roundup [H] (SURVEY §2.1): the reference keeps a
+numpy array plus a lazily-synced OpenCL/CUDA buffer and requires units to call
+``map_read``/``map_write``/``unmap`` around host access.
+
+TPU-native redesign: the canonical storage is a ``jax.Array`` in HBM.  The
+map/unmap discipline survives as a tiny coherence state machine — host reads
+trigger a device→host transfer once, host writes mark the numpy side
+canonical, and ``unmap``/``devmem`` pushes back to HBM.  Inside jitted code
+Vectors never appear (pure arrays flow); Vectors are the boundary objects the
+graph scheduler hands around, so the number of transfers is exactly the number
+of deliberate host touches (SURVEY §7 design stance).
+"""
+
+from __future__ import annotations
+
+import numpy
+
+_HOST, _DEVICE, _BOTH = "host", "device", "both"
+
+
+def roundup(value, multiple):
+    """Round ``value`` up to a multiple (ref: veles/memory.py::roundup [H])."""
+    remainder = value % multiple
+    return value if remainder == 0 else value + multiple - remainder
+
+
+class Vector:
+    """A named tensor living in HBM with lazy host mirroring."""
+
+    def __init__(self, data=None, shape=None, dtype=numpy.float32):
+        self._host = None
+        self._dev = None
+        self._state = _HOST
+        if data is not None:
+            self.reset(data)
+        elif shape is not None:
+            self.reset(numpy.zeros(shape, dtype=dtype))
+
+    # ------------------------------------------------------------------ state
+    def reset(self, data=None):
+        """Replace contents with a host array (or clear)."""
+        import jax
+        if data is None:
+            self._host = None
+            self._dev = None
+            self._state = _HOST
+            return self
+        if isinstance(data, Vector):
+            data = data.to_numpy()
+        if isinstance(data, jax.Array):
+            self._dev = data
+            self._host = None
+            self._state = _DEVICE
+            return self
+        self._host = numpy.ascontiguousarray(data)
+        self._dev = None
+        self._state = _HOST
+        return self
+
+    @property
+    def is_empty(self):
+        return self._host is None and self._dev is None
+
+    def __bool__(self):
+        return not self.is_empty
+
+    # ------------------------------------------------------- host-side access
+    @property
+    def mem(self):
+        """Host view for reading (implicit ``map_read``)."""
+        return self.map_read()
+
+    @mem.setter
+    def mem(self, value):
+        self.reset(value)
+
+    def map_read(self):
+        if self._state == _DEVICE:
+            self._host = numpy.asarray(self._dev)
+            self._state = _BOTH
+        return self._host
+
+    def map_write(self):
+        """Host view for writing; device copy becomes stale."""
+        self.map_read()
+        self._state = _HOST
+        return self._host
+
+    def unmap(self):
+        """Push host writes to the device (no-op if already coherent)."""
+        if self._state == _HOST and self._host is not None:
+            import jax.numpy as jnp
+            self._dev = jnp.asarray(self._host)
+            self._state = _BOTH
+        return self
+
+    # ----------------------------------------------------- device-side access
+    @property
+    def devmem(self):
+        """The canonical ``jax.Array`` (uploads host writes first)."""
+        self.unmap()
+        return self._dev
+
+    def assign_device(self, arr):
+        """Adopt a device array as the new canonical value (host goes stale).
+
+        This is how compiled steps hand results back without a transfer.
+        """
+        self._dev = arr
+        self._state = _DEVICE
+        return self
+
+    # ------------------------------------------------------------------ info
+    @property
+    def shape(self):
+        if self._state == _DEVICE:
+            return tuple(self._dev.shape)
+        return tuple(self._host.shape) if self._host is not None else ()
+
+    @property
+    def dtype(self):
+        if self._state == _DEVICE:
+            return self._dev.dtype
+        return self._host.dtype if self._host is not None else None
+
+    @property
+    def size(self):
+        shape = self.shape
+        n = 1
+        for dim in shape:
+            n *= dim
+        return n if shape else 0
+
+    def __len__(self):
+        shape = self.shape
+        return shape[0] if shape else 0
+
+    def to_numpy(self):
+        mem = self.map_read()
+        return None if mem is None else numpy.array(mem)
+
+    def __getitem__(self, idx):
+        return self.mem[idx]
+
+    def __setitem__(self, idx, value):
+        self.map_write()[idx] = value
+
+    def __repr__(self):
+        if self.is_empty:
+            return "<Vector empty>"
+        return "<Vector %s %s [%s]>" % (self.shape, self.dtype, self._state)
+
+    # ----------------------------------------------------------------- pickle
+    def __getstate__(self):
+        return {"data": self.to_numpy()}
+
+    def __setstate__(self, state):
+        self._host = None
+        self._dev = None
+        self._state = _HOST
+        if state["data"] is not None:
+            self.reset(state["data"])

@@ -1,0 +1,133 @@
+"""Named deterministic random streams.
+
+Ref: veles/prng/::RandomGenerator/get [H] (SURVEY §2.1): every consumer of
+randomness (weight init, index shuffling, dropout, augmentation) pulls from a
+named stream seeded from the CLI ``--random-seed``, so runs are exactly
+reproducible and the convergence tests can pin expected metrics.
+
+TPU twist: each stream carries BOTH a host-side numpy generator (for loader
+shuffles and eager init, like the reference's MT streams) and a counter-based
+``jax.random`` key derivation (for randomness inside jitted code — dropout,
+stochastic pooling — where the reference used in-kernel device RNG).
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy
+
+
+class RandomGenerator:
+    """One named deterministic stream of host and device randomness."""
+
+    def __init__(self, name, seed=None):
+        self.name = name
+        self._seed = None
+        self._key_counter = 0
+        self.seed(seed if seed is not None else 1)
+
+    @property
+    def initial_seed(self):
+        return self._seed
+
+    def seed(self, seed):
+        """(Re)seed both host state and the device key derivation."""
+        self._seed = int(seed)
+        # Stream independence: fold the stream name into the seed so streams
+        # with the same CLI seed are decorrelated.
+        digest = hashlib.sha256(
+            ("%s:%d" % (self.name, self._seed)).encode()).digest()
+        derived = int.from_bytes(digest[:8], "little")
+        self.state = numpy.random.RandomState(derived % (2 ** 32))
+        self._derived_seed = derived
+        self._key_counter = 0
+
+    # -- host-side (numpy) ---------------------------------------------------
+    def shuffle(self, arr):
+        self.state.shuffle(arr)
+
+    def permutation(self, n):
+        return self.state.permutation(n)
+
+    def randint(self, low, high=None, size=None):
+        return self.state.randint(low, high, size)
+
+    def normal(self, loc=0.0, scale=1.0, size=None):
+        return self.state.normal(loc, scale, size)
+
+    def uniform(self, low=0.0, high=1.0, size=None):
+        return self.state.uniform(low, high, size)
+
+    def fill(self, arr, vle_min=-1.0, vle_max=1.0):
+        """In-place uniform fill of a numpy array (reference init idiom)."""
+        arr[...] = self.state.uniform(vle_min, vle_max,
+                                      arr.shape).astype(arr.dtype)
+        return arr
+
+    def fill_normal(self, arr, mean=0.0, stddev=1.0):
+        arr[...] = self.state.normal(mean, stddev, arr.shape).astype(arr.dtype)
+        return arr
+
+    # -- device-side (jax) ---------------------------------------------------
+    def key(self):
+        """Fresh ``jax.random`` key; successive calls never repeat."""
+        import jax  # deferred so host-only code paths never touch jax
+
+        self._key_counter += 1
+        return jax.random.fold_in(
+            jax.random.PRNGKey(self._derived_seed % (2 ** 63)),
+            self._key_counter)
+
+    # -- snapshot support ----------------------------------------------------
+    def state_dict(self):
+        return {"seed": self._seed, "numpy_state": self.state.get_state(),
+                "key_counter": self._key_counter}
+
+    def load_state_dict(self, d):
+        self.seed(d["seed"])
+        self.state.set_state(d["numpy_state"])
+        self._key_counter = d["key_counter"]
+
+
+_streams = {}
+
+
+_default_seed = 1
+
+
+def get(name="default"):
+    """Fetch (creating on first use) the named stream."""
+    stream = _streams.get(name)
+    if stream is None:
+        stream = RandomGenerator(name, _default_seed)
+        _streams[name] = stream
+    return stream
+
+
+def seed_all(seed):
+    """Seed every existing stream and set the default seed for new ones."""
+    global _default_seed
+    _default_seed = seed
+    for stream in _streams.values():
+        stream.seed(seed)
+
+
+def new_stream(name, seed=None):
+    stream = RandomGenerator(name, seed if seed is not None else _default_seed)
+    _streams[name] = stream
+    return stream
+
+
+def reset():
+    """Drop all streams (test isolation)."""
+    _streams.clear()
+
+
+def state_dict():
+    return {name: s.state_dict() for name, s in _streams.items()}
+
+
+def load_state_dict(d):
+    for name, sd in d.items():
+        get(name).load_state_dict(sd)
