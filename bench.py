@@ -1,0 +1,153 @@
+"""Benchmark: MNIST-FC training throughput (BASELINE.json config[0]).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Protocol (BASELINE.md): steady-state samples/sec/chip after a warm-up epoch
+(jit compile excluded), averaged over >=3 epochs.  ``vs_baseline`` is the
+speedup over the reference's numpy backend FLOOR measured in-process (the
+reference itself is unrecoverable — SURVEY §0/§6 — so its numpy backend is
+reproduced here faithfully: per-minibatch python loop, numpy GEMMs, same
+topology/update rule, which is exactly what `veles ... --backend numpy` ran).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy
+
+
+def build_workflow(n_train, n_valid, mb):
+    from veles_tpu import prng
+    from veles_tpu.config import root
+    prng.reset()
+    prng.seed_all(1)
+    root.mnist.update({
+        "loader": {"minibatch_size": mb, "n_train": n_train,
+                   "n_valid": n_valid},
+        "decision": {"max_epochs": 1000, "fail_iterations": 1000},
+        "layers": [
+            {"type": "all2all_tanh", "output_sample_shape": 100,
+             "learning_rate": 0.03, "momentum": 0.9},
+            {"type": "softmax", "output_sample_shape": 10,
+             "learning_rate": 0.03, "momentum": 0.9},
+        ],
+    })
+    from veles_tpu.samples import mnist
+    wf = mnist.build(fused=True)
+    wf.initialize()
+    return wf
+
+
+def epoch_plan_arrays(loader):
+    """Train-portion (idx, mask) matrices for the epoch-scan fast path."""
+    from veles_tpu.loader.base import TRAIN
+    loader._plan_epoch()
+    idx, mask = [], []
+    for cls, chunk, actual in loader._order:
+        if cls != TRAIN:
+            continue
+        idx.append(chunk)
+        m = numpy.zeros(len(chunk), numpy.float32)
+        m[:actual] = 1.0
+        mask.append(m)
+    return numpy.stack(idx), numpy.stack(mask)
+
+
+def bench_tpu(wf, epochs=3):
+    import jax
+    runner = wf._fused_runner
+    train_epoch, _ = runner.epoch_fns()
+    loader = wf.loader
+    data = loader.original_data.devmem
+    labels = loader.original_labels.devmem
+    idx, mask = epoch_plan_arrays(loader)
+    n_samples = int(mask.sum())
+    # warm-up epoch (compile)
+    state, totals = train_epoch(runner.state, data, labels, idx, mask)
+    jax.block_until_ready(totals)
+    begin = time.perf_counter()
+    for _ in range(epochs):
+        state, totals = train_epoch(state, data, labels, idx, mask)
+    jax.block_until_ready(totals)
+    elapsed = time.perf_counter() - begin
+    runner.state = state
+    return epochs * n_samples / elapsed
+
+
+def bench_numpy_floor(wf, min_seconds=3.0):
+    """The reference's numpy backend, reproduced: python minibatch loop with
+    numpy GEMMs, same 784->100(tanh)->10(softmax) + momentum SGD."""
+    loader = wf.loader
+    data = numpy.asarray(loader.original_data.mem)
+    labels = numpy.asarray(loader.original_labels.mem)
+    idx, mask = epoch_plan_arrays(loader)
+    rng = numpy.random.RandomState(1)
+    w1 = rng.uniform(-0.1, 0.1, (784, 100)).astype(numpy.float32)
+    b1 = numpy.zeros(100, numpy.float32)
+    w2 = rng.uniform(-0.1, 0.1, (100, 10)).astype(numpy.float32)
+    b2 = numpy.zeros(10, numpy.float32)
+    vw1 = numpy.zeros_like(w1); vb1 = numpy.zeros_like(b1)
+    vw2 = numpy.zeros_like(w2); vb2 = numpy.zeros_like(b2)
+    lr, mom = 0.03, 0.9
+    a, bconst = 1.7159, 0.6666
+
+    done_samples = 0
+    begin = time.perf_counter()
+    while time.perf_counter() - begin < min_seconds:
+        for mb_idx, mb_mask in zip(idx, mask):
+            x = data[mb_idx]
+            lab = labels[mb_idx]
+            n = int(mb_mask.sum())
+            y1 = a * numpy.tanh(bconst * (x @ w1 + b1))
+            z2 = y1 @ w2 + b2
+            e = numpy.exp(z2 - z2.max(axis=1, keepdims=True))
+            probs = e / e.sum(axis=1, keepdims=True)
+            onehot = numpy.eye(10, dtype=numpy.float32)[lab]
+            err2 = (probs - onehot) * mb_mask[:, None]
+            gw2 = y1.T @ err2 / n
+            gb2 = err2.sum(0) / n
+            err1 = (err2 @ w2.T) * (bconst * (a - y1 * y1 / a))
+            gw1 = x.T @ err1 / n
+            gb1 = err1.sum(0) / n
+            vw2 = mom * vw2 - lr * gw2; w2 += vw2
+            vb2 = mom * vb2 - lr * gb2; b2 += vb2
+            vw1 = mom * vw1 - lr * gw1; w1 += vw1
+            vb1 = mom * vb1 - lr * gb1; b1 += vb1
+            done_samples += n
+    return done_samples / (time.perf_counter() - begin)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--smoke", action="store_true",
+                        help="tiny sizes on CPU for CI validation")
+    parser.add_argument("--epochs", type=int, default=3)
+    args = parser.parse_args()
+
+    if args.smoke:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        n_train, n_valid, mb = 2000, 500, 100
+        floor_seconds = 0.5
+    else:
+        n_train, n_valid, mb = 60000, 10000, 100
+        floor_seconds = 3.0
+
+    wf = build_workflow(n_train, n_valid, mb)
+    tpu_sps = bench_tpu(wf, epochs=args.epochs)
+    floor_sps = bench_numpy_floor(wf, min_seconds=floor_seconds)
+    print(json.dumps({
+        "metric": "mnist_fc_train_samples_per_sec_per_chip",
+        "value": round(tpu_sps, 1),
+        "unit": "samples/sec",
+        "vs_baseline": round(tpu_sps / floor_sps, 2),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -2,16 +2,25 @@
 
 This is the TPU analogue of the reference's loopback master/slave trick
 (SURVEY §4): distributed semantics are exercised on a virtual 8-device mesh
-without hardware.  Must run before jax is imported anywhere.
+without hardware.
+
+Environment note: this image's sitecustomize registers the 'axon' TPU-tunnel
+PJRT plugin in every process and forces JAX_PLATFORMS=axon, which OVERRIDES
+the env var — only a jax.config update reliably selects CPU.  Keeping tests
+off the tunnel matters doubly here: the tunnel admits one client at a time
+and first-compiles are 20-40s.
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,116 @@
+"""Tier-2 loader tests: epoch plan, masking, shuffling, sharding."""
+
+import numpy
+
+from veles_tpu.loader.base import Loader, TEST, VALID, TRAIN
+from veles_tpu.loader.fullbatch import FullBatchLoader
+from veles_tpu.workflow import Workflow
+
+
+class ArrayLoader(FullBatchLoader):
+    """Test loader over a deterministic arange dataset."""
+
+    def __init__(self, workflow, lengths=(6, 10, 25), **kwargs):
+        super().__init__(workflow, **kwargs)
+        self._lengths = list(lengths)
+
+    def load_data(self):
+        total = sum(self._lengths)
+        data = numpy.arange(total, dtype=numpy.float32)[:, None] * [1.0, 2.0]
+        self.original_data.reset(data)
+        self.original_labels.reset(
+            numpy.arange(total, dtype=numpy.int32) % 3)
+        self.class_lengths = list(self._lengths)
+
+
+def _make(lengths=(6, 10, 25), mb=8, shuffle=True):
+    wf = Workflow(None, name="wf")
+    loader = ArrayLoader(wf, lengths=lengths, minibatch_size=mb,
+                         shuffle=shuffle)
+    loader.initialize()
+    return loader
+
+
+def test_epoch_order_and_class_boundaries():
+    loader = _make()
+    seen = []
+    for _ in range(7):  # ceil(6/8)+ceil(10/8)+ceil(25/8) = 1+2+4
+        loader.run()
+        seen.append((loader.minibatch_class, loader.minibatch_size))
+    assert [c for c, _ in seen] == [TEST, VALID, VALID, TRAIN, TRAIN, TRAIN,
+                                    TRAIN]
+    # short minibatches at each class tail, masked not shrunk
+    assert seen[0] == (TEST, 6)
+    assert seen[2] == (VALID, 2)
+    assert seen[6] == (TRAIN, 1)
+    assert loader.last_minibatch and loader.epoch_ended
+    assert loader.epoch_number == 1
+
+
+def test_mask_and_padding():
+    loader = _make()
+    loader.run()  # TEST minibatch: 6 live rows padded to 8
+    mask = loader.minibatch_mask.mem
+    assert mask.sum() == 6 and (mask[:6] == 1).all() and (mask[6:] == 0).all()
+    assert loader.minibatch_data.shape[0] == 8  # static shape
+
+
+def test_minibatch_content_matches_indices():
+    loader = _make(shuffle=False)
+    loader.run()
+    idx = loader.minibatch_indices.mem
+    data = loader.minibatch_data.mem
+    numpy.testing.assert_allclose(data[:, 0], idx.astype(numpy.float32))
+    labels = loader.minibatch_labels.mem
+    numpy.testing.assert_array_equal(labels, idx % 3)
+
+
+def test_train_shuffles_each_epoch_but_not_eval_sets():
+    loader = _make(mb=25)
+    orders = []
+    for _ in range(2):  # two epochs
+        epoch_idx = []
+        while True:
+            loader.run()
+            if loader.minibatch_class == TRAIN:
+                epoch_idx.append(numpy.array(loader.minibatch_indices.mem))
+            if loader.last_minibatch:
+                break
+        orders.append(numpy.concatenate(epoch_idx))
+    assert not numpy.array_equal(orders[0], orders[1])   # reshuffled
+    assert set(orders[0]) == set(orders[1])              # same samples
+    # eval sets: deterministic ascending
+    loader2 = _make(mb=25)
+    loader2.run()
+    numpy.testing.assert_array_equal(
+        numpy.sort(loader2.minibatch_indices.mem[:6]), numpy.arange(6))
+
+
+def test_determinism_with_seed():
+    from veles_tpu import prng
+    prng.reset(); prng.seed_all(5)
+    a = _make()
+    a.run(); a.run(); a.run(); a.run()
+    first = numpy.array(a.minibatch_indices.mem)
+    prng.reset(); prng.seed_all(5)
+    b = _make()
+    b.run(); b.run(); b.run(); b.run()
+    numpy.testing.assert_array_equal(first, b.minibatch_indices.mem)
+
+
+def test_sharding_partitions_every_set():
+    full = set(range(41))
+    covered = set()
+    counts = []
+    for pi in range(4):
+        loader = _make()
+        loader.shard(pi, 4)
+        loader._plan_epoch()
+        mine = set()
+        for cls, idx, actual in loader._order:
+            mine.update(idx[:actual].tolist())
+        counts.append(len(mine))
+        assert covered.isdisjoint(mine)
+        covered |= mine
+    assert covered == full
+    assert max(counts) - min(counts) <= 3  # balanced within one per set

@@ -1,0 +1,112 @@
+"""Tier-3 functional tests: seeded MNIST-FC convergence (SURVEY §4 tier 3).
+
+Mirrors the reference's znicz functional tests: pinned seed, small epoch
+budget, assert bounded validation error, plus fused/unit-mode equivalence
+(our analogue of their numpy-vs-device backend cross-check).
+"""
+
+import numpy
+import pytest
+
+from veles_tpu import prng
+from veles_tpu.config import root
+
+
+def _configure(n_train=1000, n_valid=300, max_epochs=3, mb=100):
+    root.mnist.update({
+        "loader": {"minibatch_size": mb, "n_train": n_train,
+                   "n_valid": n_valid},
+        "decision": {"max_epochs": max_epochs, "fail_iterations": 50},
+        "layers": [
+            {"type": "all2all_tanh", "output_sample_shape": 64,
+             "learning_rate": 0.03, "momentum": 0.9},
+            {"type": "softmax", "output_sample_shape": 10,
+             "learning_rate": 0.03, "momentum": 0.9},
+        ],
+    })
+
+
+def test_mnist_converges_fused():
+    prng.reset(); prng.seed_all(42)
+    _configure()
+    from veles_tpu.samples import mnist
+    wf = mnist.train(fused=True)
+    metrics = wf.decision.epoch_metrics
+    assert len(metrics) <= 3
+    final_val = metrics[-1]["validation"]
+    assert final_val["err_pct"] < 5.0, final_val
+    # loss decreased epoch over epoch
+    losses = [m["validation"]["loss"] for m in metrics]
+    assert losses[-1] < losses[0]
+
+
+def test_fused_and_unit_mode_identical():
+    from veles_tpu.samples import mnist
+    finals, weights = [], []
+    for fused in (True, False):
+        prng.reset(); prng.seed_all(42)
+        _configure(n_train=500, n_valid=200, max_epochs=2)
+        wf = mnist.train(fused=fused)
+        finals.append(wf.decision.epoch_metrics[-1]["validation"])
+        # snapshot_state syncs fused device state back into the Vectors
+        wf.snapshot_state()
+        weights.append([numpy.array(f.weights.mem) for f in wf.forwards])
+    assert finals[0]["n_err"] == finals[1]["n_err"]
+    assert abs(finals[0]["loss"] - finals[1]["loss"]) < 1e-5
+    # FINAL WEIGHTS must match exactly too — catches divergence in how the
+    # last train minibatch is gated (decision.complete skips the update)
+    for wa, wb in zip(weights[0], weights[1]):
+        numpy.testing.assert_allclose(wa, wb, rtol=1e-6, atol=1e-7)
+
+
+def test_gd_skipped_on_validation_minibatches():
+    """Weights must not change during the validation portion of an epoch."""
+    prng.reset(); prng.seed_all(42)
+    _configure(n_train=300, n_valid=200, max_epochs=1)
+    from veles_tpu.samples import mnist
+    wf = mnist.build(fused=False)
+    wf.initialize()
+    w_before = numpy.array(wf.forwards[0].weights.mem)
+    # run the validation portion only: 2 minibatches of 100
+    wf.loader.run()
+    wf.evaluator.output  # touch to ensure links resolve
+    for unit in (wf.forwards[0], wf.forwards[1], wf.evaluator, wf.decision):
+        unit.run()
+    assert bool(wf.decision.gd_skip)          # VALID minibatch -> no GD
+    numpy.testing.assert_array_equal(w_before, wf.forwards[0].weights.mem)
+
+
+def test_decision_fail_iterations_early_stop():
+    """With an unlearnable lr=0 the run must stop via fail_iterations."""
+    prng.reset(); prng.seed_all(42)
+    root.mnist.update({
+        "loader": {"minibatch_size": 100, "n_train": 200, "n_valid": 100},
+        "decision": {"max_epochs": 50, "fail_iterations": 2},
+        "layers": [
+            {"type": "all2all_tanh", "output_sample_shape": 16,
+             "learning_rate": 0.0},
+            {"type": "softmax", "output_sample_shape": 10,
+             "learning_rate": 0.0},
+        ],
+    })
+    from veles_tpu.samples import mnist
+    wf = mnist.train(fused=True)
+    n_epochs = len(wf.decision.epoch_metrics)
+    assert n_epochs <= 4  # 1 improving epoch + 2 failing + margin
+
+
+def test_snapshot_state_roundtrip_weights():
+    prng.reset(); prng.seed_all(42)
+    _configure(n_train=300, n_valid=100, max_epochs=1)
+    from veles_tpu.samples import mnist
+    wf = mnist.train(fused=True)
+    state = wf.snapshot_state()
+    w_trained = numpy.array(wf.forwards[0].weights.mem)
+
+    prng.reset(); prng.seed_all(7)  # different seed: different init
+    _configure(n_train=300, n_valid=100, max_epochs=1)
+    wf2 = mnist.build(fused=True)
+    wf2.initialize()
+    wf2.load_snapshot_state(state)
+    numpy.testing.assert_array_equal(
+        w_trained, wf2.forwards[0].weights.mem)

@@ -1,0 +1,173 @@
+"""Tier-2 op tests: jax ops vs a hand-written numpy oracle.
+
+This mirrors the reference's per-op test pattern (numpy backend vs device
+backends, allclose within dtype tolerance — SURVEY §4 tier 2); the numpy
+oracle here is written independently of the jax code.
+"""
+
+import numpy
+import pytest
+
+from veles_tpu.ops import functional as F
+
+# fp32 tolerance: XLA's transcendental approximations (tanh, exp) differ from
+# numpy's at the ~1e-5 relative level, same class of tolerance the reference
+# used between its numpy and device backends
+RTOL = 5e-4
+ATOL = 1e-4
+
+
+def _np_activate(z, kind):
+    if kind == "linear":
+        return z
+    if kind == "tanh":
+        return 1.7159 * numpy.tanh(0.6666 * z)
+    if kind == "relu":
+        return numpy.log1p(numpy.exp(z))
+    if kind == "strict_relu":
+        return numpy.maximum(z, 0.0)
+    if kind == "sigmoid":
+        return 1.0 / (1.0 + numpy.exp(-z))
+    if kind == "softmax":
+        e = numpy.exp(z - z.max(axis=-1, keepdims=True))
+        return e / e.sum(axis=-1, keepdims=True)
+    raise AssertionError(kind)
+
+
+ACTIVATIONS = ["linear", "tanh", "relu", "strict_relu", "sigmoid", "softmax"]
+
+
+@pytest.mark.parametrize("activation", ACTIVATIONS)
+def test_dense_forward_matches_numpy(activation):
+    rng = numpy.random.RandomState(5)
+    x = rng.randn(7, 13).astype(numpy.float32)
+    w = rng.randn(13, 9).astype(numpy.float32) * 0.3
+    b = rng.randn(9).astype(numpy.float32) * 0.1
+    got = numpy.asarray(F.dense_forward(x, w, b, activation))
+    want = _np_activate(x @ w + b, activation)
+    numpy.testing.assert_allclose(got, want, rtol=RTOL, atol=ATOL)
+
+
+def test_dense_forward_flattens_nd_input():
+    rng = numpy.random.RandomState(0)
+    x = rng.randn(4, 2, 3, 5).astype(numpy.float32)
+    w = rng.randn(30, 6).astype(numpy.float32)
+    got = numpy.asarray(F.dense_forward(x, w, None, "linear"))
+    want = x.reshape(4, 30) @ w
+    numpy.testing.assert_allclose(got, want, rtol=RTOL, atol=ATOL)
+
+
+@pytest.mark.parametrize("activation",
+                         ["linear", "tanh", "relu", "strict_relu", "sigmoid"])
+def test_dense_backward_matches_finite_differences(activation):
+    """Gradient check: the backward pass vs numeric dL/dW, dL/db, dL/dx for
+    L = sum(y * r) with fixed random r (covers arbitrary err_output)."""
+    rng = numpy.random.RandomState(7)
+    x = rng.randn(5, 8).astype(numpy.float64)
+    w = rng.randn(8, 6).astype(numpy.float64) * 0.4
+    b = rng.randn(6).astype(numpy.float64) * 0.1
+    r = rng.randn(5, 6).astype(numpy.float64)
+
+    def loss(x_, w_, b_):
+        return float((_np_activate(x_ @ w_ + b_, activation) * r).sum())
+
+    y = _np_activate(x @ w + b, activation)
+    err_input, grad_w, grad_b = F.dense_backward(x, y, r, w, activation)
+    eps = 1e-6
+
+    def numgrad(arr, f):
+        g = numpy.zeros_like(arr)
+        flat = arr.reshape(-1)
+        gf = g.reshape(-1)
+        for i in range(flat.size):
+            old = flat[i]
+            flat[i] = old + eps
+            up = f()
+            flat[i] = old - eps
+            down = f()
+            flat[i] = old
+            gf[i] = (up - down) / (2 * eps)
+        return g
+
+    gw = numgrad(w, lambda: loss(x, w, b))
+    gb = numgrad(b, lambda: loss(x, w, b))
+    gx = numgrad(x, lambda: loss(x, w, b))
+    numpy.testing.assert_allclose(numpy.asarray(grad_w), gw, rtol=1e-3,
+                                  atol=1e-4)
+    numpy.testing.assert_allclose(numpy.asarray(grad_b), gb, rtol=1e-3,
+                                  atol=1e-4)
+    numpy.testing.assert_allclose(numpy.asarray(err_input), gx, rtol=1e-3,
+                                  atol=1e-4)
+
+
+def test_softmax_loss_oracle():
+    rng = numpy.random.RandomState(3)
+    logits = rng.randn(6, 4).astype(numpy.float32)
+    probs = _np_activate(logits, "softmax").astype(numpy.float32)
+    labels = numpy.array([0, 1, 2, 3, 1, 2], numpy.int32)
+    mask = numpy.array([1, 1, 1, 1, 0, 0], numpy.float32)  # 2 padded rows
+    err, metrics = F.softmax_loss(probs, labels, mask)
+    onehot = numpy.eye(4, dtype=numpy.float32)[labels]
+    numpy.testing.assert_allclose(
+        numpy.asarray(err), (probs - onehot) * mask[:, None],
+        rtol=RTOL, atol=ATOL)
+    pred = probs.argmax(-1)
+    want_nerr = int(((pred != labels) & (mask > 0)).sum())
+    assert int(metrics["n_err"]) == want_nerr
+    want_loss = float((-numpy.log(probs[numpy.arange(6), labels]) * mask).sum())
+    assert abs(float(metrics["loss_sum"]) - want_loss) < 1e-4
+    conf = numpy.asarray(metrics["confusion"])
+    assert conf.sum() == int(mask.sum())
+    for i in range(4):
+        assert conf[labels[i], pred[i]] >= 1
+
+
+def test_mse_loss_oracle():
+    rng = numpy.random.RandomState(4)
+    out = rng.randn(5, 7).astype(numpy.float32)
+    tgt = rng.randn(5, 7).astype(numpy.float32)
+    mask = numpy.array([1, 1, 1, 0, 0], numpy.float32)
+    err, metrics = F.mse_loss(out, tgt, mask)
+    want_err = (out - tgt) * mask[:, None]
+    numpy.testing.assert_allclose(numpy.asarray(err), want_err,
+                                  rtol=RTOL, atol=ATOL)
+    per = numpy.sqrt((want_err ** 2).sum(axis=1))
+    assert abs(float(metrics["mse_sum"]) - float((per ** 2).sum())) < 1e-4
+    assert abs(float(metrics["rmse_max"]) - float(per.max())) < 1e-5
+
+
+def test_sgd_update_momentum_decay_clip():
+    p = numpy.ones(4, numpy.float32)
+    v = numpy.zeros(4, numpy.float32)
+    g = numpy.array([10.0, -10.0, 0.5, 0.0], numpy.float32)  # batch sum
+    new_p, new_v = F.sgd_update(p, v, g, batch_size=2, learning_rate=0.1,
+                                momentum=0.0, weight_decay=0.0, l1_vs_l2=0.0,
+                                gradient_clip=1.0)
+    # g/2 then clipped to ±1
+    numpy.testing.assert_allclose(
+        numpy.asarray(new_p), [1 - 0.1, 1 + 0.1, 1 - 0.025, 1.0], rtol=1e-6)
+    # momentum accumulates
+    p2, v2 = F.sgd_update(numpy.asarray(new_p), numpy.asarray(new_v), g * 0,
+                          2, 0.1, 0.9, 0.0, 0.0, None)
+    numpy.testing.assert_allclose(numpy.asarray(p2 - new_p),
+                                  0.9 * numpy.asarray(new_v), rtol=1e-6)
+    # pure L2 decay pulls toward zero
+    p3, _ = F.sgd_update(p, v, g * 0, 1, 0.1, 0.0, 0.5, 0.0, None)
+    assert (numpy.asarray(p3) < p).all()
+    # pure L1 decay subtracts sign
+    p4, _ = F.sgd_update(p, v, g * 0, 1, 0.1, 0.0, 0.5, 1.0, None)
+    numpy.testing.assert_allclose(numpy.asarray(p4), p - 0.1 * 0.5, rtol=1e-6)
+
+
+def test_activation_derivatives_match_numeric():
+    z = numpy.linspace(-2, 2, 41)
+    eps = 1e-6
+    for kind in ["tanh", "relu", "strict_relu", "sigmoid"]:
+        y = _np_activate(z, kind)
+        want = (_np_activate(z + eps, kind) - _np_activate(z - eps, kind)) / (2 * eps)
+        got = numpy.asarray(F.activation_derivative_from_output(
+            y.astype(numpy.float32), kind))
+        # skip the kink at 0 for strict relu
+        keep = numpy.abs(z) > 1e-3 if kind == "strict_relu" else slice(None)
+        numpy.testing.assert_allclose(got[keep], want[keep], rtol=1e-3,
+                                      atol=1e-4)

@@ -1,0 +1,43 @@
+"""AcceleratedUnit — base class for device-compute units.
+
+Ref: veles/accelerated_units.py::AcceleratedUnit [H] (SURVEY §2.1).  The
+reference assembled OpenCL/CUDA source with #define dictionaries, built
+programs into a binary cache, and dispatched ``ocl_run/cuda_run/numpy_run``
+per backend.  TPU-native replacement: each unit exposes pure functions from
+``veles_tpu.ops.functional`` and jits them once at initialize time — XLA's
+compilation cache is the binary cache, jit is the program build, and there is
+exactly ONE backend (the numpy oracle lives in the tests, as the reference's
+numpy backend effectively did — SURVEY §4).
+"""
+
+from __future__ import annotations
+
+from veles_tpu.units import Unit
+
+
+class AcceleratedUnit(Unit):
+    """A unit whose ``run`` dispatches jitted device computations."""
+
+    def __init__(self, workflow, dtype=None, **kwargs):
+        super().__init__(workflow, **kwargs)
+        import numpy
+        self.dtype = numpy.dtype(dtype or "float32")
+        self._jitted = {}
+
+    def jit(self, name, fn, **jit_kwargs):
+        """Jit ``fn`` once per unit under ``name`` (idempotent)."""
+        import jax
+        cached = self._jitted.get(name)
+        if cached is None:
+            cached = jax.jit(fn, **jit_kwargs)
+            self._jitted[name] = cached
+        return cached
+
+
+class AcceleratedWorkflow:
+    """Marker mixin for workflows that own device state.
+
+    Ref: veles/accelerated_units.py::AcceleratedWorkflow [H].  Under XLA there
+    is no per-workflow device context to manage, so this only tags the class;
+    kept for API parity.
+    """

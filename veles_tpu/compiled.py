@@ -1,0 +1,238 @@
+"""Fused execution of the accelerated segment of a StandardWorkflow.
+
+SURVEY §7's central design move: the reference dispatched one OpenCL/CUDA
+kernel per unit per minibatch; here the whole steady-state inner cycle
+(forwards → evaluator → backwards → updates) is traced ONCE into a jitted
+``train_step(state, batch) -> (state, metrics)`` (plus an ``eval_step``), so
+XLA fuses across layer boundaries and the host does a single dispatch per
+minibatch.  The unit graph is left intact — the accelerated units are
+gate-skipped and a ``FusedStep`` node executes in their place — so Decision
+gating, snapshotting and plotting keep working unchanged (they are host-side
+outer-graph logic, exactly like the reference's event loop).
+
+The pure functions composed here are the SAME ``forward_fn``/``backward_fn``/
+``update_fn``/``loss_fn`` methods the units jit individually in unit mode, so
+fused and unit mode are numerically identical by construction.
+"""
+
+from __future__ import annotations
+
+from veles_tpu.mutable import Bool
+from veles_tpu.units import Unit
+from veles_tpu.loader.base import TRAIN
+
+
+class FusedRunner:
+    """Builds and owns the fused step functions + device parameter state."""
+
+    def __init__(self, wf):
+        import jax
+        self.wf = wf
+        self.forwards = list(wf.forwards)
+        self.evaluator = wf.evaluator
+        self.gds = list(wf.gds)
+        self.state = self._pull_state()
+        # loss routing: softmax-style evaluators consume labels, MSE-style
+        # consume a target (linked on the evaluator; for autoencoders it
+        # aliases the loader's minibatch_data)
+        from veles_tpu.ops.evaluator import EvaluatorMSE
+        self._is_mse = isinstance(self.evaluator, EvaluatorMSE)
+        # No donation in per-minibatch graph mode: the update is only
+        # COMMITTED after Decision gates it (see FusedStep/FusedCommit), so
+        # the previous state must stay alive.  The epoch-scan path donates.
+        self._train = jax.jit(self._train_step)
+        self._eval = jax.jit(self._eval_step)
+
+    # ----------------------------------------------------------------- state
+    def _pull_state(self):
+        """Collect per-layer params/velocities from the unit Vectors."""
+        state = []
+        for fwd, gd in zip(self.forwards, self.gds):
+            entry = {"w": fwd.weights.devmem,
+                     "vw": gd.velocity_weights.devmem}
+            if fwd.include_bias:
+                entry["b"] = fwd.bias.devmem
+                entry["vb"] = gd.velocity_bias.devmem
+            state.append(entry)
+        return state
+
+    def sync_to_units(self):
+        """Write fused state back into the unit Vectors (for snapshots)."""
+        for entry, fwd, gd in zip(self.state, self.forwards, self.gds):
+            fwd.weights.assign_device(entry["w"])
+            gd.velocity_weights.assign_device(entry["vw"])
+            if fwd.include_bias:
+                fwd.bias.assign_device(entry["b"])
+                gd.velocity_bias.assign_device(entry["vb"])
+
+    # ----------------------------------------------------------------- steps
+    def _forward_chain(self, state, x):
+        acts = [x]
+        h = x
+        for fwd, entry in zip(self.forwards, state):
+            h = fwd.forward_fn(h, entry["w"], entry.get("b"))
+            acts.append(h)
+        return acts
+
+    def _loss(self, y, y_ref, mask):
+        """y_ref: labels (classification) or the regression/AE target."""
+        if self._is_mse:
+            return self.evaluator.loss_fn(y, y_ref.reshape(y.shape), mask)
+        return self.evaluator.loss_fn(y, y_ref, mask)
+
+    def _eval_step(self, state, x, y_ref, mask):
+        acts = self._forward_chain(state, x)
+        _, metrics = self._loss(acts[-1], y_ref, mask)
+        return metrics
+
+    def _train_step(self, state, x, y_ref, mask, batch_size):
+        acts = self._forward_chain(state, x)
+        err, metrics = self._loss(acts[-1], y_ref, mask)
+        new_state = list(state)
+        for i in range(len(self.forwards) - 1, -1, -1):
+            gd, entry = self.gds[i], state[i]
+            err_in, grad_w, grad_b = gd.backward_fn(
+                acts[i], acts[i + 1], err, entry["w"])
+            new_w, new_b, new_vw, new_vb = gd.update_fn(
+                entry["w"], entry.get("b"), entry["vw"], entry.get("vb"),
+                grad_w, grad_b, batch_size)
+            new_entry = {"w": new_w, "vw": new_vw}
+            if new_b is not None:
+                new_entry["b"] = new_b
+                new_entry["vb"] = new_vb
+            new_state[i] = new_entry
+            err = err_in
+        return new_state, metrics
+
+    # ----------------------------------------------------- epoch-scan (fast)
+    # One device dispatch per EPOCH: lax.scan over the minibatch index
+    # matrix with the dataset resident in HBM.  This is the pure TPU-native
+    # steady state — zero host work between minibatches (the reference did
+    # host scheduling + H2D upload per minibatch, SURVEY §3.1).
+    def _epoch_train(self, state, data, labels, idx, mask):
+        import jax
+        import jax.numpy as jnp
+
+        def body(carry, mb):
+            mb_idx, mb_mask = mb
+            x = jnp.take(data, mb_idx, axis=0)
+            # labels doubles as the target array for MSE/AE workflows
+            y = (jnp.take(labels, mb_idx, axis=0)
+                 if labels is not None else x)
+            bs = mb_mask.sum().astype(jnp.int32)
+            carry, metrics = self._train_step(carry, x, y, mb_mask, bs)
+            return carry, metrics
+
+        state, stacked = jax.lax.scan(body, state, (idx, mask))
+        totals = jax.tree.map(lambda m: m.sum(axis=0), stacked)
+        return state, totals
+
+    def _epoch_eval(self, state, data, labels, idx, mask):
+        import jax
+        import jax.numpy as jnp
+
+        def body(carry, mb):
+            mb_idx, mb_mask = mb
+            x = jnp.take(data, mb_idx, axis=0)
+            y = (jnp.take(labels, mb_idx, axis=0)
+                 if labels is not None else x)
+            metrics = self._eval_step(carry, x, y, mb_mask)
+            return carry, metrics
+
+        _, stacked = jax.lax.scan(body, state, (idx, mask))
+        return jax.tree.map(lambda m: m.sum(axis=0), stacked)
+
+    def epoch_fns(self):
+        """Jitted (train_epoch, eval_epoch): args (state, data, labels,
+        idx (B,mb) int32, mask (B,mb) f32); train donates state."""
+        import jax
+        if not hasattr(self, "_epoch_train_jit"):
+            self._epoch_train_jit = jax.jit(self._epoch_train,
+                                            donate_argnums=(0,))
+            self._epoch_eval_jit = jax.jit(self._epoch_eval)
+        return self._epoch_train_jit, self._epoch_eval_jit
+
+    # ------------------------------------------------------------ graph hook
+    def install(self):
+        """Rewire the graph: gate-skip the accelerated units; FusedStep runs
+        the traced step right after the loader, FusedCommit adopts the
+        pending update AFTER Decision has gated it — exactly the reference's
+        ordering, where GD units fire after Decision and are skipped by
+        gd_skip/complete (ref: veles/znicz/standard_workflow.py [H])."""
+        wf = self.wf
+        always = Bool(True)
+        for unit in self.forwards + [self.evaluator] + self.gds:
+            unit.gate_skip = always
+        fused = FusedStep(wf, self, name="fused_step")
+        first_fwd = self.forwards[0]
+        first_fwd.unlink_from(wf.loader)
+        fused.link_from(wf.loader)
+        first_fwd.link_from(fused)
+        commit = FusedCommit(wf, self, name="fused_commit")
+        commit.link_from(wf.decision)
+        commit.gate_skip = wf.decision.gd_skip | wf.decision.complete
+        wf.fused_step = fused
+        wf.fused_commit = commit
+        return fused
+
+
+class FusedStep(Unit):
+    """Executes one fused train/eval step per minibatch.
+
+    For train minibatches the updated state is held PENDING; FusedCommit
+    adopts it only if Decision lets the backward pass run.  Note the unit
+    Vectors (weights/bias) are only synced back at snapshot time and at run
+    end — mid-run host reads must go through the runner's state.
+    """
+
+    def __init__(self, workflow, runner, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.runner = runner
+        self.pending_state = None
+        self._initialized = True
+
+    def initialize(self, **kwargs):
+        super().initialize(**kwargs)
+
+    def run(self):
+        import jax.numpy as jnp
+        runner = self.runner
+        loader = runner.wf.loader
+        x = loader.minibatch_data.devmem
+        labels = (loader.minibatch_labels.devmem
+                  if not loader.minibatch_labels.is_empty else None)
+        mask = loader.minibatch_mask.devmem
+        if runner._is_mse:
+            y_ref = runner.evaluator.target.devmem
+        else:
+            y_ref = labels
+        if loader.minibatch_class == TRAIN:
+            self.pending_state, metrics = runner._train(
+                runner.state, x, y_ref, mask,
+                jnp.asarray(loader.minibatch_size, jnp.int32))
+        else:
+            self.pending_state = None
+            metrics = runner._eval(runner.state, x, y_ref, mask)
+        # decision reads these through its link_attrs alias on the evaluator
+        runner.evaluator.metrics = metrics
+
+    def stop(self):
+        self.runner.sync_to_units()
+
+
+class FusedCommit(Unit):
+    """Adopts the pending update; gated like the GD units."""
+
+    def __init__(self, workflow, runner, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.runner = runner
+        self._initialized = True
+
+    def initialize(self, **kwargs):
+        super().initialize(**kwargs)
+
+    def run(self):
+        fused = self.runner.wf.fused_step
+        if fused.pending_state is not None:
+            self.runner.state = fused.pending_state
+            fused.pending_state = None

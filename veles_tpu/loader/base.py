@@ -1,0 +1,141 @@
+"""Loader — the three-set minibatch engine.
+
+Ref: veles/loader/base.py::Loader [H] (SURVEY §2.2): three sample sets
+(TEST=0, VALID=1, TRAIN=2), per-epoch iteration test→validation→train,
+train-index shuffling from the named "loader" PRNG stream, epoch accounting,
+and short-final-minibatch handling.
+
+TPU-native specifics:
+
+- minibatch shapes are STATIC: every minibatch is padded to
+  ``max_minibatch_size`` with a 0/1 ``minibatch_mask`` marking live rows
+  (the reference instead shrank ``minibatch_size``; masking keeps XLA from
+  recompiling per tail batch).
+- multi-process data parallelism replaces the reference's master→slave
+  index-shipping (ref: veles/loader/base.py IDistributable [H]) with
+  deterministic sharding: ``shard(process_index, process_count)`` gives each
+  host a strided slice of every set.
+"""
+
+from __future__ import annotations
+
+import numpy
+
+from veles_tpu import prng
+from veles_tpu.memory import Vector
+from veles_tpu.units import Unit
+
+TEST, VALID, TRAIN = 0, 1, 2
+CLASS_NAME = ["test", "validation", "train"]
+
+
+class Loader(Unit):
+    """Abstract minibatch engine; subclasses provide the data."""
+
+    snapshot_attrs = ("epoch_number", "_position", "_order")
+
+    def __init__(self, workflow, minibatch_size=100, shuffle=True,
+                 prng_stream="loader", **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.max_minibatch_size = int(minibatch_size)
+        self.shuffle = shuffle
+        self.prng_stream = prng_stream
+        #: [test, validation, train] sample counts — set by load_data()
+        self.class_lengths = [0, 0, 0]
+        self.minibatch_data = Vector()
+        self.minibatch_labels = Vector()
+        self.minibatch_indices = Vector()
+        self.minibatch_mask = Vector()
+        self.minibatch_size = 0        # live rows in the current minibatch
+        self.minibatch_class = TRAIN
+        self.last_minibatch = False    # True on the final minibatch of epoch
+        self.epoch_ended = False
+        self.epoch_number = 0
+        self._position = 0             # minibatch cursor within the epoch
+        self._order = None             # epoch plan: list of minibatch tuples
+        self._shard = (0, 1)           # (process_index, process_count)
+
+    # -- to be provided by subclasses ---------------------------------------
+    def load_data(self):
+        """Populate class_lengths (and whatever backing store is needed)."""
+        raise NotImplementedError
+
+    def create_minibatch_data(self):
+        """Allocate minibatch_data/labels Vectors at max_minibatch_size."""
+        raise NotImplementedError
+
+    def fill_minibatch(self, indices, actual_size):
+        """Fill minibatch Vectors for the given global sample indices."""
+        raise NotImplementedError
+
+    # -- sharding (multi-host DP) -------------------------------------------
+    def shard(self, process_index, process_count):
+        """Restrict this loader to a strided shard of every set.
+
+        The TPU-native successor of the reference's per-slave index shipping
+        (veles/server.py generate_data_for_slave → loader indices [H]):
+        deterministic, no control plane.
+        """
+        self._shard = (int(process_index), int(process_count))
+        return self
+
+    @property
+    def total_samples(self):
+        return sum(self.class_lengths)
+
+    def class_offsets(self):
+        """Global index ranges per class: data layout is [test|valid|train]."""
+        off, out = 0, []
+        for n in self.class_lengths:
+            out.append((off, off + n))
+            off += n
+        return out
+
+    # -- engine --------------------------------------------------------------
+    def initialize(self, device=None, **kwargs):
+        self.load_data()
+        if self.total_samples == 0:
+            raise ValueError("%s: load_data produced no samples" % self.name)
+        self.create_minibatch_data()
+        self._plan_epoch()
+        self._position = 0
+        super().initialize(device=device, **kwargs)
+
+    def _plan_epoch(self):
+        """Build this epoch's minibatch plan: test → validation → train."""
+        stream = prng.get(self.prng_stream)
+        pi, pc = self._shard
+        plan = []
+        for cls, (begin, end) in enumerate(self.class_offsets()):
+            idx = numpy.arange(begin, end)[pi::pc]
+            if len(idx) == 0:
+                continue
+            if cls == TRAIN and self.shuffle:
+                stream.shuffle(idx)
+            mb = self.max_minibatch_size
+            for at in range(0, len(idx), mb):
+                chunk = idx[at:at + mb]
+                actual = len(chunk)
+                if actual < mb:  # pad with the first index, masked dead
+                    chunk = numpy.concatenate(
+                        [chunk, numpy.full(mb - actual, chunk[0])])
+                plan.append((cls, chunk.astype(numpy.int32), actual))
+        self._order = plan
+
+    def run(self):
+        if self._order is None or self._position >= len(self._order):
+            self._plan_epoch()
+            self._position = 0
+        cls, indices, actual = self._order[self._position]
+        self._position += 1
+        self.minibatch_class = cls
+        self.minibatch_size = actual
+        mask = numpy.zeros(self.max_minibatch_size, numpy.float32)
+        mask[:actual] = 1.0
+        self.minibatch_mask.reset(mask)
+        self.minibatch_indices.reset(indices)
+        self.fill_minibatch(indices, actual)
+        self.last_minibatch = self._position >= len(self._order)
+        self.epoch_ended = self.last_minibatch
+        if self.last_minibatch:
+            self.epoch_number += 1

@@ -1,0 +1,48 @@
+"""FullBatchLoader — entire dataset resident in device HBM.
+
+Ref: veles/loader/fullbatch.py::FullBatchLoader [H] (SURVEY §2.2): the whole
+dataset lives in memory and minibatches are gathers by index.  TPU-native:
+the dataset is ONE ``jax.Array`` per tensor in HBM and ``fill_minibatch`` is
+a device-side ``jnp.take`` — the only host→device traffic per step is the
+tiny index vector (the reference re-uploaded minibatch data every step,
+SURVEY §3.1 device boundary #2).
+"""
+
+from __future__ import annotations
+
+import numpy
+
+from veles_tpu.loader.base import Loader
+from veles_tpu.memory import Vector
+
+
+class FullBatchLoader(Loader):
+    """Loader over in-memory arrays; subclasses fill original_data/labels."""
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        #: full dataset, laid out [test | validation | train] along axis 0
+        self.original_data = Vector()
+        self.original_labels = Vector()
+        self.has_labels = True
+
+    def load_data(self):
+        raise NotImplementedError
+
+    def create_minibatch_data(self):
+        mb = self.max_minibatch_size
+        sample_shape = self.original_data.shape[1:]
+        self.minibatch_data.reset(
+            numpy.zeros((mb,) + sample_shape, self.original_data.dtype))
+        if self.has_labels:
+            self.minibatch_labels.reset(
+                numpy.zeros(mb, numpy.int32))
+
+    def fill_minibatch(self, indices, actual_size):
+        import jax.numpy as jnp
+        idx = jnp.asarray(indices)
+        self.minibatch_data.assign_device(
+            jnp.take(self.original_data.devmem, idx, axis=0))
+        if self.has_labels:
+            self.minibatch_labels.assign_device(
+                jnp.take(self.original_labels.devmem, idx, axis=0))

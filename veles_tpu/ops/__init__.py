@@ -1,0 +1,12 @@
+"""NN ops — the znicz-plugin equivalent, TPU-native.
+
+Every accelerated op in the reference had three hand-written kernels
+(OpenCL/CUDA/numpy — ref: veles/znicz/ocl/*.cl, cuda/*.cu [H], SURVEY §2.3).
+Here each op is ONE pure jax function in ``veles_tpu.ops.functional``; XLA
+lowers it to the MXU, and the numpy test oracle in the test-suite plays the
+role the reference's numpy backend played.
+"""
+
+# importing the op modules registers their layer types and forward↔gd pairs
+from veles_tpu.ops import all2all, gd  # noqa: F401,E402
+

@@ -1,0 +1,68 @@
+"""Loss evaluators — they seed the backward chain and emit metrics.
+
+Ref: veles/znicz/evaluator.py::EvaluatorSoftmax/EvaluatorMSE [H]
+(SURVEY §2.3).  Metrics stay ON DEVICE as jax scalars; the Decision unit
+accumulates them device-side and only syncs to host at epoch boundaries —
+that is the TPU-native version of the reference's per-step D2H metric readout
+(SURVEY §3.1 device boundary #3), and it keeps the step pipeline free of
+host round-trips.
+"""
+
+from __future__ import annotations
+
+import numpy
+
+from veles_tpu.accel import AcceleratedUnit
+from veles_tpu.memory import Vector
+from veles_tpu.workflow import DeferredInitError
+from veles_tpu.ops import functional as F
+
+
+class EvaluatorBase(AcceleratedUnit):
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.err_output = Vector()
+        self.metrics = {}        # name -> device scalar/array, per minibatch
+
+    def initialize(self, device=None, **kwargs):
+        if not hasattr(self, "output") or self.output.is_empty:
+            raise DeferredInitError(self.name)
+        self.err_output.reset(numpy.zeros(self.output.shape, self.dtype))
+        self._eval = self.jit("eval", self.loss_fn)
+        super().initialize(device=device, **kwargs)
+
+
+class EvaluatorSoftmax(EvaluatorBase):
+    """Softmax+NLL with error count and confusion matrix.
+
+    Linked attrs: ``output`` (last forward's probs), ``labels`` (loader's
+    minibatch_labels), ``mask`` (loader's minibatch_mask 0/1 validity).
+    Produces ``err_output`` = dL/dlogits and device metrics ``n_err``,
+    ``loss_sum``, ``confusion``.
+    """
+
+    def loss_fn(self, probs, labels, mask):
+        return F.softmax_loss(probs, labels, mask)
+
+    def run(self):
+        err, metrics = self._eval(self.output.devmem, self.labels.devmem,
+                                  self.mask.devmem)
+        self.err_output.assign_device(err)
+        self.metrics = metrics
+
+
+class EvaluatorMSE(EvaluatorBase):
+    """Mean-squared-error evaluator (autoencoders, regression).
+
+    Linked attrs: ``output``, ``target`` (for autoencoders the loader's
+    minibatch_data itself), ``mask``.
+    """
+
+    def loss_fn(self, output, target, mask):
+        return F.mse_loss(output, target, mask)
+
+    def run(self):
+        err, metrics = self._eval(self.output.devmem, self.target.devmem,
+                                  self.mask.devmem)
+        self.err_output.assign_device(err)
+        self.metrics = metrics

@@ -1,0 +1,204 @@
+"""Pure numeric functions behind every accelerated unit.
+
+Single source of truth: unit-mode ``run()`` methods jit these individually;
+the fused step builder (``veles_tpu.compiled``) composes them into one traced
+``train_step``.  All are shape-static, batch-leading, and bf16/f32 friendly so
+XLA tiles the matmuls onto the MXU.
+
+Activation semantics follow the reference exactly (ref: veles/znicz/
+all2all.py, activation.py [H]):
+
+- ``tanh`` is the LeCun-scaled ``1.7159 * tanh(2/3 x)`` the reference's
+  All2AllTanh/ConvTanh used,
+- ``relu`` is the smooth ``log(1 + exp(x))`` the reference called RELU,
+- ``strict_relu`` is ``max(0, x)``,
+- ``sigmoid``, ``softmax`` as usual.
+
+Each activation has a matching ``*_derivative_from_output`` used by the
+backward chain: derivatives are expressed in terms of the forward OUTPUT
+(exactly like the reference's gradient kernels), so the backward pass never
+re-materializes pre-activations.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# LeCun-scaled tanh constants (ref: veles/znicz/all2all.py::All2AllTanh [H])
+TANH_A = 1.7159
+TANH_B = 0.6666
+
+# Matmul precision: jax's default lets the MXU (and its CPU emulation) use
+# reduced-precision passes; the reference computed fp32 GEMMs (OpenCL/cuBLAS),
+# so convergence parity requires HIGHEST by default (SURVEY §7 "hard parts").
+# Perf runs can opt into bf16 inputs via set_matmul_precision("bfloat16"),
+# which casts operands instead (the idiomatic fast path on TPU).
+_PRECISION = jax.lax.Precision.HIGHEST
+_CAST_BF16 = False
+
+
+def set_matmul_precision(mode):
+    """mode: 'float32' (default, parity) | 'default' | 'bfloat16' (fast).
+
+    The mode is read at TRACE time, so already-jitted functions would keep
+    their old precision; jax caches are cleared here to force a retrace on
+    the next call.
+    """
+    global _PRECISION, _CAST_BF16
+    if mode == "float32":
+        _PRECISION, _CAST_BF16 = jax.lax.Precision.HIGHEST, False
+    elif mode == "default":
+        _PRECISION, _CAST_BF16 = jax.lax.Precision.DEFAULT, False
+    elif mode == "bfloat16":
+        _PRECISION, _CAST_BF16 = jax.lax.Precision.DEFAULT, True
+    else:
+        raise ValueError("unknown matmul precision mode %r" % (mode,))
+    jax.clear_caches()
+
+
+def matmul(a, b):
+    """Precision-pinned matmul every op routes its GEMMs through."""
+    if _CAST_BF16:
+        out_dtype = jnp.result_type(a, b)
+        return jnp.matmul(a.astype(jnp.bfloat16),
+                          b.astype(jnp.bfloat16)).astype(out_dtype)
+    return jnp.matmul(a, b, precision=_PRECISION)
+
+
+# --------------------------------------------------------------- activations
+def activate(z, activation):
+    if activation == "linear":
+        return z
+    if activation == "tanh":
+        return TANH_A * jnp.tanh(TANH_B * z)
+    if activation == "relu":  # smooth relu, see module docstring
+        return jnp.log1p(jnp.exp(-jnp.abs(z))) + jnp.maximum(z, 0.0)
+    if activation == "strict_relu":
+        return jnp.maximum(z, 0.0)
+    if activation == "sigmoid":
+        return jax.nn.sigmoid(z)
+    if activation == "softmax":
+        return jax.nn.softmax(z, axis=-1)
+    raise ValueError("unknown activation %r" % (activation,))
+
+
+def activation_derivative_from_output(y, activation):
+    """d(activation)/d(pre-activation) expressed via the forward output y.
+
+    For softmax returns ones: the softmax evaluator emits err_output already
+    w.r.t. the logits (the softmax+NLL fusion the reference used — ref:
+    veles/znicz/evaluator.py::EvaluatorSoftmax [H]).
+    """
+    if activation in ("linear", "softmax"):
+        return jnp.ones_like(y)
+    if activation == "tanh":
+        # y = a tanh(bz)  =>  dy/dz = b (a - y^2 / a)
+        return TANH_B * (TANH_A - y * y / TANH_A)
+    if activation == "relu":
+        # y = log(1+e^z)  =>  dy/dz = 1 - e^{-y}
+        return 1.0 - jnp.exp(-y)
+    if activation == "strict_relu":
+        return (y > 0.0).astype(y.dtype)
+    if activation == "sigmoid":
+        return y * (1.0 - y)
+    raise ValueError("unknown activation %r" % (activation,))
+
+
+# --------------------------------------------------------------------- dense
+def dense_forward(x, weights, bias, activation="linear"):
+    """All2All forward: y = act(x @ W + b).
+
+    x: (batch, n_in); weights: (n_in, n_out); bias: (n_out,) or None.
+    Ref: veles/znicz/all2all.py::All2All [H] (GEMM + fused activation on MXU).
+    """
+    z = matmul(x.reshape(x.shape[0], -1), weights)
+    if bias is not None:
+        z = z + bias
+    return activate(z, activation)
+
+
+def dense_backward(x, y, err_output, weights, activation="linear",
+                   include_bias=True, need_err_input=True):
+    """All2All backward: (err_input, grad_weights, grad_bias).
+
+    err_output is dL/dy (or dL/dlogits for softmax, see above).  Gradients
+    are SUMS over the batch; the update rule normalizes by batch size.
+    ``need_err_input=False`` (first trainable layer) skips the dL/dx GEMM
+    entirely.  Ref: veles/znicz/gd.py::GradientDescent [H].
+    """
+    x2 = x.reshape(x.shape[0], -1)
+    dz = err_output * activation_derivative_from_output(y, activation)
+    grad_weights = matmul(x2.T, dz)
+    grad_bias = dz.sum(axis=0) if include_bias else None
+    err_input = (matmul(dz, weights.T).reshape(x.shape)
+                 if need_err_input else None)
+    return err_input, grad_weights, grad_bias
+
+
+# ---------------------------------------------------------------- evaluators
+def softmax_loss(probs, labels, valid_mask):
+    """Softmax+NLL evaluator math.
+
+    probs: (batch, n_classes) — OUTPUT of All2AllSoftmax;
+    labels: (batch,) int; valid_mask: (batch,) 0/1 float (short-minibatch
+    padding — the reference tracked the live ``minibatch_size`` instead;
+    masking keeps shapes static for XLA).
+
+    Returns (err_output, metrics) with err_output = (probs - onehot) * mask —
+    the gradient w.r.t. the LOGITS (softmax+NLL fusion).  Metrics: n_err
+    (wrong argmax count), loss sum, per-class confusion counts.
+    Ref: veles/znicz/evaluator.py::EvaluatorSoftmax [H].
+    """
+    n_classes = probs.shape[-1]
+    onehot = jax.nn.one_hot(labels, n_classes, dtype=probs.dtype)
+    mask = valid_mask.astype(probs.dtype)[:, None]
+    err_output = (probs - onehot) * mask
+    pred = jnp.argmax(probs, axis=-1)
+    wrong = (pred != labels) & (valid_mask > 0)
+    n_err = wrong.sum(dtype=jnp.int32)
+    eps = jnp.asarray(1e-30, probs.dtype)
+    nll = -jnp.log(jnp.maximum(
+        jnp.take_along_axis(probs, labels[:, None], axis=-1)[:, 0], eps))
+    loss_sum = (nll * valid_mask.astype(probs.dtype)).sum()
+    confusion = jnp.zeros((n_classes, n_classes), jnp.int32).at[
+        labels, pred].add(valid_mask.astype(jnp.int32))
+    return err_output, {"n_err": n_err, "loss_sum": loss_sum,
+                        "confusion": confusion}
+
+
+def mse_loss(output, target, valid_mask):
+    """MSE evaluator: err_output = (output - target) * mask, metrics sums.
+
+    Ref: veles/znicz/evaluator.py::EvaluatorMSE [H].
+    """
+    mask = valid_mask.astype(output.dtype).reshape(
+        (-1,) + (1,) * (output.ndim - 1))
+    diff = (output - target) * mask
+    per_sample = jnp.sqrt((diff * diff).reshape(diff.shape[0], -1).sum(axis=1))
+    return diff, {
+        "mse_sum": (per_sample * per_sample).sum(),
+        "rmse_max": per_sample.max(),
+        "loss_sum": 0.5 * (diff * diff).sum(),
+    }
+
+
+# ------------------------------------------------------------------- updates
+def sgd_update(param, velocity, grad, batch_size, learning_rate, momentum,
+               weight_decay, l1_vs_l2, gradient_clip):
+    """Momentum-SGD with mixed L1/L2 decay and optional clipping.
+
+    Matches the reference's per-unit update options (lr, momentum,
+    weight-decay with l1_vs_l2 mix, clipping — ref: veles/znicz/
+    nn_units.py::GradientDescentBase [H]).  Gradients arrive as batch SUMS
+    and are normalized by the live batch size here.
+    """
+    g = grad / jnp.maximum(batch_size, 1).astype(grad.dtype)
+    if gradient_clip is not None and gradient_clip > 0.0:
+        g = jnp.clip(g, -gradient_clip, gradient_clip)
+    if weight_decay:
+        decay = (l1_vs_l2 * jnp.sign(param)
+                 + (1.0 - l1_vs_l2) * param)
+        g = g + weight_decay * decay
+    velocity = momentum * velocity - learning_rate * g
+    return param + velocity, velocity

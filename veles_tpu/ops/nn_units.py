@@ -1,0 +1,251 @@
+"""NN unit base classes: forward ops, gradient ops, their pairing.
+
+Ref: veles/znicz/nn_units.py::ForwardBase/GradientDescentBase/MatchingObject
+[H] (SURVEY §2.3).  A forward unit owns its weights/bias (device-resident
+Vectors); its paired gradient unit consumes ``err_output`` from the next unit
+in the backward chain, produces ``err_input`` for the previous one, and
+applies the per-unit update rule (learning rate, momentum, L1/L2 decay,
+clipping — each layer can differ, exactly like the reference).
+"""
+
+from __future__ import annotations
+
+import numpy
+
+from veles_tpu import prng
+from veles_tpu.accel import AcceleratedUnit
+from veles_tpu.memory import Vector
+from veles_tpu.workflow import Workflow, DeferredInitError
+from veles_tpu.ops import functional as F
+
+#: maps config layer-type strings to forward unit classes
+#: (ref: veles/znicz/standard_workflow.py layer "type" keys [H])
+LAYER_TYPES = {}
+
+#: maps forward classes to their gradient classes
+#: (ref: veles/znicz/nn_units.py::MatchingObject metaclass [H])
+_FORWARD_TO_GD = {}
+
+
+def register_layer_type(name):
+    def deco(cls):
+        LAYER_TYPES[name] = cls
+        cls.layer_type = name
+        return cls
+    return deco
+
+
+def register_gd_for(forward_cls):
+    def deco(cls):
+        _FORWARD_TO_GD[forward_cls] = cls
+        cls.forward_class = forward_cls
+        return cls
+    return deco
+
+
+def gd_class_for(forward_unit_or_cls):
+    cls = (forward_unit_or_cls if isinstance(forward_unit_or_cls, type)
+           else type(forward_unit_or_cls))
+    for klass in cls.__mro__:
+        gd = _FORWARD_TO_GD.get(klass)
+        if gd is not None:
+            return gd
+    raise KeyError("no gradient unit registered for %s" % cls.__name__)
+
+
+class NNWorkflow(Workflow):
+    """Workflow with the conventional NN roles attached.
+
+    Ref: veles/znicz/nn_units.py::NNWorkflow [H]: slots for loader,
+    forwards, evaluator, decision, gds that samples and services rely on.
+    """
+
+    def __init__(self, workflow=None, name=None, **kwargs):
+        super().__init__(workflow, name=name, **kwargs)
+        self.loader = None
+        self.forwards = []
+        self.evaluator = None
+        self.decision = None
+        self.gds = []
+        self.repeater = None
+
+
+class ForwardBase(AcceleratedUnit):
+    """Base for weight-owning forward units.
+
+    Subclasses set ``ACTIVATION`` and may override ``infer_output_shape`` /
+    ``forward_fn``.  Weight init follows the reference's options
+    (``weights_filling`` uniform/gaussian with ``weights_stddev`` — ref:
+    veles/znicz/nn_units.py [H]).
+    """
+
+    ACTIVATION = "linear"
+    snapshot_attrs = ("weights", "bias")
+
+    def __init__(self, workflow, output_sample_shape=None,
+                 weights_filling="uniform", weights_stddev=None,
+                 include_bias=True, **kwargs):
+        super().__init__(workflow, **kwargs)
+        if isinstance(output_sample_shape, int):
+            output_sample_shape = (output_sample_shape,)
+        self.output_sample_shape = output_sample_shape
+        self.weights_filling = weights_filling
+        self.weights_stddev = weights_stddev
+        self.include_bias = include_bias
+        self.weights = Vector()
+        self.bias = Vector()
+        self.output = Vector()
+        # self.input is expected to be link_attrs'd from the previous unit's
+        # output (or the loader's minibatch_data).
+
+    # -- shape / param init --------------------------------------------------
+    @property
+    def n_input(self):
+        shape = self.input.shape
+        n = 1
+        for d in shape[1:]:
+            n *= d
+        return n
+
+    @property
+    def n_output(self):
+        n = 1
+        for d in self.output_sample_shape:
+            n *= d
+        return n
+
+    def _init_weights(self, shape, fan_in, fan_out):
+        stream = prng.get("init")
+        w = numpy.zeros(shape, dtype=self.dtype)
+        if self.weights_stddev is not None:
+            s = self.weights_stddev
+        else:
+            s = numpy.sqrt(6.0 / (fan_in + fan_out))
+        if self.weights_filling == "uniform":
+            stream.fill(w, -s, s)
+        elif self.weights_filling == "gaussian":
+            stream.fill_normal(w, 0.0, s)
+        else:
+            raise ValueError("unknown weights_filling %r"
+                             % self.weights_filling)
+        return w
+
+    def initialize(self, device=None, **kwargs):
+        if not hasattr(self, "input") or self.input.is_empty:
+            raise DeferredInitError(self.name)
+        if self.weights.is_empty:
+            self.weights.reset(self._init_weights(
+                (self.n_input, self.n_output), self.n_input, self.n_output))
+            if self.include_bias:
+                self.bias.reset(numpy.zeros(self.n_output, self.dtype))
+        batch = self.input.shape[0]
+        self.output.reset(numpy.zeros((batch,) + tuple(self.output_sample_shape),
+                                      self.dtype))
+        self._fwd = self.jit("fwd", self.forward_fn)
+        super().initialize(device=device, **kwargs)
+
+    # -- compute -------------------------------------------------------------
+    def forward_fn(self, x, weights, bias):
+        """The pure forward function (composed by the fused step builder)."""
+        y = F.dense_forward(x, weights, bias if self.include_bias else None,
+                            self.ACTIVATION)
+        return y.reshape((x.shape[0],) + tuple(self.output_sample_shape))
+
+    def run(self):
+        self.output.assign_device(self._fwd(
+            self.input.devmem, self.weights.devmem,
+            self.bias.devmem if self.include_bias else None))
+
+
+class GradientDescentBase(AcceleratedUnit):
+    """Base for gradient/update units.
+
+    Consumes ``err_output`` (dL/d output of the paired forward unit),
+    produces ``err_input`` (which becomes the previous GD unit's err_output
+    via link_attrs) and updates the paired forward's weights in place.
+    Hyperparameters are per-unit (ref: veles/znicz/gd.py [H]).
+    """
+
+    snapshot_attrs = ("velocity_weights", "velocity_bias")
+
+    def __init__(self, workflow, forward=None, learning_rate=0.01,
+                 learning_rate_bias=None, momentum=0.0, weight_decay=0.0,
+                 weight_decay_bias=0.0, l1_vs_l2=0.0, gradient_clip=None,
+                 need_err_input=True, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.forward = forward
+        self.learning_rate = learning_rate
+        self.learning_rate_bias = (learning_rate if learning_rate_bias is None
+                                   else learning_rate_bias)
+        self.momentum = momentum
+        self.weight_decay = weight_decay
+        self.weight_decay_bias = weight_decay_bias
+        self.l1_vs_l2 = l1_vs_l2
+        self.gradient_clip = gradient_clip
+        #: first trainable layer skips computing err_input (saves a GEMM,
+        #: same as the reference's need_err_input flag)
+        self.need_err_input = need_err_input
+        self.err_input = Vector()
+        self.velocity_weights = Vector()
+        self.velocity_bias = Vector()
+        if forward is not None:
+            self.link_attrs(forward, "weights", "bias", "input", "output")
+        # self.err_output is link_attrs'd from the next GD unit's err_input
+        # (or the evaluator's err_output); self.batch_size from the loader.
+
+    def initialize(self, device=None, **kwargs):
+        fwd = self.forward
+        if fwd is None or fwd.weights.is_empty:
+            raise DeferredInitError(self.name)
+        if self.velocity_weights.is_empty:
+            self.velocity_weights.reset(
+                numpy.zeros(fwd.weights.shape, self.dtype))
+            if fwd.include_bias:
+                self.velocity_bias.reset(
+                    numpy.zeros(fwd.bias.shape, self.dtype))
+        self._bwd = self.jit("bwd", self.backward_fn)
+        self._upd = self.jit("upd", self.update_fn)
+        super().initialize(device=device, **kwargs)
+
+    # -- pure functions ------------------------------------------------------
+    def backward_fn(self, x, y, err_output, weights):
+        """(err_input, grad_weights, grad_bias) — pure, composed when fused."""
+        return F.dense_backward(
+            x, y.reshape(y.shape[0], -1),
+            err_output.reshape(err_output.shape[0], -1), weights,
+            self.forward.ACTIVATION, self.forward.include_bias,
+            self.need_err_input)
+
+    def update_fn(self, weights, bias, vel_w, vel_b, grad_w, grad_b,
+                  batch_size):
+        new_w, new_vw = F.sgd_update(
+            weights, vel_w, grad_w, batch_size, self.learning_rate,
+            self.momentum, self.weight_decay, self.l1_vs_l2,
+            self.gradient_clip)
+        if grad_b is None:
+            return new_w, None, new_vw, None
+        new_b, new_vb = F.sgd_update(
+            bias, vel_b, grad_b, batch_size, self.learning_rate_bias,
+            self.momentum, self.weight_decay_bias, self.l1_vs_l2,
+            self.gradient_clip)
+        return new_w, new_b, new_vw, new_vb
+
+    def run(self):
+        import jax.numpy as jnp
+        fwd = self.forward
+        err_in, grad_w, grad_b = self._bwd(
+            self.input.devmem, self.output.devmem, self.err_output.devmem,
+            self.weights.devmem)
+        if self.need_err_input:
+            self.err_input.assign_device(err_in)
+        new_w, new_b, new_vw, new_vb = self._upd(
+            self.weights.devmem,
+            fwd.bias.devmem if fwd.include_bias else None,
+            self.velocity_weights.devmem,
+            self.velocity_bias.devmem if fwd.include_bias else None,
+            grad_w, grad_b, jnp.asarray(int(self.batch_size)))
+        fwd.weights.assign_device(new_w)
+        self.velocity_weights.assign_device(new_vw)
+        if fwd.include_bias:
+            fwd.bias.assign_device(new_b)
+            self.velocity_bias.assign_device(new_vb)

@@ -1,0 +1,6 @@
+"""Sample launchers — the model zoo (ref: veles/znicz/samples/** [H]).
+
+Each sample module defines a Workflow subclass plus a ``run(load, main)``
+entry point called by the CLI (ref convention: SURVEY §3.1), and a direct
+``train(...)`` helper usable from code and tests.
+"""

@@ -1,0 +1,173 @@
+"""StandardWorkflow — the config→graph compiler.
+
+Ref: veles/znicz/standard_workflow.py::StandardWorkflow [H] (SURVEY §2.3):
+builds the full training graph (loader → forwards → evaluator → decision →
+gds → repeater cycle, snapshotter/plotters off decision) from a declarative
+``layers`` list like::
+
+    [{"type": "all2all_tanh", "->": {"output_sample_shape": 100},
+                              "<-": {"learning_rate": 0.03}},
+     {"type": "softmax",      "->": {"output_sample_shape": 10},
+                              "<-": {"learning_rate": 0.03}}]
+
+Flat keys are also accepted (merged into "->"/"<-" by ownership).
+
+Execution: the classic unit graph runs under the host scheduler (unit mode).
+When ``fused=True`` (default) the accelerated segment (forwards + evaluator +
+gds) is additionally traced ONCE into jitted train/eval steps
+(``veles_tpu.compiled``) and the per-minibatch cycle dispatches those instead
+of the individual unit runs — same numerics (identical pure functions), one
+XLA dispatch per minibatch (SURVEY §7 design stance).
+"""
+
+from __future__ import annotations
+
+from veles_tpu.config import get
+from veles_tpu.workflow import Repeater
+from veles_tpu.ops.nn_units import NNWorkflow, LAYER_TYPES, gd_class_for
+from veles_tpu.ops.evaluator import EvaluatorSoftmax, EvaluatorMSE
+from veles_tpu.ops.decision import DecisionGD, DecisionMSE
+
+# keys routed to the forward unit when given flat in a layer dict
+_FWD_KEYS = {"output_sample_shape", "weights_filling", "weights_stddev",
+             "include_bias", "dtype"}
+# keys routed to the gradient unit
+_GD_KEYS = {"learning_rate", "learning_rate_bias", "momentum", "weight_decay",
+            "weight_decay_bias", "l1_vs_l2", "gradient_clip"}
+
+
+def parse_layer(layer):
+    """Split one layer config dict into (type, fwd_kwargs, gd_kwargs)."""
+    layer = dict(layer)
+    kind = layer.pop("type")
+    fwd = dict(layer.pop("->", {}))
+    gd = dict(layer.pop("<-", {}))
+    for key, value in layer.items():
+        if key in _FWD_KEYS:
+            fwd[key] = get(value, value)
+        elif key in _GD_KEYS:
+            gd[key] = get(value, value)
+        else:
+            raise ValueError("unknown layer config key %r" % key)
+    return kind, fwd, gd
+
+
+class StandardWorkflowBase(NNWorkflow):
+    """Builds the standard supervised-training graph from config."""
+
+    def __init__(self, workflow=None, name=None, loader_factory=None,
+                 loader_config=None, layers=(), decision_config=None,
+                 loss_function="softmax", fused=True, **kwargs):
+        super().__init__(workflow, name=name, **kwargs)
+        self.layers_config = list(layers)
+        self.loss_function = loss_function
+        self.fused = fused
+        self._build(loader_factory, dict(loader_config or {}),
+                    dict(decision_config or {}))
+
+    # ------------------------------------------------------------------ build
+    def _build(self, loader_factory, loader_config, decision_config):
+        if loader_factory is None:
+            raise ValueError("loader_factory is required")
+        self.repeater = Repeater(self, name="repeater")
+        self.repeater.link_from(self.start_point)
+
+        self.loader = loader_factory(self, name="loader", **loader_config)
+        self.loader.link_from(self.repeater)
+
+        self.link_forwards()
+        self.link_evaluator()
+        self.link_decision(decision_config)
+        self.link_gds()
+        self.link_end_point()
+
+    def link_forwards(self):
+        prev = None
+        for layer in self.layers_config:
+            kind, fwd_kwargs, _ = parse_layer(layer)
+            cls = LAYER_TYPES.get(kind)
+            if cls is None:
+                raise ValueError("unknown layer type %r (known: %s)" %
+                                 (kind, ", ".join(sorted(LAYER_TYPES))))
+            unit = cls(self, **fwd_kwargs)
+            if prev is None:
+                unit.link_from(self.loader)
+                unit.link_attrs(self.loader, ("input", "minibatch_data"))
+            else:
+                unit.link_from(prev)
+                unit.link_attrs(prev, ("input", "output"))
+            self.forwards.append(unit)
+            prev = unit
+
+    def link_evaluator(self):
+        last = self.forwards[-1]
+        if self.loss_function == "softmax":
+            ev = EvaluatorSoftmax(self, name="evaluator")
+            ev.link_attrs(self.loader, ("labels", "minibatch_labels"),
+                          ("mask", "minibatch_mask"))
+        elif self.loss_function == "mse":
+            ev = EvaluatorMSE(self, name="evaluator")
+            ev.link_attrs(self.loader, ("target", "minibatch_data"),
+                          ("mask", "minibatch_mask"))
+        else:
+            raise ValueError("unknown loss_function %r" % self.loss_function)
+        ev.link_from(last)
+        ev.link_attrs(last, "output")
+        self.evaluator = ev
+
+    def link_decision(self, decision_config):
+        cls = DecisionGD if self.loss_function == "softmax" else DecisionMSE
+        dec = cls(self, name="decision", **decision_config)
+        dec.link_from(self.evaluator)
+        dec.link_attrs(self.loader, "minibatch_class", "minibatch_size",
+                       "last_minibatch", "class_lengths", "epoch_number")
+        dec.link_attrs(self.evaluator, "metrics")
+        self.decision = dec
+
+    def link_gds(self):
+        """Backward chain in reverse layer order, closing the cycle."""
+        prev_gd = None
+        for fwd in reversed(self.forwards):
+            _, _, gd_kwargs = parse_layer(
+                self.layers_config[self.forwards.index(fwd)])
+            gd_cls = gd_class_for(fwd)
+            gd = gd_cls(self, forward=fwd,
+                        need_err_input=fwd is not self.forwards[0],
+                        **gd_kwargs)
+            if prev_gd is None:
+                gd.link_from(self.decision)
+                gd.link_attrs(self.evaluator, "err_output")
+            else:
+                gd.link_from(prev_gd)
+                gd.link_attrs(prev_gd, ("err_output", "err_input"))
+            gd.link_attrs(self.loader, ("batch_size", "minibatch_size"))
+            gd.gate_skip = self.decision.gd_skip | self.decision.complete
+            self.gds.insert(0, gd)
+            prev_gd = gd
+        self.repeater.link_from(prev_gd if prev_gd is not None
+                                else self.decision)
+
+    def link_end_point(self):
+        self.end_point.link_from(self.decision)
+        self.end_point.gate_block = ~self.decision.complete
+
+    # ------------------------------------------------------------------ fused
+    def initialize(self, device=None, **kwargs):
+        super().initialize(device=device, **kwargs)
+        if self.fused:
+            from veles_tpu.compiled import FusedRunner
+            self._fused_runner = FusedRunner(self)
+            self._fused_runner.install()
+        return self
+
+    def snapshot_state(self):
+        # during a fused run the unit Vectors lag the device state; sync
+        # before collecting so snapshots always see the live weights
+        runner = getattr(self, "_fused_runner", None)
+        if runner is not None:
+            runner.sync_to_units()
+        return super().snapshot_state()
+
+
+class StandardWorkflow(StandardWorkflowBase):
+    """The user-facing standard workflow (reference class name parity)."""
