@@ -1,0 +1,137 @@
+"""Tier-3 CIFAR conv-stack functional tests (BASELINE config[1] shape)."""
+
+import numpy
+
+from veles_tpu import prng
+from veles_tpu.config import root
+
+
+def _configure(n_train=600, n_valid=200, max_epochs=8):
+    root.cifar.update({
+        "loader": {"minibatch_size": 50, "n_train": n_train,
+                   "n_valid": n_valid},
+        "decision": {"max_epochs": max_epochs, "fail_iterations": 50},
+        "layers": [
+            {"type": "conv_str", "n_kernels": 16, "kx": 5, "ky": 5,
+             "padding": "SAME", "learning_rate": 0.01,
+             "weights_stddev": 0.05},
+            {"type": "max_pooling", "kx": 4, "ky": 4},
+            {"type": "softmax", "output_sample_shape": 10,
+             "learning_rate": 0.01, "weights_stddev": 0.05},
+        ],
+    })
+
+
+def test_cifar_conv_converges():
+    prng.reset(); prng.seed_all(42)
+    _configure()
+    from veles_tpu.samples import cifar
+    wf = cifar.train(fused=True)
+    metrics = wf.decision.epoch_metrics
+    first = metrics[0]["validation"]["err_pct"]
+    final = metrics[-1]["validation"]["err_pct"]
+    assert final < 25.0, (first, final)
+    assert final < first
+
+
+def test_cifar_fused_and_unit_mode_identical():
+    from veles_tpu.samples import cifar
+    finals, weights = [], []
+    for fused in (True, False):
+        prng.reset(); prng.seed_all(42)
+        _configure(n_train=200, n_valid=100, max_epochs=1)
+        wf = cifar.train(fused=fused)
+        finals.append(wf.decision.epoch_metrics[-1]["validation"])
+        wf.snapshot_state()
+        weights.append([numpy.array(f.weights.mem) for f in wf.forwards
+                        if f.has_params])
+    assert finals[0]["n_err"] == finals[1]["n_err"]
+    for wa, wb in zip(weights[0], weights[1]):
+        numpy.testing.assert_allclose(wa, wb, rtol=1e-5, atol=1e-6)
+
+
+def test_conv_with_dropout_and_lrn_trains():
+    """Dropout (stochastic) + LRN layers inside the standard graph."""
+    prng.reset(); prng.seed_all(42)
+    root.cifar.update({
+        "loader": {"minibatch_size": 25, "n_train": 100, "n_valid": 50},
+        "decision": {"max_epochs": 2, "fail_iterations": 50},
+        "layers": [
+            {"type": "conv_str", "n_kernels": 8, "kx": 3, "ky": 3,
+             "padding": "SAME", "learning_rate": 0.02},
+            {"type": "norm"},
+            {"type": "max_pooling", "kx": 4, "ky": 4},
+            {"type": "dropout", "dropout_ratio": 0.3},
+            {"type": "softmax", "output_sample_shape": 10,
+             "learning_rate": 0.02},
+        ],
+    })
+    from veles_tpu.samples import cifar
+    wf = cifar.train(fused=True)
+    losses = [m["train"]["loss"] for m in wf.decision.epoch_metrics]
+    assert losses[-1] < losses[0]
+    # eval path (validation) must be deterministic despite dropout:
+    val0 = wf.decision.epoch_metrics[0]["validation"]["loss"]
+    prng.reset(); prng.seed_all(42)
+    root.cifar.update({"decision": {"max_epochs": 1}})
+    wf2 = cifar.train(fused=True)
+    assert abs(wf2.decision.epoch_metrics[0]["validation"]["loss"] -
+               val0) < 1e-6
+
+
+def test_unit_mode_dropout_off_at_eval():
+    """Unit-mode eval minibatches must not apply dropout (fused parity)."""
+    prng.reset(); prng.seed_all(42)
+    root.cifar.update({
+        "loader": {"minibatch_size": 25, "n_train": 50, "n_valid": 50},
+        "decision": {"max_epochs": 1, "fail_iterations": 10},
+        "layers": [
+            {"type": "dropout", "dropout_ratio": 0.9},
+            {"type": "softmax", "output_sample_shape": 10,
+             "learning_rate": 0.0},
+        ],
+    })
+    from veles_tpu.samples import cifar
+    wf = cifar.build(fused=False)
+    wf.initialize()
+    wf.loader.run()                      # first VALID minibatch
+    assert wf.loader.minibatch_class == 1
+    wf.forwards[0].run()
+    # eval: identity, no mask applied even at ratio 0.9
+    numpy.testing.assert_array_equal(
+        numpy.asarray(wf.forwards[0].output.mem),
+        numpy.asarray(wf.loader.minibatch_data.mem))
+
+
+def test_epoch_scan_requires_and_accepts_rng_with_dropout():
+    import jax
+    prng.reset(); prng.seed_all(42)
+    root.cifar.update({
+        "loader": {"minibatch_size": 25, "n_train": 50, "n_valid": 25},
+        "decision": {"max_epochs": 1, "fail_iterations": 10},
+        "layers": [
+            {"type": "dropout", "dropout_ratio": 0.5},
+            {"type": "softmax", "output_sample_shape": 10,
+             "learning_rate": 0.01},
+        ],
+    })
+    from veles_tpu.samples import cifar
+    wf = cifar.build(fused=True)
+    wf.initialize()
+    runner = wf._fused_runner
+    train_epoch, _ = runner.epoch_fns()
+    loader = wf.loader
+    loader._plan_epoch()
+    idx = numpy.stack([c for cls, c, a in loader._order if cls == 2])
+    mask = numpy.stack([(numpy.arange(len(c)) < a).astype(numpy.float32)
+                        for cls, c, a in loader._order if cls == 2])
+    data = loader.original_data.devmem
+    labels = loader.original_labels.devmem
+    try:
+        train_epoch(runner.state, data, labels, idx, mask)
+        raise AssertionError("expected ValueError without rng")
+    except ValueError as e:
+        assert "stochastic" in str(e)
+    state, totals = train_epoch(runner.state, data, labels, idx, mask,
+                                jax.random.PRNGKey(0))
+    assert int(totals["n_err"]) >= 0

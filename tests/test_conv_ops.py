@@ -1,0 +1,217 @@
+"""Tier-2 conv-stack op tests vs independent numpy oracles (SURVEY §4)."""
+
+import numpy
+import pytest
+
+import jax
+
+from veles_tpu.ops import functional as F
+
+RTOL, ATOL = 5e-4, 1e-4
+
+
+def np_conv2d(x, w, stride=(1, 1), padding=(0, 0)):
+    """Direct-loop NHWC/HWIO convolution oracle."""
+    b, h, ww, cin = x.shape
+    kh, kw, _, cout = w.shape
+    ph, pw = padding
+    xp = numpy.pad(x, [(0, 0), (ph, ph), (pw, pw), (0, 0)])
+    oh = (h + 2 * ph - kh) // stride[0] + 1
+    ow = (ww + 2 * pw - kw) // stride[1] + 1
+    out = numpy.zeros((b, oh, ow, cout), numpy.float32)
+    for i in range(oh):
+        for j in range(ow):
+            patch = xp[:, i * stride[0]:i * stride[0] + kh,
+                       j * stride[1]:j * stride[1] + kw, :]
+            out[:, i, j, :] = numpy.tensordot(patch, w, axes=([1, 2, 3],
+                                                              [0, 1, 2]))
+    return out
+
+
+def test_conv2d_valid_matches_oracle():
+    rng = numpy.random.RandomState(1)
+    x = rng.randn(2, 8, 9, 3).astype(numpy.float32)
+    w = rng.randn(3, 3, 3, 5).astype(numpy.float32)
+    b = rng.randn(5).astype(numpy.float32)
+    got = numpy.asarray(F.conv2d_forward(x, w, b, (1, 1), "VALID"))
+    want = np_conv2d(x, w) + b
+    numpy.testing.assert_allclose(got, want, rtol=RTOL, atol=ATOL)
+
+
+def test_conv2d_int_padding_and_stride():
+    rng = numpy.random.RandomState(2)
+    x = rng.randn(2, 10, 10, 2).astype(numpy.float32)
+    w = rng.randn(5, 5, 2, 4).astype(numpy.float32)
+    got = numpy.asarray(F.conv2d_forward(x, w, None, (2, 2), 2))
+    want = np_conv2d(x, w, (2, 2), (2, 2))
+    numpy.testing.assert_allclose(got, want, rtol=RTOL, atol=ATOL)
+
+
+def test_conv2d_same_shape():
+    rng = numpy.random.RandomState(3)
+    x = rng.randn(1, 12, 12, 3).astype(numpy.float32)
+    w = rng.randn(5, 5, 3, 7).astype(numpy.float32)
+    y = F.conv2d_forward(x, w, None, (1, 1), "SAME")
+    assert y.shape == (1, 12, 12, 7)
+
+
+def test_conv_gradients_finite_differences():
+    rng = numpy.random.RandomState(4)
+    x = rng.randn(2, 6, 6, 2).astype(numpy.float32)
+    w = rng.randn(3, 3, 2, 3).astype(numpy.float32) * 0.3
+    b = rng.randn(3).astype(numpy.float32) * 0.1
+    r = rng.randn(2, 4, 4, 3).astype(numpy.float32)
+
+    def loss(x_, w_, b_):
+        return float((numpy.asarray(
+            F.conv2d_forward(x_, w_, b_, (1, 1), "VALID", "tanh")) * r).sum())
+
+    _, vjp = jax.vjp(
+        lambda x_, w_, b_: F.conv2d_forward(x_, w_, b_, (1, 1), "VALID",
+                                            "tanh"), x, w, b)
+    dx, dw, db = vjp(r)
+    eps = 1e-3
+    # spot-check a handful of coordinates of each gradient
+    rs = numpy.random.RandomState(0)
+    for arr, grad in ((x, dx), (w, dw), (b, db)):
+        flat = arr.reshape(-1)
+        gflat = numpy.asarray(grad).reshape(-1)
+        for _ in range(5):
+            i = rs.randint(flat.size)
+            old = flat[i]
+            flat[i] = old + eps
+            up = loss(x, w, b)
+            flat[i] = old - eps
+            down = loss(x, w, b)
+            flat[i] = old
+            num = (up - down) / (2 * eps)
+            assert abs(num - gflat[i]) < 5e-2 * max(1.0, abs(num)), \
+                (num, gflat[i])
+
+
+def _np_patches(x, window, stride, pad_value=0.0):
+    """Ceil-covering patches oracle (pads right/bottom like the reference)."""
+    b, h, w, c = x.shape
+    kh, kw = window
+
+    def ceil_out(size, k, s):
+        return 1 if size <= k else -(-(size - k) // s) + 1
+
+    oh, ow = ceil_out(h, kh, stride[0]), ceil_out(w, kw, stride[1])
+    ph = (oh - 1) * stride[0] + kh - h
+    pw = (ow - 1) * stride[1] + kw - w
+    xp = numpy.pad(x, [(0, 0), (0, ph), (0, pw), (0, 0)],
+                   constant_values=pad_value)
+    out = numpy.zeros((b, oh, ow, kh * kw, c), x.dtype)
+    for i in range(oh):
+        for j in range(ow):
+            patch = xp[:, i * stride[0]:i * stride[0] + kh,
+                       j * stride[1]:j * stride[1] + kw, :]
+            out[:, i, j] = patch.reshape(b, kh * kw, c)
+    return out
+
+
+@pytest.mark.parametrize("window,stride", [((2, 2), (2, 2)),
+                                           ((3, 3), (2, 2))])
+@pytest.mark.parametrize("size", [8, 7])   # 7 exercises ceil-pad tails
+def test_pooling_oracles(window, stride, size):
+    rng = numpy.random.RandomState(5)
+    x = rng.randn(2, size, size, 3).astype(numpy.float32)
+    patches_inf = _np_patches(x, window, stride,
+                              numpy.finfo(numpy.float32).min / 2)
+    patches_zero = _np_patches(x, window, stride, 0.0)
+    numpy.testing.assert_allclose(
+        numpy.asarray(F.max_pooling(x, window, stride)),
+        patches_inf.max(axis=3), rtol=RTOL, atol=ATOL)
+    numpy.testing.assert_allclose(
+        numpy.asarray(F.avg_pooling(x, window, stride)),
+        patches_zero.mean(axis=3), rtol=RTOL, atol=ATOL)
+    idx = numpy.abs(patches_zero).argmax(axis=3)
+    want = numpy.take_along_axis(patches_zero, idx[:, :, :, None, :],
+                                 axis=3)[:, :, :, 0, :]
+    numpy.testing.assert_allclose(
+        numpy.asarray(F.maxabs_pooling(x, window, stride)), want,
+        rtol=RTOL, atol=ATOL)
+
+
+def test_pooling_ceil_covers_whole_input():
+    """7x7 with 2x2/2 pooling -> 4x4 (reference ceil semantics), and the
+    last row/col contributes to the gradient."""
+    x = numpy.ones((1, 7, 7, 1), numpy.float32)
+    y = F.max_pooling(x, (2, 2), (2, 2))
+    assert y.shape == (1, 4, 4, 1)
+    _, vjp = jax.vjp(lambda a: F.max_pooling(a, (2, 2), (2, 2)), x)
+    (dx,) = vjp(numpy.ones((1, 4, 4, 1), numpy.float32))
+    assert numpy.asarray(dx)[0, 6, 6, 0] != 0 or \
+        numpy.asarray(dx)[0, 6, :, 0].sum() > 0
+
+
+def test_max_pooling_backward_scatters_to_argmax():
+    x = numpy.array([[[[1.0], [3.0]], [[2.0], [0.0]]]], numpy.float32)
+    _, vjp = jax.vjp(lambda a: F.max_pooling(a, (2, 2), (2, 2)), x)
+    (dx,) = vjp(numpy.ones((1, 1, 1, 1), numpy.float32))
+    want = numpy.array([[[[0.0], [1.0]], [[0.0], [0.0]]]], numpy.float32)
+    numpy.testing.assert_array_equal(numpy.asarray(dx), want)
+
+
+def test_avg_pooling_backward_spreads_uniformly():
+    x = numpy.ones((1, 2, 2, 1), numpy.float32)
+    _, vjp = jax.vjp(lambda a: F.avg_pooling(a, (2, 2), (2, 2)), x)
+    (dx,) = vjp(numpy.ones((1, 1, 1, 1), numpy.float32))
+    numpy.testing.assert_allclose(numpy.asarray(dx),
+                                  numpy.full((1, 2, 2, 1), 0.25))
+
+
+def test_lrn_oracle():
+    rng = numpy.random.RandomState(6)
+    x = rng.randn(2, 4, 4, 8).astype(numpy.float32)
+    alpha, beta, n, k = 1e-4, 0.75, 5, 2.0
+    got = numpy.asarray(F.lrn_forward(x, alpha, beta, n, k))
+    sq = x * x
+    want = numpy.zeros_like(x)
+    c = x.shape[-1]
+    for j in range(c):
+        lo, hi = max(0, j - n // 2), min(c, j + n // 2 + 1)
+        denom = (k + alpha / n * sq[..., lo:hi].sum(-1)) ** beta
+        want[..., j] = x[..., j] / denom
+    numpy.testing.assert_allclose(got, want, rtol=RTOL, atol=ATOL)
+
+
+def test_dropout_semantics():
+    rng = numpy.random.RandomState(7)
+    x = rng.randn(64, 100).astype(numpy.float32) + 5.0
+    key = jax.random.PRNGKey(0)
+    # eval / rate 0: identity
+    numpy.testing.assert_array_equal(
+        numpy.asarray(F.dropout(x, key, 0.5, False)), x)
+    numpy.testing.assert_array_equal(
+        numpy.asarray(F.dropout(x, key, 0.0, True)), x)
+    y = numpy.asarray(F.dropout(x, key, 0.5, True))
+    kept = y != 0
+    assert 0.35 < kept.mean() < 0.65          # ~half survive
+    numpy.testing.assert_allclose(y[kept], (x * 2.0)[kept], rtol=1e-6)
+    # same key -> identical mask (backward replay guarantee)
+    y2 = numpy.asarray(F.dropout(x, key, 0.5, True))
+    numpy.testing.assert_array_equal(y, y2)
+    # vjp: gradient flows only through kept elements, scaled
+    _, vjp = jax.vjp(lambda a: F.dropout(a, key, 0.5, True), x)
+    (dx,) = vjp(numpy.ones_like(x))
+    numpy.testing.assert_allclose(numpy.asarray(dx), kept * 2.0, rtol=1e-6)
+
+
+def test_cutter_crop_and_backward_pad():
+    from veles_tpu.ops.cutter import Cutter
+    from veles_tpu.workflow import Workflow
+    from veles_tpu.memory import Vector
+    wf = Workflow(None, name="wf")
+    cut = Cutter(wf, padding=(1, 2, 3, 1))   # left, top, right, bottom
+    x = numpy.arange(2 * 8 * 9 * 1, dtype=numpy.float32).reshape(2, 8, 9, 1)
+    cut.input = Vector(x)
+    cut.initialize()
+    cut.run()
+    got = cut.output.mem
+    numpy.testing.assert_array_equal(got, x[:, 2:7, 1:6, :])
+    _, vjp = jax.vjp(cut.transform, x)
+    (dx,) = vjp(numpy.ones_like(got))
+    assert dx.sum() == got.size
+    assert numpy.asarray(dx)[:, 0, :, :].sum() == 0   # cut rows got zeros

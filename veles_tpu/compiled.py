@@ -37,6 +37,8 @@ class FusedRunner:
         # aliases the loader's minibatch_data)
         from veles_tpu.ops.evaluator import EvaluatorMSE
         self._is_mse = isinstance(self.evaluator, EvaluatorMSE)
+        self._has_stochastic = any(getattr(f, "STOCHASTIC", False)
+                                   for f in self.forwards)
         # No donation in per-minibatch graph mode: the update is only
         # COMMITTED after Decision gates it (see FusedStep/FusedCommit), so
         # the previous state must stay alive.  The epoch-scan path donates.
@@ -45,9 +47,13 @@ class FusedRunner:
 
     # ----------------------------------------------------------------- state
     def _pull_state(self):
-        """Collect per-layer params/velocities from the unit Vectors."""
+        """Collect per-layer params/velocities from the unit Vectors
+        (weightless layers contribute an empty entry)."""
         state = []
         for fwd, gd in zip(self.forwards, self.gds):
+            if not fwd.has_params:
+                state.append({})
+                continue
             entry = {"w": fwd.weights.devmem,
                      "vw": gd.velocity_weights.devmem}
             if fwd.include_bias:
@@ -59,6 +65,8 @@ class FusedRunner:
     def sync_to_units(self):
         """Write fused state back into the unit Vectors (for snapshots)."""
         for entry, fwd, gd in zip(self.state, self.forwards, self.gds):
+            if not fwd.has_params:
+                continue
             fwd.weights.assign_device(entry["w"])
             gd.velocity_weights.assign_device(entry["vw"])
             if fwd.include_bias:
@@ -66,11 +74,15 @@ class FusedRunner:
                 gd.velocity_bias.assign_device(entry["vb"])
 
     # ----------------------------------------------------------------- steps
-    def _forward_chain(self, state, x):
+    def _layer_rng(self, rng, i):
+        import jax
+        return None if rng is None else jax.random.fold_in(rng, i)
+
+    def _forward_chain(self, state, x, rng=None, train=False):
         acts = [x]
         h = x
-        for fwd, entry in zip(self.forwards, state):
-            h = fwd.forward_fn(h, entry["w"], entry.get("b"))
+        for i, (fwd, entry) in enumerate(zip(self.forwards, state)):
+            h = fwd.apply_fused(h, entry, self._layer_rng(rng, i), train)
             acts.append(h)
         return acts
 
@@ -81,26 +93,20 @@ class FusedRunner:
         return self.evaluator.loss_fn(y, y_ref, mask)
 
     def _eval_step(self, state, x, y_ref, mask):
-        acts = self._forward_chain(state, x)
+        acts = self._forward_chain(state, x, rng=None, train=False)
         _, metrics = self._loss(acts[-1], y_ref, mask)
         return metrics
 
-    def _train_step(self, state, x, y_ref, mask, batch_size):
-        acts = self._forward_chain(state, x)
+    def _train_step(self, state, x, y_ref, mask, batch_size, rng=None):
+        acts = self._forward_chain(state, x, rng=rng, train=True)
         err, metrics = self._loss(acts[-1], y_ref, mask)
         new_state = list(state)
         for i in range(len(self.forwards) - 1, -1, -1):
             gd, entry = self.gds[i], state[i]
-            err_in, grad_w, grad_b = gd.backward_fn(
-                acts[i], acts[i + 1], err, entry["w"])
-            new_w, new_b, new_vw, new_vb = gd.update_fn(
-                entry["w"], entry.get("b"), entry["vw"], entry.get("vb"),
-                grad_w, grad_b, batch_size)
-            new_entry = {"w": new_w, "vw": new_vw}
-            if new_b is not None:
-                new_entry["b"] = new_b
-                new_entry["vb"] = new_vb
-            new_state[i] = new_entry
+            err_in, grads = gd.backward_fused(
+                acts[i], acts[i + 1], err, entry, self._layer_rng(rng, i))
+            if grads is not None:
+                new_state[i] = gd.update_fused(entry, grads, batch_size)
             err = err_in
         return new_state, metrics
 
@@ -109,21 +115,25 @@ class FusedRunner:
     # matrix with the dataset resident in HBM.  This is the pure TPU-native
     # steady state — zero host work between minibatches (the reference did
     # host scheduling + H2D upload per minibatch, SURVEY §3.1).
-    def _epoch_train(self, state, data, labels, idx, mask):
+    def _epoch_train(self, state, data, labels, idx, mask, rng=None):
         import jax
         import jax.numpy as jnp
 
         def body(carry, mb):
-            mb_idx, mb_mask = mb
+            step, mb_idx, mb_mask = mb
             x = jnp.take(data, mb_idx, axis=0)
             # labels doubles as the target array for MSE/AE workflows
             y = (jnp.take(labels, mb_idx, axis=0)
                  if labels is not None else x)
             bs = mb_mask.sum().astype(jnp.int32)
-            carry, metrics = self._train_step(carry, x, y, mb_mask, bs)
+            step_rng = (jax.random.fold_in(rng, step)
+                        if rng is not None else None)
+            carry, metrics = self._train_step(carry, x, y, mb_mask, bs,
+                                              step_rng)
             return carry, metrics
 
-        state, stacked = jax.lax.scan(body, state, (idx, mask))
+        steps = jnp.arange(idx.shape[0])
+        state, stacked = jax.lax.scan(body, state, (steps, idx, mask))
         totals = jax.tree.map(lambda m: m.sum(axis=0), stacked)
         return state, totals
 
@@ -144,11 +154,21 @@ class FusedRunner:
 
     def epoch_fns(self):
         """Jitted (train_epoch, eval_epoch): args (state, data, labels,
-        idx (B,mb) int32, mask (B,mb) f32); train donates state."""
+        idx (B,mb) int32, mask (B,mb) f32[, rng]); train donates state.
+        Networks with stochastic layers (dropout) MUST pass rng to
+        train_epoch — enforced with a clear error at call time."""
         import jax
         if not hasattr(self, "_epoch_train_jit"):
-            self._epoch_train_jit = jax.jit(self._epoch_train,
-                                            donate_argnums=(0,))
+            inner = jax.jit(self._epoch_train, donate_argnums=(0,))
+
+            def train_epoch(state, data, labels, idx, mask, rng=None):
+                if self._has_stochastic and rng is None:
+                    raise ValueError(
+                        "this network has stochastic layers (dropout): "
+                        "pass rng=jax.random.PRNGKey(...) to train_epoch")
+                return inner(state, data, labels, idx, mask, rng)
+
+            self._epoch_train_jit = train_epoch
             self._epoch_eval_jit = jax.jit(self._epoch_eval)
         return self._epoch_train_jit, self._epoch_eval_jit
 
@@ -207,9 +227,14 @@ class FusedStep(Unit):
         else:
             y_ref = labels
         if loader.minibatch_class == TRAIN:
+            if runner._has_stochastic:
+                from veles_tpu import prng
+                rng = prng.get("dropout").key()
+            else:
+                rng = None
             self.pending_state, metrics = runner._train(
                 runner.state, x, y_ref, mask,
-                jnp.asarray(loader.minibatch_size, jnp.int32))
+                jnp.asarray(loader.minibatch_size, jnp.int32), rng)
         else:
             self.pending_state = None
             metrics = runner._eval(runner.state, x, y_ref, mask)

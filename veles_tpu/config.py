@@ -75,6 +75,24 @@ class Config:
                 setattr(self, key, value)
         return self
 
+    def defaults(self, other):
+        """Like update(), but existing leaves win (config-file semantics:
+        defaults fill gaps, they never clobber earlier settings)."""
+        if isinstance(other, Config):
+            other = other.as_dict()
+        for key, value in other.items():
+            if isinstance(value, dict):
+                existing = self.__dict__.get(key)
+                if not isinstance(existing, Config):
+                    if key in self.__dict__:
+                        continue  # an explicit leaf shadows the subtree
+                    existing = Config("%s.%s" % (self.path, key))
+                    self.__dict__[key] = existing
+                existing.defaults(value)
+            elif key not in self.__dict__:
+                setattr(self, key, value)
+        return self
+
     def as_dict(self):
         out = {}
         for key, value in self.__dict__.items():

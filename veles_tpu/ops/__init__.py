@@ -9,4 +9,7 @@ role the reference's numpy backend played.
 
 # importing the op modules registers their layer types and forward↔gd pairs
 from veles_tpu.ops import all2all, gd  # noqa: F401,E402
+from veles_tpu.ops import conv, gd_conv  # noqa: F401,E402
+from veles_tpu.ops import pooling, activation  # noqa: F401,E402
+from veles_tpu.ops import normalization, dropout, cutter  # noqa: F401,E402
 

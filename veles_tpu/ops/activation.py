@@ -1,0 +1,75 @@
+"""Standalone activation units (forward/backward pairs).
+
+Ref: veles/znicz/activation.py::ForwardTanh/ForwardSigmoid/... and their
+backward halves [H] (SURVEY §2.3).  Same activation semantics as the fused
+dense/conv variants (``veles_tpu.ops.functional.activate``); backward is the
+vjp.
+"""
+
+from __future__ import annotations
+
+from veles_tpu.ops.nn_units import (TransformUnit, TransformGD,
+                                    register_layer_type, register_gd_for)
+from veles_tpu.ops import functional as F
+
+
+class ActivationBase(TransformUnit):
+    ACTIVATION = "linear"
+
+    def transform(self, x):
+        return F.activate(x, self.ACTIVATION)
+
+
+@register_layer_type("activation_tanh")
+class ForwardTanh(ActivationBase):
+    """LeCun-scaled tanh, standalone."""
+
+    ACTIVATION = "tanh"
+
+
+@register_layer_type("activation_sigmoid")
+class ForwardSigmoid(ActivationBase):
+    ACTIVATION = "sigmoid"
+
+
+@register_layer_type("activation_relu")
+class ForwardRELU(ActivationBase):
+    """Smooth relu log(1+exp(x)) — the reference's RELU."""
+
+    ACTIVATION = "relu"
+
+
+@register_layer_type("activation_str")
+class ForwardStrictRELU(ActivationBase):
+    ACTIVATION = "strict_relu"
+
+
+@register_layer_type("activation_log")
+class ForwardLog(ActivationBase):
+    """y = log(x + sqrt(x^2 + 1)) (asinh) — the reference's 'log' unit."""
+
+    def transform(self, x):
+        import jax.numpy as jnp
+        return jnp.arcsinh(x)
+
+
+@register_layer_type("activation_mul")
+class ForwardMul(ActivationBase):
+    """y = k * x elementwise scale."""
+
+    def __init__(self, workflow, factor=1.0, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.factor = float(factor)
+
+    def transform(self, x):
+        return x * self.factor
+
+
+@register_gd_for(ActivationBase)
+class BackwardActivation(TransformGD):
+    """vjp backward for every standalone activation (the reference shipped a
+    backward class per activation — BackwardTanh, BackwardRELU, ...)."""
+
+
+BackwardTanh = BackwardSigmoid = BackwardRELU = BackwardStrictRELU = \
+    BackwardLog = BackwardMul = BackwardActivation

@@ -1,0 +1,86 @@
+"""Convolution forward units.
+
+Ref: veles/znicz/conv.py::Conv/ConvTanh/ConvRELU/ConvStrictRELU [H]
+(SURVEY §2.3).  NHWC layout, HWIO weights; XLA lowers straight onto the MXU
+(the reference hand-tiled OpenCL kernels with BLOCK_SIZE defines — here the
+compiler owns tiling).
+"""
+
+from __future__ import annotations
+
+import numpy
+
+from veles_tpu.workflow import DeferredInitError
+from veles_tpu.ops.nn_units import ForwardBase, register_layer_type
+from veles_tpu.ops import functional as F
+
+
+class ConvBase(ForwardBase):
+    """Conv layer: config n_kernels, kx, ky, sliding (stride), padding."""
+
+    def __init__(self, workflow, n_kernels=32, kx=5, ky=5, sliding=(1, 1),
+                 padding="VALID", **kwargs):
+        kwargs.setdefault("output_sample_shape", ())
+        super().__init__(workflow, **kwargs)
+        self.n_kernels = int(n_kernels)
+        self.kx = int(kx)
+        self.ky = int(ky)
+        self.sliding = (sliding if isinstance(sliding, (tuple, list))
+                        else (sliding, sliding))
+        self.padding = padding
+
+    def initialize(self, device=None, **kwargs):
+        if not hasattr(self, "input") or self.input.is_empty:
+            raise DeferredInitError(self.name)
+        batch, in_h, in_w, in_c = self.input.shape
+        if self.weights.is_empty:
+            fan_in = self.ky * self.kx * in_c
+            fan_out = self.n_kernels
+            self.weights.reset(self._init_weights(
+                (self.ky, self.kx, in_c, self.n_kernels), fan_in, fan_out))
+            if self.include_bias:
+                self.bias.reset(numpy.zeros(self.n_kernels, self.dtype))
+        import jax
+        out = jax.eval_shape(
+            lambda a, w, b: F.conv2d_forward(a, w, b, self.sliding,
+                                             self.padding, self.ACTIVATION),
+            jax.ShapeDtypeStruct(self.input.shape, self.dtype),
+            jax.ShapeDtypeStruct(self.weights.shape, self.dtype),
+            jax.ShapeDtypeStruct((self.n_kernels,), self.dtype))
+        self.output_sample_shape = tuple(out.shape[1:])
+        self.output.reset(numpy.zeros(tuple(out.shape), self.dtype))
+        self._fwd = self.jit("fwd", self.forward_fn)
+        # skip ForwardBase.initialize's dense-specific weight init
+        from veles_tpu.accel import AcceleratedUnit
+        AcceleratedUnit.initialize(self, device=device, **kwargs)
+
+    def forward_fn(self, x, weights, bias):
+        return F.conv2d_forward(x, weights,
+                                bias if self.include_bias else None,
+                                self.sliding, self.padding, self.ACTIVATION)
+
+
+@register_layer_type("conv")
+class Conv(ConvBase):
+    ACTIVATION = "linear"
+
+
+@register_layer_type("conv_tanh")
+class ConvTanh(ConvBase):
+    """Conv + LeCun-scaled tanh."""
+
+    ACTIVATION = "tanh"
+
+
+@register_layer_type("conv_relu")
+class ConvRELU(ConvBase):
+    """Conv + smooth relu log(1+exp(z)) (the reference's RELU)."""
+
+    ACTIVATION = "relu"
+
+
+@register_layer_type("conv_str")
+class ConvStrictRELU(ConvBase):
+    """Conv + max(0, z)."""
+
+    ACTIVATION = "strict_relu"

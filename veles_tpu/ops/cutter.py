@@ -1,0 +1,28 @@
+"""Cutter — crops a spatial region out of NHWC activations.
+
+Ref: veles/znicz/cutter.py::Cutter [H] (SURVEY §2.3, utility units).
+Backward (vjp) pads the error back with zeros.
+"""
+
+from __future__ import annotations
+
+from veles_tpu.ops.nn_units import (TransformUnit, TransformGD,
+                                    register_layer_type, register_gd_for)
+
+
+@register_layer_type("cutter")
+class Cutter(TransformUnit):
+    def __init__(self, workflow, padding=(0, 0, 0, 0), **kwargs):
+        """padding: (left, top, right, bottom) amounts to cut away."""
+        super().__init__(workflow, **kwargs)
+        self.padding = tuple(padding)
+
+    def transform(self, x):
+        left, top, right, bottom = self.padding
+        h, w = x.shape[1], x.shape[2]
+        return x[:, top:h - bottom, left:w - right, :]
+
+
+@register_gd_for(Cutter)
+class GDCutter(TransformGD):
+    pass

@@ -1,0 +1,32 @@
+"""Dropout forward/backward.
+
+Ref: veles/znicz/dropout.py::DropoutForward/DropoutBackward [H]
+(SURVEY §2.3).  The reference generated a mask with in-kernel device RNG and
+replayed the stored mask in backward; TPU-native: a counter-based threefry
+key is used per minibatch, and the backward REGENERATES the identical mask
+from the same key (cheaper than an HBM mask round-trip; exact by
+construction).  Inverted scaling (x/keep) so eval is the identity.
+"""
+
+from __future__ import annotations
+
+from veles_tpu.ops.nn_units import (TransformUnit, TransformGD,
+                                    register_layer_type, register_gd_for)
+from veles_tpu.ops import functional as F
+
+
+@register_layer_type("dropout")
+class DropoutForward(TransformUnit):
+    STOCHASTIC = True
+
+    def __init__(self, workflow, dropout_ratio=0.5, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.dropout_ratio = float(dropout_ratio)
+
+    def transform(self, x, rng, train):
+        return F.dropout(x, rng, self.dropout_ratio, train)
+
+
+@register_gd_for(DropoutForward)
+class DropoutBackward(TransformGD):
+    """Mask replay via key regeneration (see module docstring)."""

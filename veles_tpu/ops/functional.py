@@ -183,6 +183,127 @@ def mse_loss(output, target, valid_mask):
     }
 
 
+# -------------------------------------------------------------- convolution
+def conv2d_forward(x, weights, bias, stride=(1, 1), padding="VALID",
+                   activation="linear"):
+    """2-D convolution, NHWC layout, weights HWIO (kh, kw, cin, cout).
+
+    NHWC/HWIO is the TPU-native layout (the reference's kernels were NCHW-ish
+    OpenCL — ref: veles/znicz/conv.py + ocl/conv.cl [H]); padding may be
+    "SAME", "VALID", or an int/pair of ints applied symmetrically.
+    """
+    if isinstance(padding, int):
+        padding = [(padding, padding), (padding, padding)]
+    elif (isinstance(padding, (tuple, list)) and len(padding) == 2
+          and all(isinstance(p, int) for p in padding)):
+        padding = [(padding[0], padding[0]), (padding[1], padding[1])]
+    z = jax.lax.conv_general_dilated(
+        x, weights, window_strides=tuple(stride), padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"), precision=_PRECISION)
+    if bias is not None:
+        z = z + bias
+    return activate(z, activation)
+
+
+# ------------------------------------------------------------------- pooling
+def _ceil_pad(size, k, s):
+    """Right-pad so every input element is covered (ceil semantics).
+
+    The reference's pooling ceil-covers the input (a 7x7 input with 2x2/2
+    pooling yields 4x4, not 3x3 — ref: veles/znicz/pooling.py [H]).
+    """
+    if size <= k:
+        return max(k - size, 0)
+    steps = -(-(size - k) // s)  # ceil division
+    return steps * s + k - size
+
+
+def _pool_patches(x, window, stride, pad_value):
+    """Extract pooling patches: (batch, oh, ow, kh*kw, c), ceil-padded.
+
+    Built on conv_general_dilated_patches; the patch axis ordering is
+    normalized so axis 3 enumerates the kh*kw window positions per channel.
+    """
+    b, h, w, c = x.shape
+    ph = _ceil_pad(h, window[0], stride[0])
+    pw = _ceil_pad(w, window[1], stride[1])
+    if ph or pw:
+        x = jnp.pad(x, [(0, 0), (0, ph), (0, pw), (0, 0)],
+                    constant_values=pad_value)
+    patches = jax.lax.conv_general_dilated_patches(
+        x, filter_shape=tuple(window), window_strides=tuple(stride),
+        padding="VALID", dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    oh, ow = patches.shape[1], patches.shape[2]
+    # features come out channel-major: (c, kh*kw)
+    patches = patches.reshape(b, oh, ow, c, window[0] * window[1])
+    return jnp.moveaxis(patches, 3, 4), oh, ow  # -> (b, oh, ow, kh*kw, c)
+
+
+def max_pooling(x, window=(2, 2), stride=None):
+    """Max pooling; backward (vjp) scatters to the argmax — the same
+    record-argmax-offsets scheme the reference's kernels used (ref:
+    veles/znicz/pooling.py::MaxPooling, gd_pooling.py [H])."""
+    stride = stride or window
+    # finite lowest value, not -inf: the patch extractor is conv-based and
+    # -inf * 0 would poison the padding with NaNs
+    lowest = float(jnp.finfo(x.dtype).min) / 2
+    patches, oh, ow = _pool_patches(x, window, stride, lowest)
+    idx = jnp.argmax(patches, axis=3, keepdims=True)
+    return jnp.take_along_axis(patches, idx, axis=3)[:, :, :, 0, :]
+
+
+def maxabs_pooling(x, window=(2, 2), stride=None):
+    """Max-absolute-value pooling (signed value of the abs-max element).
+
+    Ref: veles/znicz/pooling.py::MaxAbsPooling [H].  Tail windows are
+    zero-padded (|0| never wins unless the whole window is padding).
+    """
+    stride = stride or window
+    patches, oh, ow = _pool_patches(x, window, stride, 0.0)
+    idx = jnp.argmax(jnp.abs(patches), axis=3, keepdims=True)
+    return jnp.take_along_axis(patches, idx, axis=3)[:, :, :, 0, :]
+
+
+def avg_pooling(x, window=(2, 2), stride=None):
+    """Average pooling; tail windows are zero-padded and divided by the FULL
+    window size (include-pad semantics, matching Caffe-era references)."""
+    stride = stride or window
+    patches, oh, ow = _pool_patches(x, window, stride, 0.0)
+    return patches.mean(axis=3)
+
+
+# ------------------------------------------------- local response norm (LRN)
+def lrn_forward(x, alpha=1e-4, beta=0.75, n=5, k=2.0):
+    """AlexNet cross-channel local response normalization.
+
+    y = x / (k + alpha/n * sum_{j in window(n)} x_j^2)^beta over the channel
+    axis.  Ref: veles/znicz/normalization.py::LRNormalizerForward [H].
+    """
+    c = x.shape[-1]
+    sq = x * x
+    half = n // 2
+    padded = jnp.pad(sq, [(0, 0)] * (x.ndim - 1) + [(half, half)])
+    # windowed channel sum via cumulative sums (O(c), no conv needed)
+    csum = jnp.cumsum(padded, axis=-1)
+    csum = jnp.pad(csum, [(0, 0)] * (x.ndim - 1) + [(1, 0)])
+    window_sums = jax.lax.slice_in_dim(csum, n, n + c, axis=-1) - \
+        jax.lax.slice_in_dim(csum, 0, c, axis=-1)
+    denom = (k + (alpha / n) * window_sums) ** beta
+    return x / denom
+
+
+# ------------------------------------------------------------------- dropout
+def dropout(x, rng, rate, train):
+    """Inverted Bernoulli dropout; mask regenerated from the same counter key
+    in backward (the reference stored and replayed the mask — ref:
+    veles/znicz/dropout.py [H]; a counter-based key replay is the TPU way)."""
+    if not train or rate <= 0.0:
+        return x
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(rng, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+
 # ------------------------------------------------------------------- updates
 def sgd_update(param, velocity, grad, batch_size, learning_rate, momentum,
                weight_decay, l1_vs_l2, gradient_clip):

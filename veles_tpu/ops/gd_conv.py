@@ -1,0 +1,50 @@
+"""Gradient units for convolution.
+
+Ref: veles/znicz/gd_conv.py::GradientDescentConv/GDTanhConv/GDRELUConv [H]
+(SURVEY §2.3).  The backward is the exact vjp of the forward (including the
+fused activation), which XLA lowers to transposed/dilated convolutions —
+the same math the reference's hand-written grad-wrt-input / grad-wrt-weights
+kernels computed.
+"""
+
+from __future__ import annotations
+
+from veles_tpu.ops.nn_units import GradientDescentBase, register_gd_for
+from veles_tpu.ops import conv
+
+
+class GradientDescentConvBase(GradientDescentBase):
+    def backward_fn(self, x, y, err_output, weights, bias=None):
+        import jax
+        fwd = self.forward
+        if fwd.include_bias:
+            _, vjp = jax.vjp(fwd.forward_fn, x, weights, bias)
+            err_in, grad_w, grad_b = vjp(err_output.reshape(y.shape))
+        else:
+            _, vjp = jax.vjp(lambda x_, w_: fwd.forward_fn(x_, w_, None),
+                             x, weights)
+            err_in, grad_w = vjp(err_output.reshape(y.shape))
+            grad_b = None
+        if not self.need_err_input:
+            err_in = None
+        return err_in, grad_w, grad_b
+
+
+@register_gd_for(conv.Conv)
+class GradientDescentConv(GradientDescentConvBase):
+    pass
+
+
+@register_gd_for(conv.ConvTanh)
+class GDTanhConv(GradientDescentConvBase):
+    pass
+
+
+@register_gd_for(conv.ConvRELU)
+class GDRELUConv(GradientDescentConvBase):
+    pass
+
+
+@register_gd_for(conv.ConvStrictRELU)
+class GDStrictRELUConv(GradientDescentConvBase):
+    pass

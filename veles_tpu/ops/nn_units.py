@@ -145,16 +145,148 @@ class ForwardBase(AcceleratedUnit):
         super().initialize(device=device, **kwargs)
 
     # -- compute -------------------------------------------------------------
+    has_params = True
+    STOCHASTIC = False
+
     def forward_fn(self, x, weights, bias):
         """The pure forward function (composed by the fused step builder)."""
         y = F.dense_forward(x, weights, bias if self.include_bias else None,
                             self.ACTIVATION)
         return y.reshape((x.shape[0],) + tuple(self.output_sample_shape))
 
+    def apply_fused(self, x, entry, rng, train):
+        """Uniform fused-chain hook: entry is this layer's param dict."""
+        return self.forward_fn(x, entry.get("w"), entry.get("b"))
+
     def run(self):
         self.output.assign_device(self._fwd(
             self.input.devmem, self.weights.devmem,
             self.bias.devmem if self.include_bias else None))
+
+
+class TransformUnit(AcceleratedUnit):
+    """Weightless forward unit: output = transform(input).
+
+    Base for pooling, standalone activations, LRN, dropout, cutter — the
+    reference's parameterless accelerated units (ref: veles/znicz/
+    pooling.py, activation.py, normalization.py, dropout.py [H]).  Their
+    backward is the exact vjp of ``transform`` (the TPU-native equivalent of
+    the reference's hand-written backward kernels — e.g. max-pooling's
+    scatter-to-argmax IS the vjp of gather-by-argmax).
+    """
+
+    has_params = False
+    STOCHASTIC = False   # True -> transform receives (rng, train)
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.output = Vector()
+
+    def transform(self, x):
+        raise NotImplementedError
+
+    def infer_output_shape(self, input_shape):
+        """Sample-shape inference used by initialize (eval_shape based)."""
+        import jax
+        spec = jax.ShapeDtypeStruct(input_shape, self.dtype)
+        if self.STOCHASTIC:
+            out = jax.eval_shape(lambda a: self.transform(a, None, False),
+                                 spec)
+        else:
+            out = jax.eval_shape(self.transform, spec)
+        return tuple(out.shape)
+
+    def initialize(self, device=None, **kwargs):
+        if not hasattr(self, "input") or self.input.is_empty:
+            raise DeferredInitError(self.name)
+        out_shape = self.infer_output_shape(self.input.shape)
+        self.output.reset(numpy.zeros(out_shape, self.dtype))
+        self.output_sample_shape = out_shape[1:]
+        super().initialize(device=device, **kwargs)
+
+    def apply_fused(self, x, entry, rng, train):
+        if self.STOCHASTIC:
+            return self.transform(x, rng, train)
+        return self.transform(x)
+
+    def _in_training_minibatch(self):
+        """Unit-mode train/eval detection via the loader's current class."""
+        from veles_tpu.loader.base import TRAIN
+        loader = getattr(self.workflow, "loader", None)
+        return loader is None or loader.minibatch_class == TRAIN
+
+    def run(self):
+        if self.STOCHASTIC:
+            if self._in_training_minibatch():
+                from veles_tpu import prng
+                self._last_rng = prng.get("dropout").key()
+                fn = self.jit("fwd_s",
+                              lambda x, k: self.transform(x, k, True))
+                self.output.assign_device(fn(self.input.devmem,
+                                             self._last_rng))
+            else:
+                self._last_rng = None
+                fn = self.jit("fwd_e",
+                              lambda x: self.transform(x, None, False))
+                self.output.assign_device(fn(self.input.devmem))
+        else:
+            fn = self.jit("fwd", self.transform)
+            self.output.assign_device(fn(self.input.devmem))
+
+
+class TransformGD(AcceleratedUnit):
+    """Backward for a TransformUnit: err_input = vjp(transform)(err_output).
+
+    One generic class serves every weightless op (the reference needed a
+    hand-written GD kernel per op — gd_pooling.py, activation.py backward
+    halves, etc.).
+    """
+
+    has_params = False
+
+    def __init__(self, workflow, forward=None, need_err_input=True, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.forward = forward
+        self.need_err_input = need_err_input
+        self.err_input = Vector()
+        if forward is not None:
+            self.link_attrs(forward, "input", "output")
+
+    def initialize(self, device=None, **kwargs):
+        if self.forward is None or not self.forward.is_initialized:
+            raise DeferredInitError(self.name)
+        super().initialize(device=device, **kwargs)
+
+    def backward_fused(self, x, y, err_output, entry, rng):
+        import jax
+        fwd = self.forward
+        if fwd.STOCHASTIC:
+            _, vjp = jax.vjp(lambda a: fwd.transform(a, rng, True), x)
+        else:
+            _, vjp = jax.vjp(fwd.transform, x)
+        return vjp(err_output.reshape(y.shape))[0], None
+
+    def run(self):
+        import jax
+        fwd = self.forward
+        if not self.need_err_input:
+            return  # nothing downstream consumes err_input; skip the vjp
+
+        if fwd.STOCHASTIC:
+            def bwd(x, err, rng):
+                _, vjp = jax.vjp(lambda a: fwd.transform(a, rng, True), x)
+                return vjp(err.reshape(
+                    (x.shape[0],) + fwd.output_sample_shape))[0]
+            err_in = self.jit("bwd_s", bwd)(
+                self.input.devmem, self.err_output.devmem, fwd._last_rng)
+        else:
+            def bwd(x, err):
+                _, vjp = jax.vjp(fwd.transform, x)
+                return vjp(err.reshape(
+                    (x.shape[0],) + fwd.output_sample_shape))[0]
+            err_in = self.jit("bwd", bwd)(self.input.devmem,
+                                          self.err_output.devmem)
+        self.err_input.assign_device(err_in)
 
 
 class GradientDescentBase(AcceleratedUnit):
@@ -208,13 +340,36 @@ class GradientDescentBase(AcceleratedUnit):
         super().initialize(device=device, **kwargs)
 
     # -- pure functions ------------------------------------------------------
-    def backward_fn(self, x, y, err_output, weights):
-        """(err_input, grad_weights, grad_bias) — pure, composed when fused."""
+    def backward_fn(self, x, y, err_output, weights, bias=None):
+        """(err_input, grad_weights, grad_bias) — pure, composed when fused.
+
+        ``bias`` is part of the signature because some backwards (conv via
+        vjp) re-run the forward; dense ignores it.
+        """
         return F.dense_backward(
             x, y.reshape(y.shape[0], -1),
             err_output.reshape(err_output.shape[0], -1), weights,
             self.forward.ACTIVATION, self.forward.include_bias,
             self.need_err_input)
+
+    has_params = True
+
+    def backward_fused(self, x, y, err_output, entry, rng):
+        """(err_input, grads) for the fused chain; grads None if weightless."""
+        err_in, grad_w, grad_b = self.backward_fn(x, y, err_output,
+                                                  entry["w"], entry.get("b"))
+        return err_in, (grad_w, grad_b)
+
+    def update_fused(self, entry, grads, batch_size):
+        grad_w, grad_b = grads
+        new_w, new_b, new_vw, new_vb = self.update_fn(
+            entry["w"], entry.get("b"), entry["vw"], entry.get("vb"),
+            grad_w, grad_b, batch_size)
+        new_entry = {"w": new_w, "vw": new_vw}
+        if new_b is not None:
+            new_entry["b"] = new_b
+            new_entry["vb"] = new_vb
+        return new_entry
 
     def update_fn(self, weights, bias, vel_w, vel_b, grad_w, grad_b,
                   batch_size):
@@ -235,7 +390,8 @@ class GradientDescentBase(AcceleratedUnit):
         fwd = self.forward
         err_in, grad_w, grad_b = self._bwd(
             self.input.devmem, self.output.devmem, self.err_output.devmem,
-            self.weights.devmem)
+            self.weights.devmem,
+            fwd.bias.devmem if fwd.include_bias else None)
         if self.need_err_input:
             self.err_input.assign_device(err_in)
         new_w, new_b, new_vw, new_vb = self._upd(

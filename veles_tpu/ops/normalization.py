@@ -1,0 +1,31 @@
+"""Local response normalization (AlexNet LRN).
+
+Ref: veles/znicz/normalization.py::LRNormalizerForward/LRNormalizerBackward
+[H] (SURVEY §2.3).
+"""
+
+from __future__ import annotations
+
+from veles_tpu.ops.nn_units import (TransformUnit, TransformGD,
+                                    register_layer_type, register_gd_for)
+from veles_tpu.ops import functional as F
+
+
+@register_layer_type("norm")
+class LRNormalizerForward(TransformUnit):
+    """Cross-channel LRN with the reference's default hyperparameters."""
+
+    def __init__(self, workflow, alpha=1e-4, beta=0.75, n=5, k=2.0, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.alpha = float(alpha)
+        self.beta = float(beta)
+        self.n = int(n)
+        self.k = float(k)
+
+    def transform(self, x):
+        return F.lrn_forward(x, self.alpha, self.beta, self.n, self.k)
+
+
+@register_gd_for(LRNormalizerForward)
+class LRNormalizerBackward(TransformGD):
+    """vjp backward (the reference derived the quotient-rule kernel)."""

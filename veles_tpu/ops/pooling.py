@@ -1,0 +1,66 @@
+"""Pooling units (max / avg / max-abs) and their gradients.
+
+Ref: veles/znicz/pooling.py::MaxPooling/AvgPooling/MaxAbsPooling and
+gd_pooling.py::GDMaxPooling/GDAvgPooling [H] (SURVEY §2.3).  The backward is
+the vjp of the forward: for max variants that is exactly the reference's
+"record argmax offsets, scatter err" scheme (argmax is recomputed from the
+forward input rather than stored — on TPU recompute is cheaper than an HBM
+round-trip, and in fused mode XLA CSEs it with the forward pass).
+"""
+
+from __future__ import annotations
+
+from veles_tpu.ops.nn_units import (TransformUnit, TransformGD,
+                                    register_layer_type, register_gd_for)
+from veles_tpu.ops import functional as F
+
+
+class PoolingBase(TransformUnit):
+    """Config: kx, ky (window), sliding (stride, defaults to the window)."""
+
+    def __init__(self, workflow, kx=2, ky=2, sliding=None, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.kx = int(kx)
+        self.ky = int(ky)
+        if sliding is None:
+            sliding = (self.ky, self.kx)
+        self.sliding = (sliding if isinstance(sliding, (tuple, list))
+                        else (sliding, sliding))
+
+    @property
+    def window(self):
+        return (self.ky, self.kx)
+
+
+@register_layer_type("max_pooling")
+class MaxPooling(PoolingBase):
+    def transform(self, x):
+        return F.max_pooling(x, self.window, self.sliding)
+
+
+@register_layer_type("maxabs_pooling")
+class MaxAbsPooling(PoolingBase):
+    def transform(self, x):
+        return F.maxabs_pooling(x, self.window, self.sliding)
+
+
+@register_layer_type("avg_pooling")
+class AvgPooling(PoolingBase):
+    def transform(self, x):
+        return F.avg_pooling(x, self.window, self.sliding)
+
+
+@register_gd_for(PoolingBase)
+class GDPooling(TransformGD):
+    """One backward class for every pooling flavor (vjp of the forward).
+
+    Ref names kept for parity: GDMaxPooling/GDAvgPooling below are aliases.
+    """
+
+
+class GDMaxPooling(GDPooling):
+    pass
+
+
+class GDAvgPooling(GDPooling):
+    pass

@@ -2,5 +2,56 @@
 
 Each sample module defines a Workflow subclass plus a ``run(load, main)``
 entry point called by the CLI (ref convention: SURVEY §3.1), and a direct
-``train(...)`` helper usable from code and tests.
+``train(...)`` helper usable from code and tests.  The shared config→workflow
+wiring lives in :func:`make_sample`.
 """
+
+from veles_tpu.config import root, get
+
+
+def make_sample(config_name, workflow_cls, loader_cls, default_config,
+                loss_function="softmax"):
+    """Standard sample scaffolding: returns (build, train, run).
+
+    ``config_name`` is the node under ``root`` (e.g. "mnist");
+    ``default_config()`` installs defaults (with defaults() semantics so user
+    config set beforehand wins).
+    """
+
+    def _config():
+        cfg = getattr(root, config_name)
+        if "layers" not in cfg:
+            default_config()
+            cfg = getattr(root, config_name)
+        return cfg
+
+    def build(fused=True, **overrides):
+        cfg = _config()
+        loader_cfg = {k: get(v, v) for k, v in cfg.loader.items()}
+        loader_cfg.update(overrides.pop("loader", {}))
+        decision_cfg = {k: get(v, v) for k, v in cfg.decision.items()}
+        decision_cfg.update(overrides.pop("decision", {}))
+        return workflow_cls(
+            None, name=config_name,
+            loader_factory=loader_cls, loader_config=loader_cfg,
+            layers=get(cfg.layers, cfg.layers),
+            decision_config=decision_cfg,
+            loss_function=loss_function, fused=fused, **overrides)
+
+    def train(fused=True, **overrides):
+        wf = build(fused=fused, **overrides)
+        wf.initialize()
+        wf.run()
+        return wf
+
+    def run(load, main):
+        cfg = _config()
+        load(workflow_cls,
+             loader_factory=loader_cls,
+             loader_config={k: get(v, v) for k, v in cfg.loader.items()},
+             layers=get(cfg.layers, cfg.layers),
+             decision_config={k: get(v, v) for k, v in cfg.decision.items()},
+             loss_function=loss_function)
+        main()
+
+    return build, train, run

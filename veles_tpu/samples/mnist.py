@@ -109,7 +109,7 @@ class MnistWorkflow(StandardWorkflow):
 def default_config():
     """Install the sample's defaults into ``root.mnist`` (config-file role,
     ref: veles/znicz/samples/MNIST/mnist_config.py [H])."""
-    root.mnist.update({
+    root.mnist.defaults({
         "loader": {"minibatch_size": 100, "n_train": 60000, "n_valid": 10000},
         "decision": {"max_epochs": 10, "fail_iterations": 50},
         "layers": [
@@ -122,40 +122,7 @@ def default_config():
     return root.mnist
 
 
-def build(fused=True, **overrides):
-    """Construct the workflow from ``root.mnist`` (tests & CLI both use this)."""
-    cfg = root.mnist
-    if "layers" not in cfg:
-        default_config()
-        cfg = root.mnist
-    loader_cfg = {k: get(v, v) for k, v in cfg.loader.items()}
-    loader_cfg.update(overrides.pop("loader", {}))
-    decision_cfg = {k: get(v, v) for k, v in cfg.decision.items()}
-    decision_cfg.update(overrides.pop("decision", {}))
-    return MnistWorkflow(
-        None, name="mnist",
-        loader_factory=MnistLoader, loader_config=loader_cfg,
-        layers=get(cfg.layers, cfg.layers), decision_config=decision_cfg,
-        loss_function="softmax", fused=fused, **overrides)
+from veles_tpu.samples import make_sample  # noqa: E402
 
-
-def train(fused=True, **overrides):
-    """Build, initialize, run; returns the finished workflow."""
-    wf = build(fused=fused, **overrides)
-    wf.initialize()
-    wf.run()
-    return wf
-
-
-def run(load, main):
-    """CLI entry point (reference convention, SURVEY §3.1)."""
-    if "layers" not in root.mnist:
-        default_config()
-    cfg = root.mnist
-    load(MnistWorkflow,
-         loader_factory=MnistLoader,
-         loader_config={k: get(v, v) for k, v in cfg.loader.items()},
-         layers=get(cfg.layers, cfg.layers),
-         decision_config={k: get(v, v) for k, v in cfg.decision.items()},
-         loss_function="softmax")
-    main()
+build, train, run = make_sample("mnist", MnistWorkflow, MnistLoader,
+                                default_config)

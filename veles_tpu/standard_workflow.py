@@ -28,28 +28,60 @@ from veles_tpu.ops.nn_units import NNWorkflow, LAYER_TYPES, gd_class_for
 from veles_tpu.ops.evaluator import EvaluatorSoftmax, EvaluatorMSE
 from veles_tpu.ops.decision import DecisionGD, DecisionMSE
 
-# keys routed to the forward unit when given flat in a layer dict
-_FWD_KEYS = {"output_sample_shape", "weights_filling", "weights_stddev",
-             "include_bias", "dtype"}
-# keys routed to the gradient unit
-_GD_KEYS = {"learning_rate", "learning_rate_bias", "momentum", "weight_decay",
-            "weight_decay_bias", "l1_vs_l2", "gradient_clip"}
+import inspect
+
+# keys that are never user-routable (wired by the builder itself)
+_RESERVED = {"self", "workflow", "forward", "need_err_input", "name"}
+
+
+def _accepted_keys(cls):
+    """Config keys a unit class accepts, from its __init__ chain."""
+    keys = set()
+    for klass in cls.__mro__:
+        if klass is object:
+            break
+        init = klass.__dict__.get("__init__")
+        if init is None:
+            continue
+        for pname, param in inspect.signature(init).parameters.items():
+            if pname in _RESERVED or param.kind in (
+                    param.VAR_KEYWORD, param.VAR_POSITIONAL):
+                continue
+            keys.add(pname)
+    return keys
 
 
 def parse_layer(layer):
-    """Split one layer config dict into (type, fwd_kwargs, gd_kwargs)."""
+    """Split one layer config dict into (type, fwd_kwargs, gd_kwargs).
+
+    Flat keys are routed by introspecting which unit class accepts them
+    (forward wins ties); explicit "->"/"<-" sub-dicts bypass routing — the
+    reference's layer config shape (ref: veles/znicz/standard_workflow.py
+    [H]).
+    """
+    from veles_tpu.ops.nn_units import LAYER_TYPES, gd_class_for
     layer = dict(layer)
     kind = layer.pop("type")
+    cls = LAYER_TYPES.get(kind)
+    if cls is None:
+        raise ValueError("unknown layer type %r (known: %s)" %
+                         (kind, ", ".join(sorted(LAYER_TYPES))))
     fwd = dict(layer.pop("->", {}))
     gd = dict(layer.pop("<-", {}))
+    fwd_keys = _accepted_keys(cls)
+    gd_keys = _accepted_keys(gd_class_for(cls))
     for key, value in layer.items():
-        if key in _FWD_KEYS:
+        if key in fwd_keys:
             fwd[key] = get(value, value)
-        elif key in _GD_KEYS:
+        elif key in gd_keys:
             gd[key] = get(value, value)
         else:
-            raise ValueError("unknown layer config key %r" % key)
-    return kind, fwd, gd
+            raise ValueError(
+                "layer type %r does not accept config key %r "
+                "(forward keys: %s; gd keys: %s)" %
+                (kind, key, ", ".join(sorted(fwd_keys)),
+                 ", ".join(sorted(gd_keys - fwd_keys))))
+    return kind, cls, fwd, gd
 
 
 class StandardWorkflowBase(NNWorkflow):
@@ -84,11 +116,7 @@ class StandardWorkflowBase(NNWorkflow):
     def link_forwards(self):
         prev = None
         for layer in self.layers_config:
-            kind, fwd_kwargs, _ = parse_layer(layer)
-            cls = LAYER_TYPES.get(kind)
-            if cls is None:
-                raise ValueError("unknown layer type %r (known: %s)" %
-                                 (kind, ", ".join(sorted(LAYER_TYPES))))
+            kind, cls, fwd_kwargs, _ = parse_layer(layer)
             unit = cls(self, **fwd_kwargs)
             if prev is None:
                 unit.link_from(self.loader)
@@ -128,7 +156,7 @@ class StandardWorkflowBase(NNWorkflow):
         """Backward chain in reverse layer order, closing the cycle."""
         prev_gd = None
         for fwd in reversed(self.forwards):
-            _, _, gd_kwargs = parse_layer(
+            _, _, _, gd_kwargs = parse_layer(
                 self.layers_config[self.forwards.index(fwd)])
             gd_cls = gd_class_for(fwd)
             gd = gd_cls(self, forward=fwd,
