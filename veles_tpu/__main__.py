@@ -1,0 +1,191 @@
+"""CLI entry point: ``python -m veles_tpu <workflow> [<config>] [flags]``.
+
+Ref: veles/__main__.py::Main + scripts/velescli.py [H] (SURVEY §2.1, §3.1).
+Reference ergonomics preserved:
+
+- ``<workflow>`` is a Python file or a dotted module (e.g.
+  ``veles_tpu.samples.mnist``) exposing ``run(load, main)``;
+- ``<config>`` is a Python file executed against the global ``root`` tree;
+- any argument of the form ``root.a.b=value`` overrides a config leaf;
+- ``--random-seed`` seeds every named PRNG stream;
+- ``--snapshot`` resumes from a snapshot file;
+- ``-d/--device`` picks the backend (tpu/cpu) — the reference's
+  OpenCL/CUDA/numpy selection collapsed onto JAX platforms.
+
+The master/slave flags of the reference became ``--distributed`` (SPMD over
+``jax.distributed``; see veles_tpu/launcher.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import importlib.util
+import json
+import os
+import sys
+
+
+def build_argparser():
+    parser = argparse.ArgumentParser(
+        prog="veles_tpu",
+        description="TPU-native dataflow ML framework "
+                    "(capability parity with VELES)")
+    parser.add_argument("workflow",
+                        help="workflow .py file or dotted module with "
+                             "run(load, main)")
+    parser.add_argument("config", nargs="?", default=None,
+                        help="config .py file executed against `root`")
+    parser.add_argument("overrides", nargs="*", metavar="root.a.b=value",
+                        help="config leaf overrides")
+    parser.add_argument("--random-seed", type=int, default=None,
+                        help="seed every named PRNG stream")
+    parser.add_argument("-s", "--snapshot", default=None,
+                        help="resume from this snapshot file")
+    parser.add_argument("-d", "--device", default=None,
+                        choices=("tpu", "cpu"),
+                        help="JAX platform to run on (default: auto)")
+    parser.add_argument("--no-fused", action="store_true",
+                        help="run the unit graph without the fused "
+                             "compiled step (debugging)")
+    parser.add_argument("--distributed", action="store_true",
+                        help="join a multi-host SPMD run "
+                             "(jax.distributed.initialize)")
+    parser.add_argument("--coordinator-address", default=None)
+    parser.add_argument("--num-processes", type=int, default=None)
+    parser.add_argument("--process-id", type=int, default=None)
+    parser.add_argument("--snapshot-dir", default=None,
+                        help="enable periodic snapshotting into this dir")
+    parser.add_argument("--snapshot-interval", type=int, default=1)
+    parser.add_argument("--snapshot-compression", default="gz",
+                        choices=("", "gz", "bz2", "xz"))
+    parser.add_argument("--result-file", default=None,
+                        help="write a JSON run summary here")
+    parser.add_argument("--dump-config", action="store_true",
+                        help="print the effective config tree and exit")
+    parser.add_argument("--graph", default=None, metavar="FILE.dot",
+                        help="write the unit graph as graphviz dot")
+    parser.add_argument("--no-stats", action="store_true",
+                        help="skip the per-unit run-time table")
+    parser.add_argument("--optimize", default=None, metavar="GENERATIONS",
+                        help="genetic hyperparameter search over Tune() "
+                             "leaves: '<generations>' or "
+                             "'<generations>:<population>'")
+    parser.add_argument("--list-units", action="store_true",
+                        help="list registered unit classes and exit")
+    return parser
+
+
+def load_workflow_module(spec):
+    """Import the workflow module from a file path or dotted name."""
+    if spec.endswith(".py") or os.path.sep in spec:
+        name = os.path.splitext(os.path.basename(spec))[0]
+        mod_spec = importlib.util.spec_from_file_location(name, spec)
+        if mod_spec is None:
+            raise ImportError("cannot load workflow file %r" % spec)
+        module = importlib.util.module_from_spec(mod_spec)
+        sys.modules[name] = module
+        mod_spec.loader.exec_module(module)
+        return module
+    return importlib.import_module(spec)
+
+
+def exec_config_file(path):
+    """Execute a config file against the global root (reference semantics)."""
+    from veles_tpu.config import root, Tune
+    namespace = {"root": root, "Tune": Tune, "__file__": path}
+    with open(path, "r", encoding="utf-8") as f:
+        code = compile(f.read(), path, "exec")
+    exec(code, namespace)
+
+
+def main(argv=None):
+    parser = build_argparser()
+    args = parser.parse_args(argv)
+
+    if args.device:
+        # must win before the first jax import; a sitecustomize may force a
+        # plugin platform, so also set the config knob once jax loads
+        os.environ["JAX_PLATFORMS"] = args.device
+        import jax
+        jax.config.update("jax_platforms", args.device)
+
+    if args.list_units:
+        from veles_tpu.units import UnitRegistry
+        import veles_tpu.ops  # noqa: F401 — populate the registry
+        for name in sorted(UnitRegistry.units):
+            print(name)
+        return 0
+
+    from veles_tpu import prng
+    from veles_tpu.config import root, parse_override
+    from veles_tpu.launcher import Launcher
+
+    if args.random_seed is not None:
+        prng.seed_all(args.random_seed)
+
+    # tolerate overrides being swallowed into `config` when no config file
+    overrides = list(args.overrides)
+    if args.config and "=" in args.config and not os.path.exists(args.config):
+        overrides.insert(0, args.config)
+        args.config = None
+    if args.config:
+        exec_config_file(args.config)
+    for token in overrides:
+        parse_override(token)
+
+    if args.dump_config:
+        root.print_()
+        return 0
+
+    module = load_workflow_module(args.workflow)
+    if not hasattr(module, "run"):
+        raise SystemExit("workflow module %r has no run(load, main)"
+                         % args.workflow)
+
+    if args.optimize:
+        try:
+            from veles_tpu.genetics import optimize_cli
+        except ImportError as e:
+            raise SystemExit("--optimize requires veles_tpu.genetics: %s" % e)
+        return optimize_cli(module, args)
+
+    holder = {}
+
+    def load(workflow_cls, **kwargs):
+        if args.snapshot_dir:
+            # CLI flags outrank any snapshotter section in the config file,
+            # same precedence as root.a.b=value overrides
+            kwargs["snapshotter_config"] = {
+                "directory": args.snapshot_dir,
+                "interval": args.snapshot_interval,
+                "compression": args.snapshot_compression,
+            }
+        kwargs.setdefault("fused", not args.no_fused)
+        wf = workflow_cls(None, **kwargs)
+        holder["workflow"] = wf
+        return wf
+
+    def main_():
+        wf = holder["workflow"]
+        if args.graph:
+            wf.generate_graph(args.graph)
+        launcher = Launcher(
+            wf, snapshot=args.snapshot, distributed=args.distributed,
+            coordinator_address=args.coordinator_address,
+            num_processes=args.num_processes, process_id=args.process_id,
+            stats=not args.no_stats)
+        holder["launcher"] = launcher
+        launcher.boot()
+
+    module.run(load, main_)
+
+    launcher = holder.get("launcher")
+    if launcher is not None and args.result_file:
+        with open(args.result_file, "w", encoding="utf-8") as f:
+            json.dump(launcher.result_summary(), f, indent=2, default=str)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

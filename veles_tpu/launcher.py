@@ -1,0 +1,93 @@
+"""Launcher — runs a workflow standalone or multi-host SPMD.
+
+Ref: veles/launcher.py::Launcher [H] (SURVEY §2.1, §3.1): the reference's
+launcher owned the Twisted reactor, created the device, ran the workflow in
+standalone / ``--master`` / ``--slave`` modes and wired the auxiliary
+services (graphics, web status).
+
+TPU-native redesign (SURVEY §5.8): the master/slave control plane collapses
+into SPMD — every host runs the SAME program under
+``jax.distributed.initialize``; gradient averaging is the all-reduce XLA
+inserts over ICI, and the loader shards its index space by
+``process_index`` instead of receiving shards from a master.  Standalone is
+the 1-process special case of the same code path.
+"""
+
+from __future__ import annotations
+
+import time
+
+from veles_tpu.logger import Logger
+
+
+class Launcher(Logger):
+    """Owns the workflow lifecycle: initialize → (restore) → run → report.
+
+    Parameters
+    ----------
+    workflow: a built (not yet initialized) Workflow.
+    snapshot: optional path — restore state after initialize (resume).
+    distributed: join a multi-host run via ``jax.distributed`` and shard the
+        loader by process index (the reference's ``--master``/``--slave``
+        pair, collapsed).
+    stats: print the per-unit run-time table at the end.
+    """
+
+    def __init__(self, workflow, snapshot=None, distributed=False,
+                 coordinator_address=None, num_processes=None,
+                 process_id=None, stats=True):
+        self.workflow = workflow
+        self.snapshot = snapshot
+        self.distributed = distributed
+        self.coordinator_address = coordinator_address
+        self.num_processes = num_processes
+        self.process_id = process_id
+        self.stats = stats
+        self.restored_payload = None
+        self.run_seconds = None
+
+    def boot(self, **kwargs):
+        """The reference's Launcher.boot(): bring everything up and run."""
+        wf = self.workflow
+        if self.distributed:
+            from veles_tpu.parallel import initialize_multihost
+            index, count = initialize_multihost(
+                self.coordinator_address, self.num_processes,
+                self.process_id)
+            loader = getattr(wf, "loader", None)
+            if loader is not None:
+                loader.shard(index, count)
+            self.info("joined distributed run as process %d/%d", index, count)
+        wf.initialize(**kwargs)
+        if self.snapshot:
+            from veles_tpu import snapshotter
+            self.restored_payload = snapshotter.restore(wf, self.snapshot)
+            self.info("resumed from %s (epoch %s)", self.snapshot,
+                      self.restored_payload.get("epoch"))
+        begin = time.perf_counter()
+        wf.run()
+        self.run_seconds = time.perf_counter() - begin
+        self.info("workflow %r finished in %.2fs", wf.name, self.run_seconds)
+        if self.stats:
+            wf.print_stats()
+        return wf
+
+    # ------------------------------------------------------------------ intro
+    def result_summary(self):
+        """JSON-friendly run summary (the reference wrote --result-file)."""
+        wf = self.workflow
+        decision = getattr(wf, "decision", None)
+        out = {"workflow": wf.name, "run_seconds": self.run_seconds}
+        if decision is not None:
+            out["best_metric"] = decision.best_metric
+            out["best_epoch"] = decision.best_epoch
+            if decision.epoch_metrics:
+                out["last_epoch_metrics"] = {
+                    set_name: {k: v for k, v in metrics.items()
+                               if isinstance(v, (int, float))}
+                    for set_name, metrics in decision.epoch_metrics[-1].items()
+                }
+        snap = getattr(wf, "snapshotter", None)
+        if snap is not None and snap.destination:
+            out["snapshot"] = snap.destination
+        return out

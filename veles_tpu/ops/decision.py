@@ -121,6 +121,18 @@ class DecisionBase(Unit):
         if done:
             self.complete.set(True)
 
+    def reevaluate_complete(self, epoch):
+        """Would this decision (with its CURRENT limits) still be complete at
+        ``epoch``?  Used by snapshot resume: fine-tuning may raise
+        max_epochs, reopening a completed run.  Kept next to _on_epoch_end so
+        the two stopping rules stay in lockstep; subclasses with different
+        stopping logic override both."""
+        out_of_epochs = (self.max_epochs is not None
+                         and epoch >= self.max_epochs)
+        stalled = (self.best_epoch >= 0 and self.fail_iterations is not None
+                   and epoch - self.best_epoch >= self.fail_iterations)
+        return out_of_epochs or stalled
+
     def log_epoch(self, epoch):
         parts = []
         for set_name, metrics in self._current.items():
@@ -183,3 +195,6 @@ class TrivialDecision(DecisionBase):
         self.log_epoch(epoch)
         if self.max_epochs is not None and epoch >= self.max_epochs:
             self.complete.set(True)
+
+    def reevaluate_complete(self, epoch):
+        return self.max_epochs is not None and epoch >= self.max_epochs

@@ -31,6 +31,9 @@ def make_sample(config_name, workflow_cls, loader_cls, default_config,
         loader_cfg.update(overrides.pop("loader", {}))
         decision_cfg = {k: get(v, v) for k, v in cfg.decision.items()}
         decision_cfg.update(overrides.pop("decision", {}))
+        if "snapshotter" in cfg and "snapshotter_config" not in overrides:
+            overrides["snapshotter_config"] = {
+                k: get(v, v) for k, v in cfg.snapshotter.items()}
         return workflow_cls(
             None, name=config_name,
             loader_factory=loader_cls, loader_config=loader_cfg,
@@ -46,12 +49,17 @@ def make_sample(config_name, workflow_cls, loader_cls, default_config,
 
     def run(load, main):
         cfg = _config()
-        load(workflow_cls,
-             loader_factory=loader_cls,
-             loader_config={k: get(v, v) for k, v in cfg.loader.items()},
-             layers=get(cfg.layers, cfg.layers),
-             decision_config={k: get(v, v) for k, v in cfg.decision.items()},
-             loss_function=loss_function)
+        kwargs = dict(
+            name=config_name,
+            loader_factory=loader_cls,
+            loader_config={k: get(v, v) for k, v in cfg.loader.items()},
+            layers=get(cfg.layers, cfg.layers),
+            decision_config={k: get(v, v) for k, v in cfg.decision.items()},
+            loss_function=loss_function)
+        if "snapshotter" in cfg:
+            kwargs["snapshotter_config"] = {
+                k: get(v, v) for k, v in cfg.snapshotter.items()}
+        load(workflow_cls, **kwargs)
         main()
 
     return build, train, run

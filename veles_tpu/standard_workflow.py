@@ -89,16 +89,19 @@ class StandardWorkflowBase(NNWorkflow):
 
     def __init__(self, workflow=None, name=None, loader_factory=None,
                  loader_config=None, layers=(), decision_config=None,
-                 loss_function="softmax", fused=True, **kwargs):
+                 snapshotter_config=None, loss_function="softmax", fused=True,
+                 **kwargs):
         super().__init__(workflow, name=name, **kwargs)
         self.layers_config = list(layers)
         self.loss_function = loss_function
         self.fused = fused
+        self.snapshotter = None
         self._build(loader_factory, dict(loader_config or {}),
-                    dict(decision_config or {}))
+                    dict(decision_config or {}), snapshotter_config)
 
     # ------------------------------------------------------------------ build
-    def _build(self, loader_factory, loader_config, decision_config):
+    def _build(self, loader_factory, loader_config, decision_config,
+               snapshotter_config):
         if loader_factory is None:
             raise ValueError("loader_factory is required")
         self.repeater = Repeater(self, name="repeater")
@@ -111,6 +114,8 @@ class StandardWorkflowBase(NNWorkflow):
         self.link_evaluator()
         self.link_decision(decision_config)
         self.link_gds()
+        if snapshotter_config is not None:
+            self.link_snapshotter(dict(snapshotter_config))
         self.link_end_point()
 
     def link_forwards(self):
@@ -175,6 +180,21 @@ class StandardWorkflowBase(NNWorkflow):
         self.repeater.link_from(prev_gd if prev_gd is not None
                                 else self.decision)
 
+    def link_snapshotter(self, config):
+        """Snapshotter at the tail of the backward chain (ref places it off
+        decision — veles/znicz/standard_workflow.py [H] — but capturing the
+        state AFTER the epoch's last weight commit is what makes resume
+        bit-exact, so it hangs off the last gd; gate_skip propagation keeps
+        it firing on valid/test minibatches too)."""
+        from veles_tpu.snapshotter import Snapshotter
+        config.setdefault("prefix", self.name)
+        snap = Snapshotter(self, name="snapshotter", **config)
+        snap.link_from(self.gds[0] if self.gds else self.decision)
+        snap.link_attrs(self.decision, "improved", "complete")
+        snap.link_attrs(self.loader, "epoch_number", "epoch_ended")
+        self.snapshotter = snap
+        return snap
+
     def link_end_point(self):
         self.end_point.link_from(self.decision)
         self.end_point.gate_block = ~self.decision.complete
@@ -195,6 +215,22 @@ class StandardWorkflowBase(NNWorkflow):
         if runner is not None:
             runner.sync_to_units()
         return super().snapshot_state()
+
+    def load_snapshot_state(self, state):
+        super().load_snapshot_state(state)
+        # restored weights live in the unit Vectors; refresh the fused
+        # runner's device state so the next step trains from them
+        runner = getattr(self, "_fused_runner", None)
+        if runner is not None:
+            runner.state = runner._pull_state()
+        # fine-tune semantics: a snapshot taken at completion restores
+        # complete=True, but the CURRENT config may allow more epochs
+        # (--snapshot with a raised max_epochs, ref resume ergonomics) —
+        # re-evaluate the stopping condition against the current limits
+        dec = self.decision
+        if dec is not None and bool(dec.complete):
+            if not dec.reevaluate_complete(int(self.loader.epoch_number)):
+                dec.complete.set(False)
 
 
 class StandardWorkflow(StandardWorkflowBase):
