@@ -1,0 +1,284 @@
+"""Loader-expansion tests: normalizers, image/pickle loaders, minibatch
+capture/replay, InputJoiner, Wine sample (SURVEY §2.1/§2.2 parity)."""
+
+import pickle
+
+import numpy
+import pytest
+
+
+# ---------------------------------------------------------------- normalizers
+def test_linear_normalizer_roundtrip():
+    from veles_tpu.normalization import from_spec
+    stream = numpy.random.RandomState(0)
+    data = stream.uniform(-5, 9, (40, 7)).astype(numpy.float32)
+    norm = from_spec("linear")
+    norm.analyze(data)
+    out = norm.apply(data)
+    assert out.min() >= -1.0001 and out.max() <= 1.0001
+    numpy.testing.assert_allclose(norm.denormalize(out), data, atol=1e-4)
+
+
+def test_mean_disp_normalizer():
+    from veles_tpu.normalization import from_spec
+    stream = numpy.random.RandomState(1)
+    data = stream.normal(3.0, 2.0, (64, 5)).astype(numpy.float32)
+    norm = from_spec("mean_disp")
+    norm.analyze(data)
+    out = norm.apply(data)
+    numpy.testing.assert_allclose(out.mean(axis=0), 0.0, atol=1e-5)
+    numpy.testing.assert_allclose(norm.denormalize(out), data, atol=1e-4)
+
+
+def test_pointwise_and_exp_and_external_mean():
+    from veles_tpu.normalization import from_spec
+    stream = numpy.random.RandomState(2)
+    data = stream.uniform(0, 10, (16, 4)).astype(numpy.float32)
+
+    pw = from_spec("pointwise")
+    pw.analyze(data)
+    out = pw.apply(data)
+    assert out.min() >= -1.0001 and out.max() <= 1.0001
+    numpy.testing.assert_allclose(pw.denormalize(out), data, atol=1e-4)
+
+    ex = from_spec("exp")
+    out = ex.apply(data)
+    numpy.testing.assert_allclose(out.sum(axis=1), 1.0, atol=1e-5)
+
+    em = from_spec("external_mean")
+    em.analyze(data)
+    out = em.apply(data)
+    numpy.testing.assert_allclose(out.mean(axis=0), 0.0, atol=1e-5)
+
+
+def test_normalizer_picklable():
+    from veles_tpu.normalization import from_spec
+    data = numpy.random.RandomState(3).uniform(-1, 4, (8, 3)).astype(
+        numpy.float32)
+    norm = from_spec("linear")
+    norm.analyze(data)
+    clone = pickle.loads(pickle.dumps(norm))
+    numpy.testing.assert_array_equal(clone.apply(data), norm.apply(data))
+
+
+def test_unknown_normalizer_rejected():
+    from veles_tpu.normalization import from_spec
+    with pytest.raises(ValueError):
+        from_spec("nope")
+
+
+# ----------------------------------------------------------------- normalized
+def test_loader_normalization_hook():
+    from veles_tpu.workflow import Workflow
+    from veles_tpu.loader.fullbatch import FullBatchLoader
+
+    class ArrayLoader(FullBatchLoader):
+        def load_data(self):
+            stream = numpy.random.RandomState(0)
+            self.original_data.reset(
+                stream.uniform(0, 50, (30, 4)).astype(numpy.float32))
+            self.original_labels.reset(
+                numpy.zeros(30, numpy.int32))
+            self.class_lengths = [0, 10, 20]
+
+    wf = Workflow(None, name="w")
+    loader = ArrayLoader(wf, minibatch_size=10,
+                         normalization_type="linear")
+    loader.initialize()
+    # statistics fitted on the TRAIN slice: train rows map into [-1, 1]
+    data = loader.original_data.mem
+    assert data[10:].min() >= -1.0001 and data[10:].max() <= 1.0001
+
+
+# -------------------------------------------------------------- image loading
+def _write_images(tmp_path, per_class=6, size=(12, 10)):
+    from PIL import Image
+    for cls, color0 in (("red", (200, 10, 10)), ("blue", (10, 10, 200))):
+        d = tmp_path / cls
+        d.mkdir(exist_ok=True)
+        for i in range(per_class):
+            arr = numpy.zeros(size + (3,), numpy.uint8)
+            arr[..., :] = color0
+            arr[i % size[0], :, :] = 255
+            Image.fromarray(arr).save(d / ("img_%d.png" % i))
+
+
+def test_image_loader_directory_split(tmp_path):
+    from veles_tpu.workflow import Workflow
+    from veles_tpu.loader.image import AutoSplitImageLoader
+
+    _write_images(tmp_path)
+    wf = Workflow(None, name="w")
+    loader = AutoSplitImageLoader(wf, str(tmp_path), validation_ratio=0.25,
+                                  scale=(8, 8), minibatch_size=4)
+    loader.initialize()
+    assert loader.class_lengths[0] == 0
+    assert sum(loader.class_lengths) == 12
+    assert loader.class_lengths[1] == 3   # every 4th file
+    assert loader.original_data.shape == (12, 8, 8, 3)
+    assert set(loader.label_names) == {"red", "blue"}
+    # linear normalization is fitted on the TRAIN slice only
+    train = loader.original_data.mem[3:]
+    assert train.min() >= -1.0001 and train.max() <= 1.0001
+
+
+def test_image_decode_gray_and_crop(tmp_path):
+    from PIL import Image
+    from veles_tpu.loader.image import decode_image
+    arr = numpy.arange(20 * 16 * 3, dtype=numpy.uint8).reshape(20, 16, 3)
+    path = tmp_path / "x.png"
+    Image.fromarray(arr).save(path)
+    out = decode_image(str(path), size=(10, 8), color_space="GRAY",
+                       crop=(6, 6))
+    assert out.shape == (6, 6, 1)
+
+
+def test_image_loader_shared_label_map(tmp_path):
+    """The same class name maps to the same label index in EVERY split, even
+    when a split is missing some classes."""
+    from PIL import Image
+    from veles_tpu.workflow import Workflow
+    from veles_tpu.loader.image import FullBatchImageLoader
+
+    def make(split, classes):
+        base = tmp_path / split
+        for cls in classes:
+            d = base / cls
+            d.mkdir(parents=True, exist_ok=True)
+            arr = numpy.full((6, 6, 3), 100, numpy.uint8)
+            Image.fromarray(arr).save(d / "a.png")
+        return str(base)
+
+    train = make("train", ["ant", "bee", "cat"])
+    valid = make("valid", ["bee", "cat"])   # missing "ant"
+    wf = Workflow(None, name="w")
+    loader = FullBatchImageLoader(wf, validation_paths=valid,
+                                  train_paths=train, scale=(6, 6),
+                                  minibatch_size=4)
+    loader.initialize()
+    assert loader.label_names == ["ant", "bee", "cat"]
+    labels = loader.original_labels.to_numpy()
+    # layout [test|valid|train]: valid = bee,cat → [1,2]; train → [0,1,2]
+    numpy.testing.assert_array_equal(labels, [1, 2, 0, 1, 2])
+
+
+# ------------------------------------------------------------- pickles loader
+def test_pickles_loader(tmp_path):
+    from veles_tpu.workflow import Workflow
+    from veles_tpu.loader.pickles import PicklesLoader
+
+    stream = numpy.random.RandomState(0)
+    for name, n in (("v.pickle", 8), ("t.pickle", 24)):
+        with open(tmp_path / name, "wb") as f:
+            pickle.dump((stream.normal(size=(n, 6)).astype(numpy.float32),
+                         (numpy.arange(n) % 3).astype(numpy.int32)), f)
+    wf = Workflow(None, name="w")
+    loader = PicklesLoader(
+        wf, validation_path=str(tmp_path / "v.pickle"),
+        train_path=str(tmp_path / "t.pickle"), minibatch_size=8)
+    loader.initialize()
+    assert loader.class_lengths == [0, 8, 24]
+    assert loader.original_data.shape == (32, 6)
+    assert loader.has_labels
+
+
+def test_pickles_loader_rejects_mixed_labels(tmp_path):
+    from veles_tpu.workflow import Workflow
+    from veles_tpu.loader.pickles import PicklesLoader
+
+    stream = numpy.random.RandomState(0)
+    with open(tmp_path / "v.pickle", "wb") as f:       # bare array: no labels
+        pickle.dump(stream.normal(size=(8, 4)).astype(numpy.float32), f)
+    with open(tmp_path / "t.pickle", "wb") as f:       # labeled
+        pickle.dump((stream.normal(size=(24, 4)).astype(numpy.float32),
+                     (numpy.arange(24) % 3).astype(numpy.int32)), f)
+    wf = Workflow(None, name="w")
+    loader = PicklesLoader(
+        wf, validation_path=str(tmp_path / "v.pickle"),
+        train_path=str(tmp_path / "t.pickle"), minibatch_size=8)
+    with pytest.raises(ValueError, match="mixed"):
+        loader.initialize()
+
+
+# ----------------------------------------------------- capture/replay + joiner
+def test_minibatch_capture_replay(tmp_path):
+    from veles_tpu.samples import mnist
+    from veles_tpu.config import root
+    from veles_tpu.loader.saver import MinibatchesSaver, MinibatchesLoader
+    from veles_tpu.workflow import Workflow
+
+    root.__dict__.pop("mnist", None)
+    root.mnist.update({
+        "loader": {"minibatch_size": 16, "n_train": 48, "n_valid": 16},
+        "decision": {"max_epochs": 1, "fail_iterations": 5},
+        "layers": [
+            {"type": "all2all_tanh", "output_sample_shape": 16,
+             "learning_rate": 0.05, "momentum": 0.0},
+            {"type": "softmax", "output_sample_shape": 10,
+             "learning_rate": 0.05, "momentum": 0.0},
+        ],
+    })
+    path = str(tmp_path / "stream.pickle")
+    wf = mnist.build()
+    MinibatchesSaver.attach_to(wf.loader, path)
+    wf.initialize()
+    wf.run()
+
+    replay_wf = Workflow(None, name="replay")
+    replay = MinibatchesLoader(replay_wf, path=path)
+    replay.initialize()
+    assert replay.class_lengths == [0, 16, 48]
+    seen = 0
+    first_epoch = []
+    while True:
+        replay.run()
+        first_epoch.append(replay.minibatch_data.to_numpy())
+        seen += replay.minibatch_size
+        if replay.last_minibatch:
+            break
+    assert seen == 64
+    # replay is deterministic: second epoch identical
+    replay.run()
+    numpy.testing.assert_array_equal(replay.minibatch_data.to_numpy(),
+                                     first_epoch[0])
+
+
+def test_input_joiner():
+    from veles_tpu.workflow import Workflow
+    from veles_tpu.units import Unit
+    from veles_tpu.memory import Vector
+    from veles_tpu.input_joiner import InputJoiner
+
+    class Producer(Unit):
+        def __init__(self, workflow, value, **kwargs):
+            super().__init__(workflow, **kwargs)
+            self.output = Vector(value)
+
+    wf = Workflow(None, name="w")
+    a = Producer(wf, numpy.ones((4, 3), numpy.float32), name="a")
+    b = Producer(wf, numpy.full((4, 2, 2), 2.0, numpy.float32), name="b")
+    joiner = InputJoiner(wf, inputs=[a, b])
+    joiner.initialize()
+    joiner.run()
+    out = joiner.output.to_numpy()
+    assert out.shape == (4, 7)
+    numpy.testing.assert_array_equal(out[:, :3], 1.0)
+    numpy.testing.assert_array_equal(out[:, 3:], 2.0)
+
+    c = Producer(wf, numpy.zeros((5, 3), numpy.float32), name="c")
+    bad = InputJoiner(wf, inputs=[a, c])
+    with pytest.raises(ValueError):
+        bad.initialize()
+
+
+# ------------------------------------------------------------------ wine
+def test_wine_converges():
+    from veles_tpu.config import root
+    from veles_tpu.samples import wine
+
+    root.__dict__.pop("wine", None)
+    wine.default_config()
+    root.wine.decision.max_epochs = 25
+    wf = wine.train()
+    last = wf.decision.epoch_metrics[-1]["validation"]
+    assert last["n_err"] <= 3, last

@@ -92,10 +92,15 @@ class Loader(Unit):
         return out
 
     # -- engine --------------------------------------------------------------
+    def normalize_data(self):
+        """Hook between load_data and minibatch allocation (see
+        FullBatchLoader: fits the configured normalizer on the train set)."""
+
     def initialize(self, device=None, **kwargs):
         self.load_data()
         if self.total_samples == 0:
             raise ValueError("%s: load_data produced no samples" % self.name)
+        self.normalize_data()
         self.create_minibatch_data()
         self._plan_epoch()
         self._position = 0

@@ -17,17 +17,40 @@ from veles_tpu.memory import Vector
 
 
 class FullBatchLoader(Loader):
-    """Loader over in-memory arrays; subclasses fill original_data/labels."""
+    """Loader over in-memory arrays; subclasses fill original_data/labels.
 
-    def __init__(self, workflow, **kwargs):
+    ``normalization_type`` plugs a :mod:`veles_tpu.normalization` strategy
+    in: statistics are fitted on the TRAIN slice only and applied to every
+    set (the reference's normalizer hook on Loader — veles/loader/base.py
+    [H]).
+    """
+
+    #: the fitted normalizer travels with snapshots so a served/resumed
+    #: model reproduces the exact input transform without the train data
+    snapshot_attrs = Loader.snapshot_attrs + ("normalizer",)
+
+    def __init__(self, workflow, normalization_type="none",
+                 normalization_parameters=None, **kwargs):
         super().__init__(workflow, **kwargs)
         #: full dataset, laid out [test | validation | train] along axis 0
         self.original_data = Vector()
         self.original_labels = Vector()
         self.has_labels = True
+        from veles_tpu.normalization import from_spec
+        self.normalizer = from_spec(normalization_type,
+                                    **(normalization_parameters or {}))
 
     def load_data(self):
         raise NotImplementedError
+
+    def normalize_data(self):
+        from veles_tpu.normalization import NoneNormalizer
+        if isinstance(self.normalizer, NoneNormalizer):
+            return
+        data = self.original_data.mem
+        begin, end = self.class_offsets()[2]   # TRAIN slice
+        self.normalizer.analyze(data[begin:end] if end > begin else data)
+        self.original_data.reset(self.normalizer.apply(data))
 
     def create_minibatch_data(self):
         mb = self.max_minibatch_size

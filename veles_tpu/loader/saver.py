@@ -1,0 +1,130 @@
+"""Minibatch stream capture and replay (offline preprocessing).
+
+Ref: veles/loader/saver.py::MinibatchesSaver/MinibatchesLoader [M]
+(SURVEY §2.2): record the loader's minibatch output stream to one binary
+file during a run, then replay it later WITHOUT the original dataset or its
+preprocessing cost.  Format here: a pickle stream — one header dict, then
+one record per minibatch, each self-contained (class, indices, data, labels,
+mask, size) — append-friendly and readable without loading everything.
+"""
+
+from __future__ import annotations
+
+import pickle
+
+import numpy
+
+from veles_tpu.loader.base import Loader
+from veles_tpu.units import Unit
+
+MAGIC = "veles_tpu-minibatches-v1"
+
+
+class MinibatchesSaver(Unit):
+    """Graph unit: hangs off the loader and records every minibatch.
+
+    Wire: ``saver.link_from(loader)`` +
+    ``saver.link_attrs(loader, "minibatch_data", …)`` (done by
+    ``attach_to``).  Capture covers exactly one epoch by default — replay
+    then reshuffles indices per epoch like a real loader would not (the
+    stream is fixed), which is what the reference's offline mode did.
+    """
+
+    def __init__(self, workflow, path="minibatches.pickle", **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.path = path
+        self._file = None
+        self._recorded = 0
+
+    @classmethod
+    def attach_to(cls, loader, path, **kwargs):
+        saver = cls(loader.workflow, path=path, **kwargs)
+        saver.link_from(loader)
+        saver.link_attrs(
+            loader, "minibatch_data", "minibatch_labels", "minibatch_mask",
+            "minibatch_indices", "minibatch_class", "minibatch_size",
+            "class_lengths", "max_minibatch_size", "epoch_ended")
+        return saver
+
+    def initialize(self, device=None, **kwargs):
+        self._file = open(self.path, "wb")
+        pickle.dump({"magic": MAGIC,
+                     "class_lengths": list(self.class_lengths),
+                     "minibatch_size": int(self.max_minibatch_size)},
+                    self._file, protocol=pickle.HIGHEST_PROTOCOL)
+        super().initialize(device=device, **kwargs)
+
+    def run(self):
+        if self._file is None:
+            return
+        record = {
+            "class": int(self.minibatch_class),
+            "size": int(self.minibatch_size),
+            "data": self.minibatch_data.to_numpy(),
+            "labels": (self.minibatch_labels.to_numpy()
+                       if not self.minibatch_labels.is_empty else None),
+            "mask": self.minibatch_mask.to_numpy(),
+            "indices": self.minibatch_indices.to_numpy(),
+        }
+        pickle.dump(record, self._file, protocol=pickle.HIGHEST_PROTOCOL)
+        self._recorded += 1
+        if bool(self.epoch_ended):
+            self.close()
+
+    def close(self):
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+            self.info("captured %d minibatches → %s", self._recorded,
+                      self.path)
+
+    def stop(self):
+        self.close()
+
+
+class MinibatchesLoader(Loader):
+    """Replays a captured minibatch stream as a drop-in Loader.
+
+    The epoch plan is the recorded sequence verbatim (no reshuffle — the
+    capture IS the preprocessing artifact).
+    """
+
+    def __init__(self, workflow, path="minibatches.pickle", **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.path = path
+        self._records = []
+
+    def load_data(self):
+        self._records = []
+        with open(self.path, "rb") as f:
+            header = pickle.load(f)
+            if header.get("magic") != MAGIC:
+                raise ValueError("%s is not a minibatch capture" % self.path)
+            self.class_lengths = list(header["class_lengths"])
+            self.max_minibatch_size = int(header["minibatch_size"])
+            while True:
+                try:
+                    self._records.append(pickle.load(f))
+                except EOFError:
+                    break
+        if not self._records:
+            raise ValueError("%s holds no minibatches" % self.path)
+
+    def create_minibatch_data(self):
+        first = self._records[0]
+        self.minibatch_data.reset(numpy.zeros_like(first["data"]))
+        if first["labels"] is not None:
+            self.minibatch_labels.reset(numpy.zeros_like(first["labels"]))
+
+    def _plan_epoch(self):
+        # the recorded order IS the plan; minibatch i replays record i
+        self._order = [(r["class"],
+                        numpy.asarray(r["indices"], numpy.int32), r["size"])
+                       for r in self._records]
+
+    def fill_minibatch(self, indices, actual_size):
+        record = self._records[self._position - 1]
+        self.minibatch_data.reset(record["data"])
+        if record["labels"] is not None:
+            self.minibatch_labels.reset(record["labels"])
+        self.minibatch_mask.reset(record["mask"])

@@ -115,7 +115,7 @@ class DecisionBase(Unit):
         done = False
         if self.max_epochs is not None and epoch >= self.max_epochs:
             done = True
-        if (self.best_epoch >= 0 and
+        if (self.best_epoch >= 0 and self.fail_iterations is not None and
                 epoch - self.best_epoch >= self.fail_iterations):
             done = True
         if done:

@@ -25,29 +25,8 @@ def make_sample(config_name, workflow_cls, loader_cls, default_config,
             cfg = getattr(root, config_name)
         return cfg
 
-    def build(fused=True, **overrides):
-        cfg = _config()
-        loader_cfg = {k: get(v, v) for k, v in cfg.loader.items()}
-        loader_cfg.update(overrides.pop("loader", {}))
-        decision_cfg = {k: get(v, v) for k, v in cfg.decision.items()}
-        decision_cfg.update(overrides.pop("decision", {}))
-        if "snapshotter" in cfg and "snapshotter_config" not in overrides:
-            overrides["snapshotter_config"] = {
-                k: get(v, v) for k, v in cfg.snapshotter.items()}
-        return workflow_cls(
-            None, name=config_name,
-            loader_factory=loader_cls, loader_config=loader_cfg,
-            layers=get(cfg.layers, cfg.layers),
-            decision_config=decision_cfg,
-            loss_function=loss_function, fused=fused, **overrides)
-
-    def train(fused=True, **overrides):
-        wf = build(fused=fused, **overrides)
-        wf.initialize()
-        wf.run()
-        return wf
-
-    def run(load, main):
+    def _workflow_kwargs():
+        """The ONE cfg→constructor-kwargs assembly (build and run share it)."""
         cfg = _config()
         kwargs = dict(
             name=config_name,
@@ -59,7 +38,23 @@ def make_sample(config_name, workflow_cls, loader_cls, default_config,
         if "snapshotter" in cfg:
             kwargs["snapshotter_config"] = {
                 k: get(v, v) for k, v in cfg.snapshotter.items()}
-        load(workflow_cls, **kwargs)
+        return kwargs
+
+    def build(fused=True, **overrides):
+        kwargs = _workflow_kwargs()
+        kwargs["loader_config"].update(overrides.pop("loader", {}))
+        kwargs["decision_config"].update(overrides.pop("decision", {}))
+        kwargs.update(overrides)
+        return workflow_cls(None, fused=fused, **kwargs)
+
+    def train(fused=True, **overrides):
+        wf = build(fused=fused, **overrides)
+        wf.initialize()
+        wf.run()
+        return wf
+
+    def run(load, main):
+        load(workflow_cls, **_workflow_kwargs())
         main()
 
     return build, train, run

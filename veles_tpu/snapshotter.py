@@ -25,6 +25,7 @@ import gzip
 import lzma
 import os
 import pickle
+import shutil
 import time
 
 from veles_tpu.mutable import Bool
@@ -174,13 +175,10 @@ class SnapshotterToFile(SnapshotterBase):
         tmp = path + ".tmp"
         with _open_for_suffix(tmp, self.compression) as f:
             pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
-        with open(tmp, "rb") as f:
-            blob = f.read()
-        os.replace(tmp, path)
         current = os.path.join(self.directory,
                                "%s_current%s" % (self.prefix, self._suffix()))
-        with open(current + ".tmp", "wb") as f:
-            f.write(blob)
+        shutil.copyfile(tmp, current + ".tmp")   # streams in chunks
+        os.replace(tmp, path)
         os.replace(current + ".tmp", current)
         self.destination = path
         self.info("snapshot → %s", path)
