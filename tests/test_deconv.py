@@ -1,0 +1,131 @@
+"""Deconv / depooling op tests + MNIST-AE convergence (SURVEY §4 tiers 2-3).
+
+Oracle pattern: numpy reference vs the jitted op (the role the reference's
+numpy backend played — veles/znicz/tests/unit/ [M])."""
+
+import numpy
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from veles_tpu.ops import functional as F
+
+
+def rng(seed=0):
+    return numpy.random.RandomState(seed)
+
+
+class TestDeconvFunctional:
+    def test_upsamples_by_stride(self):
+        x = rng().randn(2, 7, 7, 3).astype(numpy.float32)
+        w = rng(1).randn(3, 3, 3, 5).astype(numpy.float32)
+        y = F.deconv2d_forward(jnp.asarray(x), jnp.asarray(w), None,
+                               stride=(2, 2), padding="SAME")
+        assert y.shape == (2, 14, 14, 5)
+
+    def test_adjoint_of_conv(self):
+        """<conv(x), y> == <x, deconv(y)> — transposed conv IS the adjoint
+        of conv with the same weights (stride 1, SAME)."""
+        r = rng(2)
+        x = r.randn(2, 8, 8, 3).astype(numpy.float32)
+        w = r.randn(3, 3, 3, 4).astype(numpy.float32)
+        y = r.randn(2, 8, 8, 4).astype(numpy.float32)
+        conv_x = F.conv2d_forward(jnp.asarray(x), jnp.asarray(w), None,
+                                  (1, 1), "SAME")
+        # adjoint wrt x of conv is vjp; deconv with transposed kernel mirrors
+        _, vjp = jax.vjp(
+            lambda a: F.conv2d_forward(a, jnp.asarray(w), None, (1, 1),
+                                       "SAME"), jnp.asarray(x))
+        adj = vjp(jnp.asarray(y))[0]
+        wt = jnp.flip(jnp.asarray(w), axis=(0, 1)).transpose(0, 1, 3, 2)
+        dec = F.deconv2d_forward(jnp.asarray(y), wt, None, (1, 1), "SAME")
+        lhs = float((conv_x * y).sum())
+        rhs = float((jnp.asarray(x) * adj).sum())
+        numpy.testing.assert_allclose(lhs, rhs, rtol=1e-4)
+        numpy.testing.assert_allclose(numpy.asarray(adj), numpy.asarray(dec),
+                                      rtol=1e-4, atol=1e-4)
+
+    def test_int_padding_mirrors_conv(self):
+        """deconv(k, s, p) must invert conv(k, s, p)'s spatial shape —
+        the autoencoder mirror contract (explicit int padding)."""
+        x = jnp.zeros((1, 28, 28, 3))
+        w = jnp.zeros((5, 5, 3, 8))
+        y = F.conv2d_forward(x, w, None, (2, 2), 2)
+        assert y.shape == (1, 14, 14, 8)
+        wt = jnp.zeros((5, 5, 8, 3))
+        # (28 + 2*2 - 5) % 2 = 1 extra bottom/right pixel recovers 28 exactly
+        back = F.deconv2d_forward(y, wt, None, (2, 2), 2, output_padding=1)
+        assert back.shape == (1, 28, 28, 3)
+        # without output_padding the transpose shape formula gives 27
+        back = F.deconv2d_forward(y, wt, None, (2, 2), 2)
+        assert back.shape == (1, 27, 27, 3)
+
+    def test_numeric_gradient(self):
+        r = rng(3)
+        x = r.randn(1, 4, 4, 2).astype(numpy.float32)
+        w = r.randn(3, 3, 2, 1).astype(numpy.float32)
+
+        def loss(w_):
+            y = F.deconv2d_forward(jnp.asarray(x), w_, None, (2, 2), "SAME")
+            return (y * y).sum() * 0.5
+
+        g = jax.grad(loss)(jnp.asarray(w))
+        eps = 1e-3
+        for idx in [(0, 0, 0, 0), (1, 2, 1, 0), (2, 2, 0, 0)]:
+            wp, wm = w.copy(), w.copy()
+            wp[idx] += eps
+            wm[idx] -= eps
+            num = (float(loss(jnp.asarray(wp))) -
+                   float(loss(jnp.asarray(wm)))) / (2 * eps)
+            numpy.testing.assert_allclose(float(g[idx]), num, rtol=2e-2,
+                                          atol=1e-3)
+
+
+class TestDepool:
+    def test_nearest(self):
+        x = numpy.arange(4, dtype=numpy.float32).reshape(1, 2, 2, 1)
+        y = numpy.asarray(F.depool(jnp.asarray(x), (2, 2), "nearest"))
+        expect = numpy.repeat(numpy.repeat(x, 2, 1), 2, 2)
+        numpy.testing.assert_array_equal(y, expect)
+
+    def test_zero(self):
+        x = numpy.ones((1, 2, 2, 1), numpy.float32)
+        y = numpy.asarray(F.depool(jnp.asarray(x), (2, 2), "zero"))
+        assert y.shape == (1, 4, 4, 1)
+        assert y.sum() == 4.0
+        assert y[0, 0, 0, 0] == 1.0 and y[0, 1, 1, 0] == 0.0
+
+    def test_nearest_vjp_is_window_sum(self):
+        x = jnp.ones((1, 2, 2, 1))
+        _, vjp = jax.vjp(lambda a: F.depool(a, (2, 2), "nearest"), x)
+        g = vjp(jnp.ones((1, 4, 4, 1)))[0]
+        numpy.testing.assert_array_equal(numpy.asarray(g),
+                                         numpy.full((1, 2, 2, 1), 4.0))
+
+
+class TestMnistAE:
+    @pytest.mark.parametrize("fused", [True, False])
+    def test_converges(self, fused):
+        from veles_tpu import prng
+        from veles_tpu.config import root
+        prng.reset()
+        prng.seed_all(1)
+        root.mnist_ae.update({
+            "loader": {"minibatch_size": 50, "n_train": 300, "n_valid": 100},
+            "decision": {"max_epochs": 3, "fail_iterations": 10},
+            "layers": [
+                {"type": "conv_tanh", "n_kernels": 8, "kx": 5, "ky": 5,
+                 "padding": "SAME", "learning_rate": 0.0005, "momentum": 0.9},
+                {"type": "avg_pooling", "kx": 2, "ky": 2},
+                {"type": "depooling", "kx": 2, "ky": 2},
+                {"type": "deconv", "n_kernels": 1, "kx": 5, "ky": 5,
+                 "padding": "SAME", "learning_rate": 0.0005, "momentum": 0.9},
+            ],
+        })
+        from veles_tpu.samples import mnist_ae
+        wf = mnist_ae.train(fused=fused)
+        rmses = [m["validation"]["rmse"] for m in wf.decision.epoch_metrics
+                 if "validation" in m]
+        assert len(rmses) >= 3
+        assert rmses[-1] < rmses[0], rmses

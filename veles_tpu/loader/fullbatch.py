@@ -49,8 +49,33 @@ class FullBatchLoader(Loader):
             return
         data = self.original_data.mem
         begin, end = self.class_offsets()[2]   # TRAIN slice
-        self.normalizer.analyze(data[begin:end] if end > begin else data)
+        if end > begin:
+            self.normalizer.analyze(data[begin:end])
+        elif not self.normalizer.is_fitted:
+            # No train data (serving/eval-only loader): statistics must come
+            # from training time — fitting on test data would silently change
+            # the input transform.  A snapshot restore (which happens AFTER
+            # initialize) may still deliver the fitted normalizer, so defer:
+            # load_state_dict applies it, and run() errors if nothing did.
+            self._normalize_deferred = True
+            return
         self.original_data.reset(self.normalizer.apply(data))
+
+    def load_state_dict(self, d):
+        super().load_state_dict(d)
+        if getattr(self, "_normalize_deferred", False) and \
+                self.normalizer.is_fitted:
+            self.original_data.reset(
+                self.normalizer.apply(self.original_data.mem))
+            self._normalize_deferred = False
+
+    def run(self):
+        if getattr(self, "_normalize_deferred", False):
+            raise ValueError(
+                "%s: normalizer is unfitted and there is no train data to "
+                "fit it on; restore a snapshot holding the fitted normalizer "
+                "or pass a pre-fitted one" % self.name)
+        super().run()
 
     def create_minibatch_data(self):
         mb = self.max_minibatch_size

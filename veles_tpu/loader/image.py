@@ -40,6 +40,10 @@ def decode_image(path, size=None, color_space="RGB", crop=None):
     if crop is not None:
         ch, cw = crop
         h, w = arr.shape[:2]
+        if ch > h or cw > w:
+            raise ValueError(
+                "crop %s exceeds image size %s for %s (resize first or "
+                "shrink the crop)" % ((ch, cw), (h, w), path))
         top, left = (h - ch) // 2, (w - cw) // 2
         arr = arr[top:top + ch, left:left + cw]
     return arr

@@ -92,39 +92,64 @@ class MinibatchesLoader(Loader):
     def __init__(self, workflow, path="minibatches.pickle", **kwargs):
         super().__init__(workflow, **kwargs)
         self.path = path
-        self._records = []
+        #: per-record (file_offset, class, indices, size) — data stays on
+        #: disk; one record is unpickled per step (streaming replay, so
+        #: ImageNet-scale captures don't materialize in host RAM)
+        self._index = []
+        self._file = None
 
     def load_data(self):
-        self._records = []
-        with open(self.path, "rb") as f:
-            header = pickle.load(f)
-            if header.get("magic") != MAGIC:
-                raise ValueError("%s is not a minibatch capture" % self.path)
-            self.class_lengths = list(header["class_lengths"])
-            self.max_minibatch_size = int(header["minibatch_size"])
-            while True:
-                try:
-                    self._records.append(pickle.load(f))
-                except EOFError:
-                    break
-        if not self._records:
+        self._index = []
+        if self._file is not None:  # re-initialize: don't leak the handle
+            self._file.close()
+        self._file = open(self.path, "rb")
+        header = pickle.load(self._file)
+        if header.get("magic") != MAGIC:
+            raise ValueError("%s is not a minibatch capture" % self.path)
+        self.class_lengths = list(header["class_lengths"])
+        self.max_minibatch_size = int(header["minibatch_size"])
+        while True:
+            offset = self._file.tell()
+            try:
+                record = pickle.load(self._file)
+            except EOFError:
+                break
+            self._index.append(
+                (offset, record["class"],
+                 numpy.asarray(record["indices"], numpy.int32),
+                 record["size"]))
+        if not self._index:
             raise ValueError("%s holds no minibatches" % self.path)
 
+    def _read_record(self, i):
+        if self._file is None:  # reopened lazily after stop() closed it
+            self._file = open(self.path, "rb")
+        self._file.seek(self._index[i][0])
+        return pickle.load(self._file)
+
     def create_minibatch_data(self):
-        first = self._records[0]
+        first = self._read_record(0)
         self.minibatch_data.reset(numpy.zeros_like(first["data"]))
         if first["labels"] is not None:
             self.minibatch_labels.reset(numpy.zeros_like(first["labels"]))
 
     def _plan_epoch(self):
         # the recorded order IS the plan; minibatch i replays record i
-        self._order = [(r["class"],
-                        numpy.asarray(r["indices"], numpy.int32), r["size"])
-                       for r in self._records]
+        self._order = [(cls, idx, size)
+                       for _, cls, idx, size in self._index]
 
     def fill_minibatch(self, indices, actual_size):
-        record = self._records[self._position - 1]
+        # Loader.run increments _position BEFORE fill_minibatch, so the
+        # current plan entry — and therefore the current record — is
+        # _position - 1; _position is snapshot-restored, which keeps
+        # mid-epoch resume replaying the right record
+        record = self._read_record(self._position - 1)
         self.minibatch_data.reset(record["data"])
         if record["labels"] is not None:
             self.minibatch_labels.reset(record["labels"])
         self.minibatch_mask.reset(record["mask"])
+
+    def stop(self):
+        if self._file is not None:
+            self._file.close()
+            self._file = None

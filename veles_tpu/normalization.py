@@ -21,7 +21,6 @@ NORMALIZERS = {}
 def register(name):
     def deco(cls):
         NORMALIZERS[name] = cls
-        cls.MAPPING = name
         return cls
     return deco
 
@@ -51,14 +50,12 @@ class NormalizerBase:
     def denormalize(self, data):
         raise NotImplementedError
 
-    # normalizers are tiny and plain — default pickling just works; state
-    # helpers exist for the snapshot payload's explicit dict form
-    def state_dict(self):
-        return {attr: getattr(self, attr) for attr in self.state_attrs}
-
-    def load_state_dict(self, d):
-        for attr, value in d.items():
-            setattr(self, attr, value)
+    @property
+    def is_fitted(self):
+        """True once analyze() has produced every statistic (stateless
+        normalizers are always fitted)."""
+        return all(getattr(self, attr) is not None
+                   for attr in self.state_attrs)
 
 
 @register("none")

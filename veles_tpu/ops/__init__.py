@@ -12,4 +12,5 @@ from veles_tpu.ops import all2all, gd  # noqa: F401,E402
 from veles_tpu.ops import conv, gd_conv  # noqa: F401,E402
 from veles_tpu.ops import pooling, activation  # noqa: F401,E402
 from veles_tpu.ops import normalization, dropout, cutter  # noqa: F401,E402
+from veles_tpu.ops import deconv, gd_deconv, depooling  # noqa: F401,E402
 

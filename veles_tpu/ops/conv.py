@@ -16,7 +16,13 @@ from veles_tpu.ops import functional as F
 
 
 class ConvBase(ForwardBase):
-    """Conv layer: config n_kernels, kx, ky, sliding (stride), padding."""
+    """Conv layer: config n_kernels, kx, ky, sliding (stride), padding.
+
+    ``FUNCTIONAL`` is the pure op behind the layer — DeconvBase swaps in the
+    transposed conv and inherits everything else.
+    """
+
+    FUNCTIONAL = staticmethod(F.conv2d_forward)
 
     def __init__(self, workflow, n_kernels=32, kx=5, ky=5, sliding=(1, 1),
                  padding="VALID", **kwargs):
@@ -42,8 +48,7 @@ class ConvBase(ForwardBase):
                 self.bias.reset(numpy.zeros(self.n_kernels, self.dtype))
         import jax
         out = jax.eval_shape(
-            lambda a, w, b: F.conv2d_forward(a, w, b, self.sliding,
-                                             self.padding, self.ACTIVATION),
+            self.forward_fn,
             jax.ShapeDtypeStruct(self.input.shape, self.dtype),
             jax.ShapeDtypeStruct(self.weights.shape, self.dtype),
             jax.ShapeDtypeStruct((self.n_kernels,), self.dtype))
@@ -55,9 +60,9 @@ class ConvBase(ForwardBase):
         AcceleratedUnit.initialize(self, device=device, **kwargs)
 
     def forward_fn(self, x, weights, bias):
-        return F.conv2d_forward(x, weights,
-                                bias if self.include_bias else None,
-                                self.sliding, self.padding, self.ACTIVATION)
+        return self.FUNCTIONAL(x, weights,
+                               bias if self.include_bias else None,
+                               self.sliding, self.padding, self.ACTIVATION)
 
 
 @register_layer_type("conv")

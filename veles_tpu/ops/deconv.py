@@ -1,0 +1,52 @@
+"""Transposed-convolution (deconv) forward units.
+
+Ref: veles/znicz/deconv.py::Deconv [H] (SURVEY §2.3) — used by the
+autoencoder samples to mirror a conv encoder.  NHWC layout, HWIO weights,
+lowered by XLA to an input-dilated conv on the MXU (the reference hand-wrote
+OpenCL/CUDA scatter kernels).  ``deconv(k, s, p)`` inverts the spatial shape
+of ``conv(k, s, p)`` (see functional.deconv2d_forward's padding semantics).
+
+Unlike the reference — whose Deconv could alias the paired Conv's weights
+(tied autoencoder) — weights are owned here so the fused per-layer state
+stays a tree; tie behavior can be recovered by assigning the same Vector to
+both units before initialize.
+"""
+
+from __future__ import annotations
+
+from veles_tpu.ops.conv import ConvBase
+from veles_tpu.ops.nn_units import register_layer_type
+from veles_tpu.ops import functional as F
+
+
+class DeconvBase(ConvBase):
+    """Config: n_kernels (output channels), kx, ky, sliding (upsample
+    factor), padding, output_padding (mirror disambiguation — see
+    functional.deconv2d_forward).  Everything but the pure op is ConvBase."""
+
+    def __init__(self, workflow, n_kernels=1, kx=5, ky=5, sliding=(1, 1),
+                 padding="SAME", output_padding=0, **kwargs):
+        super().__init__(workflow, n_kernels=n_kernels, kx=kx, ky=ky,
+                         sliding=sliding, padding=padding, **kwargs)
+        self.output_padding = output_padding
+
+    def forward_fn(self, x, weights, bias):
+        return F.deconv2d_forward(x, weights,
+                                  bias if self.include_bias else None,
+                                  self.sliding, self.padding, self.ACTIVATION,
+                                  self.output_padding)
+
+
+@register_layer_type("deconv")
+class Deconv(DeconvBase):
+    ACTIVATION = "linear"
+
+
+@register_layer_type("deconv_tanh")
+class DeconvTanh(DeconvBase):
+    ACTIVATION = "tanh"
+
+
+@register_layer_type("deconv_relu")
+class DeconvRELU(DeconvBase):
+    ACTIVATION = "relu"

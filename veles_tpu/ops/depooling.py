@@ -1,0 +1,32 @@
+"""Depooling (unpooling) unit — the autoencoder's pooling mirror.
+
+Ref: veles/znicz/depooling.py::Depooling [H] (SURVEY §2.3).  See
+``functional.depool`` for the positional-unpooling semantics that replace
+the reference's recorded-argmax scatter.
+"""
+
+from __future__ import annotations
+
+from veles_tpu.ops.nn_units import (TransformUnit, TransformGD,
+                                    register_layer_type, register_gd_for)
+from veles_tpu.ops import functional as F
+
+
+@register_layer_type("depooling")
+class Depooling(TransformUnit):
+    """Config: kx, ky (upsample factors), mode ("nearest" | "zero")."""
+
+    def __init__(self, workflow, kx=2, ky=2, mode="nearest", **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.kx = int(kx)
+        self.ky = int(ky)
+        self.mode = mode
+
+    def transform(self, x):
+        return F.depool(x, (self.ky, self.kx), self.mode)
+
+
+@register_gd_for(Depooling)
+class GDDepooling(TransformGD):
+    """Backward: vjp of the upsample (window-sum for "nearest", gather for
+    "zero") — the reverse of the reference's gd path through Depooling."""

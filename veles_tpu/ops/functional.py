@@ -184,6 +184,17 @@ def mse_loss(output, target, valid_mask):
 
 
 # -------------------------------------------------------------- convolution
+def _norm_padding(padding):
+    """"SAME"/"VALID" pass through; int or (int, int) become symmetric
+    per-dimension (lo, hi) pairs."""
+    if isinstance(padding, int):
+        return [(padding, padding), (padding, padding)]
+    if (isinstance(padding, (tuple, list)) and len(padding) == 2
+            and all(isinstance(p, int) for p in padding)):
+        return [(padding[0], padding[0]), (padding[1], padding[1])]
+    return padding
+
+
 def conv2d_forward(x, weights, bias, stride=(1, 1), padding="VALID",
                    activation="linear"):
     """2-D convolution, NHWC layout, weights HWIO (kh, kw, cin, cout).
@@ -192,17 +203,69 @@ def conv2d_forward(x, weights, bias, stride=(1, 1), padding="VALID",
     OpenCL — ref: veles/znicz/conv.py + ocl/conv.cl [H]); padding may be
     "SAME", "VALID", or an int/pair of ints applied symmetrically.
     """
-    if isinstance(padding, int):
-        padding = [(padding, padding), (padding, padding)]
-    elif (isinstance(padding, (tuple, list)) and len(padding) == 2
-          and all(isinstance(p, int) for p in padding)):
-        padding = [(padding[0], padding[0]), (padding[1], padding[1])]
+    padding = _norm_padding(padding)
     z = jax.lax.conv_general_dilated(
         x, weights, window_strides=tuple(stride), padding=padding,
         dimension_numbers=("NHWC", "HWIO", "NHWC"), precision=_PRECISION)
     if bias is not None:
         z = z + bias
     return activate(z, activation)
+
+
+# ---------------------------------------------------------- transposed conv
+def deconv2d_forward(x, weights, bias, stride=(1, 1), padding="SAME",
+                     activation="linear", output_padding=(0, 0)):
+    """Transposed 2-D convolution (deconvolution), NHWC/HWIO.
+
+    Upsamples spatially by ``stride``.  Ref: veles/znicz/deconv.py::Deconv
+    [H] (SURVEY §2.3) — the reference hand-wrote the scatter kernels; here
+    ``lax.conv_transpose`` lowers to an input-dilated conv on the MXU.
+    weights: (kh, kw, in_c, out_c).
+
+    Int/pair padding means THE TRANSPOSE OF a conv with that padding (the
+    autoencoder mirror: deconv(k, s, p) inverts conv(k, s, p)'s spatial
+    shape), i.e. the dilated input is raw-padded k-1-p per side —
+    lax.conv_transpose's explicit pads are raw, only its string forms
+    transpose automatically.  Conv's shape formula floors, so the mirror is
+    ambiguous by up to stride-1 pixels; ``output_padding`` (extra bottom/
+    right pixels, torch semantics) resolves it:
+    ``output_padding = (in + 2p - k) % s`` recovers ``in`` exactly.
+    """
+    padding = _norm_padding(padding)
+    if not isinstance(padding, str):
+        kh, kw = weights.shape[0], weights.shape[1]
+        oph, opw = ((output_padding, output_padding)
+                    if isinstance(output_padding, int) else output_padding)
+        padding = [(kh - 1 - padding[0][0], kh - 1 - padding[0][1] + oph),
+                   (kw - 1 - padding[1][0], kw - 1 - padding[1][1] + opw)]
+    z = jax.lax.conv_transpose(
+        x, weights, strides=tuple(stride), padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"), precision=_PRECISION)
+    if bias is not None:
+        z = z + bias
+    return activate(z, activation)
+
+
+# ------------------------------------------------------------------ depooling
+def depool(x, window=(2, 2), mode="nearest"):
+    """Unpooling: spatially upsample by the pooling window.
+
+    Ref: veles/znicz/depooling.py::Depooling [H].  The reference scattered
+    err values to max-pool argmax offsets recorded device-side; recording
+    cross-unit indices breaks functional purity, so the TPU-native unpooling
+    is positional: "nearest" replicates each value over its window (the
+    adjoint of avg-pooling up to the 1/k factor), "zero" places it top-left
+    and zero-fills (the adjoint of a fixed-offset max-pool).
+    """
+    kh, kw = window
+    if mode == "nearest":
+        return jnp.repeat(jnp.repeat(x, kh, axis=1), kw, axis=2)
+    if mode == "zero":
+        b, h, w, c = x.shape
+        out = jnp.zeros((b, h, kh, w, kw, c), x.dtype)
+        out = out.at[:, :, 0, :, 0, :].set(x)
+        return out.reshape(b, h * kh, w * kw, c)
+    raise ValueError("unknown depooling mode %r" % (mode,))
 
 
 # ------------------------------------------------------------------- pooling

@@ -1,0 +1,17 @@
+"""Gradient units for transposed convolution.
+
+Ref: veles/znicz/gd_deconv.py::GDDeconv [H] (SURVEY §2.3).  Backward is the
+exact vjp of the deconv forward (a plain strided conv for err_input — the
+transpose of a transpose), matching the reference's hand-written kernels.
+"""
+
+from __future__ import annotations
+
+from veles_tpu.ops.gd_conv import GradientDescentConvBase
+from veles_tpu.ops.nn_units import register_gd_for
+from veles_tpu.ops import deconv
+
+
+@register_gd_for(deconv.DeconvBase)
+class GDDeconv(GradientDescentConvBase):
+    pass
