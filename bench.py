@@ -66,12 +66,16 @@ def bench_tpu(wf, epochs=3):
     labels = loader.original_labels.devmem
     idx, mask = epoch_plan_arrays(loader)
     n_samples = int(mask.sum())
-    # warm-up epoch (compile)
-    state, totals = train_epoch(runner.state, data, labels, idx, mask)
+    steps_per_epoch = idx.shape[0]
+    # warm-up epoch (compile); step0 threads the global step so lr policies
+    # (when configured) decay across epochs instead of restarting
+    state, totals = train_epoch(runner.state, data, labels, idx, mask,
+                                step0=0)
     jax.block_until_ready(totals)
     begin = time.perf_counter()
-    for _ in range(epochs):
-        state, totals = train_epoch(state, data, labels, idx, mask)
+    for epoch in range(epochs):
+        state, totals = train_epoch(state, data, labels, idx, mask,
+                                    step0=(epoch + 1) * steps_per_epoch)
     jax.block_until_ready(totals)
     elapsed = time.perf_counter() - begin
     runner.state = state

@@ -1,0 +1,73 @@
+"""Kohonen SOM tests: winner search vs numpy oracle, update rule pull,
+convergence of the demo sample (SURVEY §4 tiers 2-3)."""
+
+import numpy
+
+import jax.numpy as jnp
+
+from veles_tpu.ops import functional as F
+from veles_tpu.ops.kohonen import grid_coords
+
+
+def rng(seed=0):
+    return numpy.random.RandomState(seed)
+
+
+class TestKohonenFunctional:
+    def test_winners_match_numpy(self):
+        r = rng(1)
+        x = r.randn(16, 4).astype(numpy.float32)
+        w = r.randn(9, 4).astype(numpy.float32)
+        winners, dmin = F.kohonen_winners(jnp.asarray(x), jnp.asarray(w))
+        d = ((x[:, None, :] - w[None, :, :]) ** 2).sum(-1)
+        numpy.testing.assert_array_equal(numpy.asarray(winners),
+                                         d.argmin(1))
+        numpy.testing.assert_allclose(numpy.asarray(dmin), d.min(1),
+                                      rtol=1e-4, atol=1e-4)
+
+    def test_update_pulls_winner_toward_sample(self):
+        w = numpy.zeros((4, 2), numpy.float32)
+        w[3] = [0.9, 0.9]
+        x = numpy.array([[1.0, 1.0]], numpy.float32)
+        mask = numpy.ones(1, numpy.float32)
+        grid = jnp.asarray(grid_coords(2, 2))
+        new_w, metrics = F.kohonen_update(
+            jnp.asarray(w), jnp.asarray(x), jnp.asarray(mask), grid,
+            jnp.asarray(0.5, jnp.float32), jnp.asarray(0.5, jnp.float32))
+        new_w = numpy.asarray(new_w)
+        # winner (neuron 3) moved halfway toward the sample
+        numpy.testing.assert_allclose(new_w[3], [0.95, 0.95], atol=1e-5)
+        # distant neurons moved much less than the winner
+        assert abs(new_w[0]).max() < 0.05
+        assert float(metrics["qe_sum"]) > 0
+
+    def test_masked_samples_do_not_update(self):
+        r = rng(2)
+        w = r.randn(4, 2).astype(numpy.float32)
+        x = r.randn(3, 2).astype(numpy.float32)
+        grid = jnp.asarray(grid_coords(2, 2))
+        dead = jnp.asarray(numpy.zeros(3, numpy.float32))
+        new_w, metrics = F.kohonen_update(
+            jnp.asarray(w), jnp.asarray(x), dead, grid,
+            jnp.asarray(0.5, jnp.float32), jnp.asarray(1.0, jnp.float32))
+        numpy.testing.assert_allclose(numpy.asarray(new_w), w, atol=1e-6)
+        assert float(metrics["qe_sum"]) == 0.0
+
+
+class TestKohonenSample:
+    def test_converges_and_spreads(self):
+        from veles_tpu.config import root
+        root.kohonen.update({
+            "loader": {"minibatch_size": 50, "n_train": 500},
+            "trainer": {"shape": (6, 6), "learning_rate": 0.3,
+                        "decay_steps": 100},
+            "decision": {"max_epochs": 5, "fail_iterations": 20},
+        })
+        from veles_tpu.samples import kohonen
+        wf = kohonen.train()
+        qerrs = [m["train"]["qerr"] for m in wf.decision.epoch_metrics]
+        assert len(qerrs) == 5
+        assert qerrs[-1] < qerrs[0], qerrs
+        # forward ran at completion and distributed wins over many neurons
+        assert wf.forward.hits.sum() > 0
+        assert (wf.forward.hits > 0).sum() >= 4

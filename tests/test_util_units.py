@@ -1,0 +1,176 @@
+"""LR policies + utility units (ZeroFiller, ResizableAll2All, ImageSaver,
+MeanDispNormalizer) — SURVEY §2.3 utility rows."""
+
+import numpy
+import pytest
+
+import jax.numpy as jnp
+
+from veles_tpu.ops.lr_adjust import make_policy
+
+
+class TestLRPolicies:
+    def t(self, v):
+        return jnp.asarray(v, jnp.int32)
+
+    def test_fixed(self):
+        fn = make_policy({"policy": "fixed"})
+        assert float(fn(0.1, self.t(500))) == pytest.approx(0.1)
+
+    def test_exp(self):
+        fn = make_policy({"policy": "exp", "gamma": 0.9})
+        assert float(fn(1.0, self.t(2))) == pytest.approx(0.81, rel=1e-5)
+
+    def test_step_exp(self):
+        fn = make_policy({"policy": "step_exp", "gamma": 0.5, "step": 10})
+        assert float(fn(1.0, self.t(9))) == pytest.approx(1.0)
+        assert float(fn(1.0, self.t(25))) == pytest.approx(0.25)
+
+    def test_inv(self):
+        fn = make_policy({"policy": "inv", "gamma": 0.1, "power": 1.0})
+        assert float(fn(1.0, self.t(10))) == pytest.approx(0.5, rel=1e-5)
+
+    def test_linear(self):
+        fn = make_policy({"policy": "linear", "final": 0.0, "steps": 100})
+        assert float(fn(1.0, self.t(50))) == pytest.approx(0.5, rel=1e-5)
+        assert float(fn(1.0, self.t(1000))) == pytest.approx(0.0, abs=1e-7)
+
+    def test_arbitrary(self):
+        fn = make_policy({"policy": "arbitrary",
+                          "points": [(0, 1.0), (10, 0.1), (20, 0.01)]})
+        assert float(fn(2.0, self.t(5))) == pytest.approx(2.0)
+        assert float(fn(2.0, self.t(15))) == pytest.approx(0.2, rel=1e-5)
+        assert float(fn(2.0, self.t(99))) == pytest.approx(0.02, rel=1e-5)
+
+    @pytest.mark.parametrize("fused", [True, False])
+    def test_policy_in_training(self, fused):
+        """MNIST-FC with exp decay trains and differs from fixed-lr run."""
+        from veles_tpu import prng
+        from veles_tpu.config import root
+
+        def run_once(policy):
+            prng.reset()
+            prng.seed_all(1)
+            layers = [
+                {"type": "all2all_tanh", "output_sample_shape": 32,
+                 "learning_rate": 0.05, "momentum": 0.9},
+                {"type": "softmax", "output_sample_shape": 10,
+                 "learning_rate": 0.05, "momentum": 0.9},
+            ]
+            if policy:
+                for layer in layers:
+                    layer["lr_policy"] = policy
+            root.mnist.update({
+                "loader": {"minibatch_size": 50, "n_train": 300,
+                           "n_valid": 100},
+                "decision": {"max_epochs": 3, "fail_iterations": 10},
+                "layers": layers,
+            })
+            from veles_tpu.samples import mnist
+            wf = mnist.train(fused=fused)
+            runner = getattr(wf, "_fused_runner", None)
+            if runner is not None:
+                runner.sync_to_units()
+            return (wf.forwards[0].weights.to_numpy().copy(),
+                    [m["validation"]["n_err"]
+                     for m in wf.decision.epoch_metrics])
+
+        w_fixed, errs_fixed = run_once(None)
+        w_decay, errs_decay = run_once({"policy": "exp", "gamma": 0.99})
+        assert errs_decay[-1] < errs_decay[0] * 1.2  # still trains
+        assert not numpy.allclose(w_fixed, w_decay)  # decay took effect
+
+
+class TestZeroFiller:
+    @pytest.mark.parametrize("fused", [True, False])
+    def test_mask_enforced_through_training(self, fused):
+        from veles_tpu import prng
+        from veles_tpu.config import root
+        from veles_tpu.ops.weights_zerofilling import ZeroFiller
+        prng.reset()
+        prng.seed_all(1)
+        root.mnist.update({
+            "loader": {"minibatch_size": 50, "n_train": 200, "n_valid": 50},
+            "decision": {"max_epochs": 2, "fail_iterations": 10},
+            "layers": [
+                {"type": "all2all_tanh", "output_sample_shape": 16,
+                 "learning_rate": 0.05, "momentum": 0.9},
+                {"type": "softmax", "output_sample_shape": 10,
+                 "learning_rate": 0.05, "momentum": 0.9},
+            ],
+        })
+        from veles_tpu.samples import mnist
+        wf = mnist.build(fused=fused)
+        mask = numpy.ones((784, 16), numpy.float32)
+        mask[::2, :] = 0.0   # kill every other input row
+        zf = ZeroFiller(wf, forward=wf.forwards[0], gd=wf.gds[0], mask=mask,
+                        name="zerofiller")
+        zf.link_from(wf.gds[0])
+        wf.initialize()
+        wf.run()
+        runner = getattr(wf, "_fused_runner", None)
+        if runner is not None:
+            runner.sync_to_units()
+        w = wf.forwards[0].weights.to_numpy()
+        assert numpy.abs(w[::2, :]).max() == 0.0
+        assert numpy.abs(w[1::2, :]).max() > 0.0
+
+
+class TestResizableAll2All:
+    def test_resize_preserves_overlap(self):
+        from veles_tpu.workflow import Workflow
+        from veles_tpu.memory import Vector
+        from veles_tpu.ops.resizable_all2all import ResizableAll2All
+        wf = Workflow(None, name="wf")
+        unit = ResizableAll2All(wf, output_sample_shape=4, name="fc")
+        unit.input = Vector(numpy.ones((2, 6), numpy.float32))
+        unit.initialize()
+        w_before = unit.weights.to_numpy().copy()
+        unit.resize(6)
+        unit.initialize()
+        assert unit.weights.shape == (6, 6)
+        numpy.testing.assert_allclose(unit.weights.to_numpy()[:, :4],
+                                      w_before)
+        unit.resize(3)
+        assert unit.weights.shape == (6, 3)
+        numpy.testing.assert_allclose(unit.weights.to_numpy(),
+                                      w_before[:, :3])
+
+
+class TestMeanDispNormalizer:
+    def test_transform(self):
+        from veles_tpu.workflow import Workflow
+        from veles_tpu.memory import Vector
+        from veles_tpu.ops.mean_disp_normalizer import MeanDispNormalizer
+        wf = Workflow(None, name="wf")
+        mean = numpy.array([1.0, 2.0], numpy.float32)
+        rdisp = numpy.array([0.5, 0.25], numpy.float32)
+        unit = MeanDispNormalizer(wf, mean=mean, rdisp=rdisp, name="norm")
+        unit.input = Vector(numpy.array([[3.0, 6.0]], numpy.float32))
+        unit.initialize()
+        unit.run()
+        numpy.testing.assert_allclose(unit.output.to_numpy(),
+                                      [[1.0, 1.0]], atol=1e-6)
+
+
+class TestImageSaver:
+    def test_saves_mispredictions(self, tmp_path):
+        from veles_tpu.workflow import Workflow
+        from veles_tpu.memory import Vector
+        from veles_tpu.ops.image_saver import ImageSaver
+        from veles_tpu.loader.base import VALID
+        wf = Workflow(None, name="wf")
+        saver = ImageSaver(wf, directory=str(tmp_path / "imgs"),
+                           name="image_saver")
+        saver.input = Vector(numpy.zeros((4, 16), numpy.float32))
+        probs = numpy.zeros((4, 3), numpy.float32)
+        probs[:, 0] = 1.0                       # predicts class 0 always
+        saver.output = Vector(probs)
+        saver.labels = Vector(numpy.array([0, 1, 2, 0], numpy.int32))
+        saver.indices = Vector(numpy.arange(4, dtype=numpy.int32))
+        saver.minibatch_class = VALID
+        saver.minibatch_size = 4
+        saver.initialize()
+        saver.run()
+        files = sorted(p.name for p in (tmp_path / "imgs").iterdir())
+        assert files == ["1_as_0_1.png", "2_as_0_2.png"]

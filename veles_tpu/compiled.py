@@ -97,7 +97,8 @@ class FusedRunner:
         _, metrics = self._loss(acts[-1], y_ref, mask)
         return metrics
 
-    def _train_step(self, state, x, y_ref, mask, batch_size, rng=None):
+    def _train_step(self, state, x, y_ref, mask, batch_size, rng=None,
+                    step=0):
         acts = self._forward_chain(state, x, rng=rng, train=True)
         err, metrics = self._loss(acts[-1], y_ref, mask)
         new_state = list(state)
@@ -106,7 +107,8 @@ class FusedRunner:
             err_in, grads = gd.backward_fused(
                 acts[i], acts[i + 1], err, entry, self._layer_rng(rng, i))
             if grads is not None:
-                new_state[i] = gd.update_fused(entry, grads, batch_size)
+                new_state[i] = gd.update_fused(entry, grads, batch_size,
+                                               step)
             err = err_in
         return new_state, metrics
 
@@ -115,7 +117,8 @@ class FusedRunner:
     # matrix with the dataset resident in HBM.  This is the pure TPU-native
     # steady state — zero host work between minibatches (the reference did
     # host scheduling + H2D upload per minibatch, SURVEY §3.1).
-    def _epoch_train(self, state, data, labels, idx, mask, rng=None):
+    def _epoch_train(self, state, data, labels, idx, mask, rng=None,
+                     step0=0):
         import jax
         import jax.numpy as jnp
 
@@ -129,7 +132,7 @@ class FusedRunner:
             step_rng = (jax.random.fold_in(rng, step)
                         if rng is not None else None)
             carry, metrics = self._train_step(carry, x, y, mb_mask, bs,
-                                              step_rng)
+                                              step_rng, step0 + step)
             return carry, metrics
 
         steps = jnp.arange(idx.shape[0])
@@ -161,12 +164,17 @@ class FusedRunner:
         if not hasattr(self, "_epoch_train_jit"):
             inner = jax.jit(self._epoch_train, donate_argnums=(0,))
 
-            def train_epoch(state, data, labels, idx, mask, rng=None):
+            def train_epoch(state, data, labels, idx, mask, rng=None,
+                            step0=0):
+                import jax.numpy as jnp
                 if self._has_stochastic and rng is None:
                     raise ValueError(
                         "this network has stochastic layers (dropout): "
                         "pass rng=jax.random.PRNGKey(...) to train_epoch")
-                return inner(state, data, labels, idx, mask, rng)
+                # int32 device scalar: a bare python int would retrace the
+                # epoch program once per distinct value
+                return inner(state, data, labels, idx, mask, rng,
+                             jnp.asarray(step0, jnp.int32))
 
             self._epoch_train_jit = train_epoch
             self._epoch_eval_jit = jax.jit(self._epoch_eval)
@@ -205,10 +213,14 @@ class FusedStep(Unit):
     end — mid-run host reads must go through the runner's state.
     """
 
+    snapshot_attrs = ("train_steps",)
+
     def __init__(self, workflow, runner, **kwargs):
         super().__init__(workflow, **kwargs)
         self.runner = runner
         self.pending_state = None
+        #: global train-minibatch counter feeding the lr policies
+        self.train_steps = 0
         self._initialized = True
 
     def initialize(self, **kwargs):
@@ -234,7 +246,9 @@ class FusedStep(Unit):
                 rng = None
             self.pending_state, metrics = runner._train(
                 runner.state, x, y_ref, mask,
-                jnp.asarray(loader.minibatch_size, jnp.int32), rng)
+                jnp.asarray(loader.minibatch_size, jnp.int32), rng,
+                jnp.asarray(self.train_steps, jnp.int32))
+            self.train_steps += 1
         else:
             self.pending_state = None
             metrics = runner._eval(runner.state, x, y_ref, mask)

@@ -91,8 +91,13 @@ class Vector:
     def unmap(self):
         """Push host writes to the device (no-op if already coherent)."""
         if self._state == _HOST and self._host is not None:
+            import jax
             import jax.numpy as jnp
-            self._dev = jnp.asarray(self._host)
+            # escape any active trace: otherwise a first devmem access from
+            # inside eval_shape/jit would cache a TRACER as the device copy,
+            # which leaks out of the trace and poisons later reads
+            with jax.ensure_compile_time_eval():
+                self._dev = jnp.asarray(self._host)
             self._state = _BOTH
         return self
 

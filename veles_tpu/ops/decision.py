@@ -54,12 +54,17 @@ class DecisionBase(Unit):
         self._current = {}
 
     # -- per-minibatch -------------------------------------------------------
+    def should_skip_gd(self, cls):
+        """Gate the weight update off for this minibatch class (unsupervised
+        decisions override: their trainers have no backward to gate)."""
+        return cls != TRAIN
+
     def run(self):
         cls = self.minibatch_class
         if self._last_class is not None and cls != self._last_class:
             self._finalize_class(self._last_class)
         self._last_class = cls
-        self.gd_skip.set(cls != TRAIN)
+        self.gd_skip.set(self.should_skip_gd(cls))
         acc = self._acc.setdefault(cls, [])
         acc.append(self.metrics)
         self._seen[cls] = self._seen.get(cls, 0) + int(self.minibatch_size)

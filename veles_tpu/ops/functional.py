@@ -367,6 +367,96 @@ def dropout(x, rng, rate, train):
     return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
 
 
+# ----------------------------------------------------------------- kohonen
+def kohonen_distances(x, weights):
+    """Squared euclidean distances (mb, n_neurons) between samples and SOM
+    codebook vectors; the cross term is a GEMM so the MXU carries the load.
+    Ref: veles/znicz/kohonen.py [H] (SURVEY §2.3)."""
+    x = x.reshape(x.shape[0], -1)
+    x2 = (x * x).sum(axis=1)[:, None]
+    w2 = (weights * weights).sum(axis=1)[None, :]
+    return x2 - 2.0 * matmul(x, weights.T) + w2
+
+
+def kohonen_winners(x, weights):
+    """(winner_index, min_sq_distance) per sample — the SOM forward."""
+    d = kohonen_distances(x, weights)
+    return jnp.argmin(d, axis=1), d.min(axis=1)
+
+
+def kohonen_update(weights, x, mask, grid, learning_rate, sigma):
+    """One batch SOM update: each neuron moves toward the samples it (or a
+    grid neighbor) won, weighted by a Gaussian neighborhood.
+
+        w_n += lr/B * Σ_b h(b, n) (x_b - w_n),
+        h(b, n) = exp(-||grid_n - grid_win(b)||² / (2σ²))
+
+    Batch-parallel reformulation of the reference's per-sample "gravity"
+    kernel (ref: veles/znicz/kohonen.py::KohonenTrainer + ocl kernels [H]);
+    both matmuls (winner search + neighborhood gather) hit the MXU.
+
+    Returns (new_weights, metrics) with the quantization-error sum
+    (mean min-distance is the SOM's convergence measure).
+    """
+    x = x.reshape(x.shape[0], -1)
+    d = kohonen_distances(x, weights)
+    winners = jnp.argmin(d, axis=1)
+    qe_sum = (jnp.sqrt(jnp.maximum(d.min(axis=1), 0.0)) * mask).sum()
+    wcoord = jnp.take(grid, winners, axis=0)            # (mb, 2)
+    gd2 = ((grid[None, :, :] - wcoord[:, None, :]) ** 2).sum(-1)
+    h = jnp.exp(-gd2 / (2.0 * sigma * sigma)) * mask[:, None]
+    batch = jnp.maximum(mask.sum(), 1.0)
+    num = matmul(h.T, x)                                # (n_neurons, n_in)
+    den = h.sum(axis=0)[:, None]
+    new_w = weights + learning_rate * (num - den * weights) / batch
+    return new_w, {"qe_sum": qe_sum, "loss_sum": qe_sum}
+
+
+# ---------------------------------------------------------------------- rbm
+def rbm_hidden(v, weights, hbias):
+    """P(h=1 | v) — sigmoid(v @ W + hb).  Ref: veles/znicz/rbm_units.py [M]
+    (SURVEY §2.3): the reference split CD over several units (Binarization,
+    BatchWeights, GradientsCalculator, WeightsUpdater); here the whole CD-k
+    step is one fused function (rbm_cd_step)."""
+    return jax.nn.sigmoid(matmul(v.reshape(v.shape[0], -1), weights) + hbias)
+
+
+def rbm_visible(h, weights, vbias):
+    """P(v=1 | h) — sigmoid(h @ W^T + vb)."""
+    return jax.nn.sigmoid(matmul(h, weights.T) + vbias)
+
+
+def rbm_cd_step(weights, vbias, hbias, v0, mask, rng, learning_rate,
+                cd_k=1):
+    """One contrastive-divergence (CD-k) update on a (0/1-ish) batch.
+
+    Positive phase from the data, negative phase from k Gibbs steps with
+    Bernoulli-sampled hiddens (probabilities, not samples, are used for the
+    final statistics — standard Hinton recipe, matching the reference's
+    gradient calculator).  Gradients are batch means; masked rows contribute
+    nothing.  Returns (new_w, new_vb, new_hb, metrics) with the summed
+    per-sample reconstruction error.
+    """
+    v0 = v0.reshape(v0.shape[0], -1)
+    m = mask[:, None]
+    batch = jnp.maximum(mask.sum(), 1.0)
+    h0 = rbm_hidden(v0, weights, hbias)
+    vk, hk = v0, h0
+    for i in range(cd_k):
+        h_samp = jax.random.bernoulli(
+            jax.random.fold_in(rng, i), hk).astype(v0.dtype)
+        vk = rbm_visible(h_samp, weights, vbias)
+        hk = rbm_hidden(vk, weights, hbias)
+    grad_w = (matmul((v0 * m).T, h0) - matmul((vk * m).T, hk)) / batch
+    grad_vb = ((v0 - vk) * m).sum(axis=0) / batch
+    grad_hb = ((h0 - hk) * m).sum(axis=0) / batch
+    recon = jnp.sqrt((((v0 - vk) * m) ** 2).sum(axis=1))
+    return (weights + learning_rate * grad_w,
+            vbias + learning_rate * grad_vb,
+            hbias + learning_rate * grad_hb,
+            {"recon_sum": recon.sum(), "loss_sum": recon.sum()})
+
+
 # ------------------------------------------------------------------- updates
 def sgd_update(param, velocity, grad, batch_size, learning_rate, momentum,
                weight_decay, l1_vs_l2, gradient_clip):

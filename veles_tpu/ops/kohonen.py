@@ -1,0 +1,160 @@
+"""Kohonen self-organizing map units (unsupervised).
+
+Ref: veles/znicz/kohonen.py::KohonenForward/KohonenTrainer [H]
+(SURVEY §2.3).  These exercise the framework's claim to be more than an SGD
+trainer (SURVEY §7 stage 6): the trainer owns a custom non-gradient update
+rule executed as one jitted call per minibatch, with learning-rate and
+neighborhood-radius decay schedules on the host.
+"""
+
+from __future__ import annotations
+
+import numpy
+
+from veles_tpu.accel import AcceleratedUnit
+from veles_tpu.memory import Vector
+from veles_tpu.workflow import DeferredInitError
+from veles_tpu.ops import functional as F
+from veles_tpu.ops.decision import DecisionBase
+from veles_tpu import prng
+
+
+def grid_coords(sy, sx):
+    """(sy*sx, 2) float32 grid coordinates, row-major like the reference's
+    rectangular SOM layout."""
+    yy, xx = numpy.mgrid[0:sy, 0:sx]
+    return numpy.stack([yy.ravel(), xx.ravel()], axis=1).astype(numpy.float32)
+
+
+class KohonenTrainer(AcceleratedUnit):
+    """SOM trainer: shape (sy, sx) codebook over the input features.
+
+    Decay schedules follow the reference's time-parameterized form
+    (ref: veles/znicz/kohonen.py gradient/radius decay [H]):
+    ``lr(t) = lr0 / (1 + t/T)`` and ``σ(t) = max(σ0 / (1 + t/T), σ_min)``
+    with t counted in minibatches and T = ``decay_steps``.
+    """
+
+    snapshot_attrs = ("weights", "time")
+
+    def __init__(self, workflow, shape=(8, 8), learning_rate=0.2,
+                 sigma=None, sigma_min=0.5, decay_steps=1000,
+                 weights_filling="uniform", weights_stddev=0.1, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.shape = tuple(shape)
+        self.learning_rate0 = float(learning_rate)
+        self.sigma0 = float(sigma) if sigma else max(self.shape) / 2.0
+        self.sigma_min = float(sigma_min)
+        self.decay_steps = int(decay_steps)
+        self.weights_filling = weights_filling
+        self.weights_stddev = weights_stddev
+        self.weights = Vector()
+        self.time = 0
+        self.metrics = {}
+        # self.input linked from the loader's minibatch_data; self.mask from
+        # minibatch_mask
+
+    @property
+    def n_neurons(self):
+        return self.shape[0] * self.shape[1]
+
+    def initialize(self, device=None, **kwargs):
+        if not hasattr(self, "input") or self.input.is_empty:
+            raise DeferredInitError(self.name)
+        n_in = int(numpy.prod(self.input.shape[1:]))
+        if self.weights.is_empty:
+            stream = prng.get("init")
+            w = numpy.zeros((self.n_neurons, n_in), self.dtype)
+            if self.weights_filling == "uniform":
+                stream.fill(w, -self.weights_stddev, self.weights_stddev)
+            else:
+                stream.fill_normal(w, 0.0, self.weights_stddev)
+            self.weights.reset(w)
+        grid = grid_coords(*self.shape)
+
+        def update(weights, x, mask, lr, sigma):
+            import jax.numpy as jnp
+            return F.kohonen_update(weights, x, mask, jnp.asarray(grid),
+                                    lr, sigma)
+
+        self._upd = self.jit("update", update)
+        super().initialize(device=device, **kwargs)
+
+    def schedules(self):
+        t = self.time / max(self.decay_steps, 1)
+        lr = self.learning_rate0 / (1.0 + t)
+        sigma = max(self.sigma0 / (1.0 + t), self.sigma_min)
+        return lr, sigma
+
+    def run(self):
+        import jax.numpy as jnp
+        lr, sigma = self.schedules()
+        new_w, metrics = self._upd(
+            self.weights.devmem, self.input.devmem, self.mask.devmem,
+            jnp.asarray(lr, self.dtype), jnp.asarray(sigma, self.dtype))
+        self.weights.assign_device(new_w)
+        self.metrics = metrics
+        self.time += 1
+
+
+class KohonenForward(AcceleratedUnit):
+    """SOM forward: winner index (+ min distance) per sample.
+
+    Ref: veles/znicz/kohonen.py::KohonenForward [H].  ``weights`` is
+    link_attrs'd from the trainer; ``output`` holds the winner indices and
+    ``distances`` the per-sample quantization errors; ``hits`` accumulates
+    per-neuron win counts across calls (the KohonenHits plotting source).
+    """
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.output = Vector()
+        self.distances = Vector()
+        self.hits = None
+
+    def initialize(self, device=None, **kwargs):
+        if not hasattr(self, "input") or self.input.is_empty:
+            raise DeferredInitError(self.name)
+        if not hasattr(self, "weights") or self.weights.is_empty:
+            raise DeferredInitError(self.name)
+        mb = self.input.shape[0]
+        self.output.reset(numpy.zeros(mb, numpy.int32))
+        self.distances.reset(numpy.zeros(mb, self.dtype))
+        self.hits = numpy.zeros(self.weights.shape[0], numpy.int64)
+        self._fwd = self.jit("fwd", F.kohonen_winners)
+        super().initialize(device=device, **kwargs)
+
+    def reset_hits(self):
+        self.hits[:] = 0
+
+    def run(self):
+        winners, dmin = self._fwd(self.input.devmem, self.weights.devmem)
+        self.output.assign_device(winners)
+        self.distances.assign_device(dmin)
+        live = numpy.asarray(winners)
+        # short minibatches are padded with duplicates of row 0 (masked
+        # dead) — counting them would inflate that row's winner
+        if hasattr(self, "mask") and not self.mask.is_empty:
+            live = live[numpy.asarray(self.mask.to_numpy()) > 0]
+        numpy.add.at(self.hits, live, 1)
+
+
+class KohonenDecision(DecisionBase):
+    """Tracks the epoch quantization error; improvement = lower mean QE.
+
+    The SOM update runs on every minibatch (no gd_skip gating off-train —
+    there is no backward pass to gate), so gd_skip stays False.
+    """
+
+    def should_skip_gd(self, cls):
+        return False
+
+    def reduce_metrics(self, host_totals):
+        out = super().reduce_metrics(host_totals)
+        count = max(out.get("count", 1), 1)
+        if "qe_sum" in out:
+            out["qerr"] = out.pop("qe_sum") / count
+        return out
+
+    def epoch_metric(self, set_metrics):
+        return set_metrics.get("qerr")

@@ -1,0 +1,120 @@
+"""Learning-rate adjustment policies.
+
+Ref: veles/znicz/lr_adjust.py::LearningRateAdjust + policy classes [M]
+(SURVEY §2.3).  The reference mutated each GD unit's learning rate from a
+policy object between iterations; under XLA that would retrace the step, so
+TPU-native policies are PURE functions ``lr(lr0, t)`` of the traced global
+step — they compile INTO the training step and cost nothing per iteration.
+
+Config: a GD unit (or layer config) takes ``lr_policy={"policy": <name>,
+...params}``; every policy below mirrors a reference policy class.
+"""
+
+from __future__ import annotations
+
+
+def make_policy(spec):
+    """Build ``fn(lr0, t) -> lr`` from a policy spec dict (or pass through a
+    callable)."""
+    if spec is None:
+        return None
+    if callable(spec):
+        return spec
+    spec = dict(spec)
+    name = spec.pop("policy")
+    maker = _POLICIES.get(name)
+    if maker is None:
+        raise ValueError("unknown lr policy %r (known: %s)" %
+                         (name, ", ".join(sorted(_POLICIES))))
+    return maker(**spec)
+
+
+_POLICIES = {}
+
+
+def _register(name):
+    def deco(fn):
+        _POLICIES[name] = fn
+        return fn
+    return deco
+
+
+@_register("fixed")
+def fixed():
+    """Constant lr (ref: FixedAjustPolicy)."""
+    def fn(lr0, t):
+        return lr0
+    return fn
+
+
+@_register("exp")
+def exp(gamma=0.999):
+    """lr0 * gamma^t (ref: ExpPolicy)."""
+    def fn(lr0, t):
+        import jax.numpy as jnp
+        return lr0 * jnp.power(gamma, t.astype(jnp.float32))
+    return fn
+
+
+@_register("step_exp")
+def step_exp(gamma=0.5, step=1000):
+    """lr0 * gamma^(t // step) — staircase decay (ref: StepExpPolicy)."""
+    def fn(lr0, t):
+        import jax.numpy as jnp
+        return lr0 * jnp.power(gamma, (t // step).astype(jnp.float32))
+    return fn
+
+
+@_register("inv")
+def inv(gamma=0.0001, power=0.75):
+    """lr0 * (1 + gamma t)^-power — Caffe-style inv decay (ref: InvPolicy)."""
+    def fn(lr0, t):
+        import jax.numpy as jnp
+        return lr0 * jnp.power(1.0 + gamma * t.astype(jnp.float32), -power)
+    return fn
+
+
+@_register("linear")
+def linear(final=0.0, steps=10000):
+    """Linear ramp from lr0 to ``final`` over ``steps``, then flat."""
+    def fn(lr0, t):
+        import jax.numpy as jnp
+        frac = jnp.clip(t.astype(jnp.float32) / float(steps), 0.0, 1.0)
+        return lr0 + (final - lr0) * frac
+    return fn
+
+
+@_register("arbitrary")
+def arbitrary(points=()):
+    """Piecewise-constant: ``points`` is a sequence of (t_from, lr_scale);
+    the scale of the last point whose t_from <= t applies (scale multiplies
+    lr0) — ref: ArbitraryStepPolicy."""
+    points = sorted(points)
+
+    def fn(lr0, t):
+        import jax.numpy as jnp
+        scale = jnp.asarray(1.0, jnp.float32)
+        for t_from, s in points:
+            scale = jnp.where(t >= t_from, jnp.asarray(s, jnp.float32),
+                              scale)
+        return lr0 * scale
+    return fn
+
+
+class LearningRateAdjust:
+    """Build-time helper with the reference unit's name: assigns a policy to
+    a set of GD units (the policy then runs inside the jitted step).
+
+    Usage: ``LearningRateAdjust(spec).apply_to(workflow.gds)`` before
+    ``initialize`` — kept for API parity with the reference's graph unit,
+    which mutated lrs between steps.
+    """
+
+    def __init__(self, lr_policy=None, bias_lr_policy=None):
+        self.lr_policy = lr_policy
+        self.bias_lr_policy = bias_lr_policy
+
+    def apply_to(self, gds):
+        for gd in gds:
+            gd.set_lr_policy(self.lr_policy, self.bias_lr_policy)
+        return gds

@@ -1,0 +1,52 @@
+"""MeanDispNormalizer — on-device input standardization unit.
+
+Ref: veles/znicz/mean_disp_normalizer.py::MeanDispNormalizer [M]
+(SURVEY §2.3): y = (x - mean) * rdisp with a precomputed mean sample and
+reciprocal-dispersion array (the device-side half of the ImageNet pipeline's
+mean-subtraction).  A weightless TransformUnit, so its backward is the vjp
+like every other transform.
+"""
+
+from __future__ import annotations
+
+import numpy
+
+from veles_tpu.memory import Vector
+from veles_tpu.workflow import DeferredInitError
+from veles_tpu.ops.nn_units import (TransformUnit, TransformGD,
+                                    register_layer_type, register_gd_for)
+
+
+@register_layer_type("mean_disp_normalizer")
+class MeanDispNormalizer(TransformUnit):
+    """``mean`` and ``rdisp`` are sample-shaped Vectors (set directly or
+    link_attrs'd from a pipeline unit)."""
+
+    def __init__(self, workflow, mean=None, rdisp=None, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.mean = Vector(numpy.asarray(mean, numpy.float32)
+                           if mean is not None else None)
+        self.rdisp = Vector(numpy.asarray(rdisp, numpy.float32)
+                            if rdisp is not None else None)
+
+    def initialize(self, device=None, **kwargs):
+        if self.mean.is_empty or self.rdisp.is_empty:
+            raise DeferredInitError(self.name)
+        super().initialize(device=device, **kwargs)
+
+    def transform(self, x):
+        # NOTE: in the fused chain mean/rdisp trace in as device constants —
+        # they must be set before initialize and are fixed for the run (the
+        # reference computed them once in the pipeline, same contract);
+        # unit-mode run() below passes them as live arguments instead.
+        return (x - self.mean.devmem) * self.rdisp.devmem
+
+    def run(self):
+        fn = self.jit("fwd_args", lambda x, m, r: (x - m) * r)
+        self.output.assign_device(fn(self.input.devmem, self.mean.devmem,
+                                     self.rdisp.devmem))
+
+
+@register_gd_for(MeanDispNormalizer)
+class GDMeanDispNormalizer(TransformGD):
+    pass

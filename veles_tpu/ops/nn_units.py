@@ -298,17 +298,22 @@ class GradientDescentBase(AcceleratedUnit):
     Hyperparameters are per-unit (ref: veles/znicz/gd.py [H]).
     """
 
-    snapshot_attrs = ("velocity_weights", "velocity_bias")
+    snapshot_attrs = ("velocity_weights", "velocity_bias", "time")
 
     def __init__(self, workflow, forward=None, learning_rate=0.01,
                  learning_rate_bias=None, momentum=0.0, weight_decay=0.0,
                  weight_decay_bias=0.0, l1_vs_l2=0.0, gradient_clip=None,
-                 need_err_input=True, **kwargs):
+                 need_err_input=True, lr_policy=None, bias_lr_policy=None,
+                 weights_mask=None, **kwargs):
         super().__init__(workflow, **kwargs)
         self.forward = forward
         self.learning_rate = learning_rate
         self.learning_rate_bias = (learning_rate if learning_rate_bias is None
                                    else learning_rate_bias)
+        self.set_lr_policy(lr_policy, bias_lr_policy)
+        #: optional 0/1 sparse-connectivity mask multiplied into the weights
+        #: after every update (ref: veles/znicz/weights_zerofilling.py [M])
+        self.weights_mask = weights_mask
         self.momentum = momentum
         self.weight_decay = weight_decay
         self.weight_decay_bias = weight_decay_bias
@@ -322,8 +327,21 @@ class GradientDescentBase(AcceleratedUnit):
         self.velocity_bias = Vector()
         if forward is not None:
             self.link_attrs(forward, "weights", "bias", "input", "output")
+        #: iteration counter for lr policies in unit mode (fused mode passes
+        #: the FusedStep's global counter instead)
+        self.time = 0
         # self.err_output is link_attrs'd from the next GD unit's err_input
         # (or the evaluator's err_output); self.batch_size from the loader.
+
+    def set_lr_policy(self, lr_policy, bias_lr_policy=None):
+        """Attach lr(t) decay policies (see veles_tpu.ops.lr_adjust); they
+        trace into the jitted step as pure functions of the step counter."""
+        from veles_tpu.ops.lr_adjust import make_policy
+        self.lr_policy = lr_policy
+        self.bias_lr_policy = bias_lr_policy
+        self._lr_fn = make_policy(lr_policy)
+        self._lr_bias_fn = (make_policy(bias_lr_policy)
+                            if bias_lr_policy is not None else self._lr_fn)
 
     def initialize(self, device=None, **kwargs):
         fwd = self.forward
@@ -360,27 +378,45 @@ class GradientDescentBase(AcceleratedUnit):
                                                   entry["w"], entry.get("b"))
         return err_in, (grad_w, grad_b)
 
-    def update_fused(self, entry, grads, batch_size):
+    def update_fused(self, entry, grads, batch_size, step=0):
         grad_w, grad_b = grads
         new_w, new_b, new_vw, new_vb = self.update_fn(
             entry["w"], entry.get("b"), entry["vw"], entry.get("vb"),
-            grad_w, grad_b, batch_size)
+            grad_w, grad_b, batch_size, step)
         new_entry = {"w": new_w, "vw": new_vw}
         if new_b is not None:
             new_entry["b"] = new_b
             new_entry["vb"] = new_vb
         return new_entry
 
+    def _live_lrs(self, step):
+        """(lr_weights, lr_bias) — constants, or policy curves of the traced
+        global step.  Weight and bias policies are independent (either may
+        be unset)."""
+        import jax.numpy as jnp
+        if self._lr_fn is None and self._lr_bias_fn is None:
+            return self.learning_rate, self.learning_rate_bias
+        t = jnp.asarray(step)
+        lr_w = (self._lr_fn(self.learning_rate, t)
+                if self._lr_fn is not None else self.learning_rate)
+        lr_b = (self._lr_bias_fn(self.learning_rate_bias, t)
+                if self._lr_bias_fn is not None else self.learning_rate_bias)
+        return lr_w, lr_b
+
     def update_fn(self, weights, bias, vel_w, vel_b, grad_w, grad_b,
-                  batch_size):
+                  batch_size, step=0):
+        lr_w, lr_b = self._live_lrs(step)
         new_w, new_vw = F.sgd_update(
-            weights, vel_w, grad_w, batch_size, self.learning_rate,
+            weights, vel_w, grad_w, batch_size, lr_w,
             self.momentum, self.weight_decay, self.l1_vs_l2,
             self.gradient_clip)
+        if self.weights_mask is not None:
+            import jax.numpy as jnp
+            new_w = new_w * jnp.asarray(self.weights_mask, new_w.dtype)
         if grad_b is None:
             return new_w, None, new_vw, None
         new_b, new_vb = F.sgd_update(
-            bias, vel_b, grad_b, batch_size, self.learning_rate_bias,
+            bias, vel_b, grad_b, batch_size, lr_b,
             self.momentum, self.weight_decay_bias, self.l1_vs_l2,
             self.gradient_clip)
         return new_w, new_b, new_vw, new_vb
@@ -399,7 +435,9 @@ class GradientDescentBase(AcceleratedUnit):
             fwd.bias.devmem if fwd.include_bias else None,
             self.velocity_weights.devmem,
             self.velocity_bias.devmem if fwd.include_bias else None,
-            grad_w, grad_b, jnp.asarray(int(self.batch_size)))
+            grad_w, grad_b, jnp.asarray(int(self.batch_size)),
+            jnp.asarray(self.time, jnp.int32))
+        self.time += 1
         fwd.weights.assign_device(new_w)
         self.velocity_weights.assign_device(new_vw)
         if fwd.include_bias:

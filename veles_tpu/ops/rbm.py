@@ -1,0 +1,123 @@
+"""Restricted Boltzmann machine units (contrastive-divergence training).
+
+Ref: veles/znicz/rbm_units.py [M] (SURVEY §2.3): the reference decomposed
+CD into a chain of units (Binarization → BatchWeights → GradientsCalculator
+→ WeightsUpdater); TPU-native, the whole CD-k step is ONE jitted call per
+minibatch (``functional.rbm_cd_step``) — another non-SGD update rule living
+in the same training-cycle shape as Kohonen (SURVEY §7 stage 6).
+"""
+
+from __future__ import annotations
+
+import numpy
+
+from veles_tpu import prng
+from veles_tpu.accel import AcceleratedUnit
+from veles_tpu.memory import Vector
+from veles_tpu.workflow import DeferredInitError
+from veles_tpu.ops import functional as F
+from veles_tpu.ops.kohonen import KohonenDecision
+
+
+class RBMTrainer(AcceleratedUnit):
+    """CD-k trainer owning (weights, vbias, hbias).
+
+    ``input`` is expected in [0, 1] (probability scale — use a loader whose
+    normalizer maps there, or the raw [0,255]/255 convention).
+    """
+
+    snapshot_attrs = ("weights", "vbias", "hbias", "time")
+
+    def __init__(self, workflow, n_hidden=128, learning_rate=0.05, cd_k=1,
+                 weights_stddev=0.01, binarize_input=True, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.n_hidden = int(n_hidden)
+        self.learning_rate = float(learning_rate)
+        self.cd_k = int(cd_k)
+        self.weights_stddev = float(weights_stddev)
+        #: Bernoulli-sample the visible layer per step (the reference's
+        #: Binarization unit)
+        self.binarize_input = binarize_input
+        self.weights = Vector()
+        self.vbias = Vector()
+        self.hbias = Vector()
+        self.time = 0
+        self.metrics = {}
+
+    def initialize(self, device=None, **kwargs):
+        if not hasattr(self, "input") or self.input.is_empty:
+            raise DeferredInitError(self.name)
+        n_vis = int(numpy.prod(self.input.shape[1:]))
+        if self.weights.is_empty:
+            stream = prng.get("init")
+            w = numpy.zeros((n_vis, self.n_hidden), self.dtype)
+            stream.fill_normal(w, 0.0, self.weights_stddev)
+            self.weights.reset(w)
+            self.vbias.reset(numpy.zeros(n_vis, self.dtype))
+            self.hbias.reset(numpy.zeros(self.n_hidden, self.dtype))
+
+        def step(w, vb, hb, v, mask, rng):
+            import jax
+            import jax.numpy as jnp
+            v = v.reshape(v.shape[0], -1)
+            if self.binarize_input:
+                v = jax.random.bernoulli(
+                    jax.random.fold_in(rng, 0xB1), v).astype(w.dtype)
+            return F.rbm_cd_step(w, vb, hb, v, mask,
+                                 jax.random.fold_in(rng, 1),
+                                 jnp.asarray(self.learning_rate, w.dtype),
+                                 self.cd_k)
+
+        self._step = self.jit("cd", step)
+        super().initialize(device=device, **kwargs)
+
+    def run(self):
+        key = prng.get("rbm").key()
+        new_w, new_vb, new_hb, metrics = self._step(
+            self.weights.devmem, self.vbias.devmem, self.hbias.devmem,
+            self.input.devmem, self.mask.devmem, key)
+        self.weights.assign_device(new_w)
+        self.vbias.assign_device(new_vb)
+        self.hbias.assign_device(new_hb)
+        self.metrics = metrics
+        self.time += 1
+
+
+class RBMForward(AcceleratedUnit):
+    """Hidden-probability forward: output = P(h=1 | input).
+
+    ``weights``/``hbias`` link_attrs'd from the trainer (or a snapshot).
+    """
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.output = Vector()
+
+    def initialize(self, device=None, **kwargs):
+        if not hasattr(self, "input") or self.input.is_empty:
+            raise DeferredInitError(self.name)
+        if not hasattr(self, "weights") or self.weights.is_empty:
+            raise DeferredInitError(self.name)
+        mb = self.input.shape[0]
+        self.output.reset(numpy.zeros((mb, self.weights.shape[1]),
+                                      self.dtype))
+        self._fwd = self.jit("fwd", F.rbm_hidden)
+        super().initialize(device=device, **kwargs)
+
+    def run(self):
+        self.output.assign_device(self._fwd(
+            self.input.devmem, self.weights.devmem, self.hbias.devmem))
+
+
+class RBMDecision(KohonenDecision):
+    """Epoch bookkeeping on the reconstruction error."""
+
+    def reduce_metrics(self, host_totals):
+        out = super().reduce_metrics(host_totals)
+        count = max(out.get("count", 1), 1)
+        if "recon_sum" in out:
+            out["recon_err"] = out.pop("recon_sum") / count
+        return out
+
+    def epoch_metric(self, set_metrics):
+        return set_metrics.get("recon_err")

@@ -1,0 +1,57 @@
+"""ZeroFiller — sparse-connectivity weight masking.
+
+Ref: veles/znicz/weights_zerofilling.py::ZeroFiller [M] (SURVEY §2.3): keeps
+a 0/1 mask over a forward unit's weights and re-zeroes the masked entries
+after every update (grouped/blocked connectivity, AlexNet's grouped convs).
+TPU-native: the mask multiplies into the jitted update (GD's
+``weights_mask``), so enforcement costs one fused elementwise op; this unit
+exists for graph parity and owns the mask's lifecycle.
+"""
+
+from __future__ import annotations
+
+import numpy
+
+from veles_tpu.units import Unit
+from veles_tpu.workflow import DeferredInitError
+
+
+class ZeroFiller(Unit):
+    """Attach to a (forward, gd) pair: ``mask`` is a 0/1 array of the
+    forward's weight shape (or a callable shape -> mask)."""
+
+    snapshot_attrs = ("mask",)
+
+    def __init__(self, workflow, forward=None, gd=None, mask=None, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.forward = forward
+        self.gd = gd
+        self.mask = mask
+
+    def initialize(self, device=None, **kwargs):
+        if self.forward is None or self.forward.weights.is_empty:
+            raise DeferredInitError(self.name)
+        shape = self.forward.weights.shape
+        if callable(self.mask):
+            self.mask = self.mask(shape)
+        if self.mask is None:
+            raise ValueError("%s: a mask (array or shape->array callable) "
+                             "is required" % self.name)
+        self.mask = numpy.asarray(self.mask, self.forward.weights.dtype)
+        if self.mask.shape != shape:
+            raise ValueError("%s: mask shape %s != weights shape %s"
+                             % (self.name, self.mask.shape, shape))
+        # initial enforcement + fused-path wiring
+        self.forward.weights.reset(
+            numpy.asarray(self.forward.weights.to_numpy()) * self.mask)
+        if self.gd is not None:
+            self.gd.weights_mask = self.mask
+        super().initialize(device=device, **kwargs)
+
+    def run(self):
+        # unit-mode safety net: if no gd is wired (inference graphs), keep
+        # the weights masked
+        if self.gd is None:
+            import jax.numpy as jnp
+            self.forward.weights.assign_device(
+                self.forward.weights.devmem * jnp.asarray(self.mask))

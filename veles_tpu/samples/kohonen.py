@@ -1,0 +1,120 @@
+"""Kohonen SOM demo sample — BASELINE.json config[3] (Kohonen part).
+
+Ref: veles/znicz/samples/Kohonen/kohonen.py [H] (SURVEY §2.3 samples):
+unsupervised SOM on 2-D point clouds.  The workflow is a NON-SGD training
+cycle — Repeater → Loader → KohonenTrainer → KohonenDecision — proving the
+graph core is not hardwired to the forward/evaluator/gd shape.
+"""
+
+from __future__ import annotations
+
+import numpy
+
+from veles_tpu import prng
+from veles_tpu.config import root, get
+from veles_tpu.loader.fullbatch import FullBatchLoader
+from veles_tpu.ops.kohonen import (KohonenTrainer, KohonenForward,
+                                   KohonenDecision)
+from veles_tpu.ops.nn_units import NNWorkflow
+from veles_tpu.workflow import Repeater
+
+
+class KohonenLoader(FullBatchLoader):
+    """Synthetic 2-D point cloud: a few Gaussian blobs (stream
+    "kohonen_synth"), train-set only — the SOM is unsupervised."""
+
+    def __init__(self, workflow, n_train=2000, n_blobs=5, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.n_train = n_train
+        self.n_blobs = n_blobs
+        self.has_labels = False
+
+    def load_data(self):
+        stream = prng.get("kohonen_synth")
+        centers = stream.uniform(-1.0, 1.0, (self.n_blobs, 2)).astype(
+            numpy.float32)
+        which = numpy.arange(self.n_train) % self.n_blobs
+        noise = stream.normal(0.0, 0.15, (self.n_train, 2)).astype(
+            numpy.float32)
+        self.original_data.reset(centers[which] + noise)
+        self.class_lengths = [0, 0, self.n_train]
+
+
+class KohonenWorkflow(NNWorkflow):
+    """The unsupervised SOM training cycle."""
+
+    def __init__(self, workflow=None, name=None, loader_config=None,
+                 trainer_config=None, decision_config=None, **kwargs):
+        super().__init__(workflow, name=name, **kwargs)
+        self.repeater = Repeater(self, name="repeater")
+        self.repeater.link_from(self.start_point)
+
+        self.loader = KohonenLoader(self, name="loader",
+                                    **(loader_config or {}))
+        self.loader.link_from(self.repeater)
+
+        self.trainer = KohonenTrainer(self, name="trainer",
+                                      **(trainer_config or {}))
+        self.trainer.link_from(self.loader)
+        self.trainer.link_attrs(self.loader, ("input", "minibatch_data"),
+                                ("mask", "minibatch_mask"))
+
+        self.decision = KohonenDecision(self, name="decision",
+                                        **(decision_config or {}))
+        self.decision.link_from(self.trainer)
+        self.decision.link_attrs(self.loader, "minibatch_class",
+                                 "minibatch_size", "last_minibatch",
+                                 "class_lengths", "epoch_number")
+        self.decision.link_attrs(self.trainer, "metrics")
+
+        self.forward = KohonenForward(self, name="forward")
+        self.forward.link_attrs(self.loader, ("input", "minibatch_data"),
+                                ("mask", "minibatch_mask"))
+        self.forward.link_attrs(self.trainer, "weights")
+        # forward sits OUTSIDE the cycle: it classifies on demand after
+        # training (the reference ran it in the evaluation pass / plots)
+        self.forward.link_from(self.decision)
+        self.forward.gate_skip = ~self.decision.complete
+
+        self.repeater.link_from(self.decision)
+        self.end_point.link_from(self.forward)
+        self.end_point.gate_block = ~self.decision.complete
+
+
+def default_config():
+    root.kohonen.defaults({
+        "loader": {"minibatch_size": 100, "n_train": 2000},
+        "trainer": {"shape": (8, 8), "learning_rate": 0.2,
+                    "decay_steps": 200},
+        "decision": {"max_epochs": 10, "fail_iterations": 20},
+    })
+    return root.kohonen
+
+
+def build(**overrides):
+    cfg = default_config()
+    kwargs = dict(
+        name="kohonen",
+        loader_config={k: get(v, v) for k, v in cfg.loader.items()},
+        trainer_config={k: get(v, v) for k, v in cfg.trainer.items()},
+        decision_config={k: get(v, v) for k, v in cfg.decision.items()})
+    for key in ("loader", "trainer", "decision"):
+        kwargs["%s_config" % key].update(overrides.pop(key, {}))
+    kwargs.update(overrides)
+    return KohonenWorkflow(None, **kwargs)
+
+
+def train(**overrides):
+    wf = build(**overrides)
+    wf.initialize()
+    wf.run()
+    return wf
+
+
+def run(load, main):
+    cfg = default_config()
+    load(KohonenWorkflow,
+         loader_config={k: get(v, v) for k, v in cfg.loader.items()},
+         trainer_config={k: get(v, v) for k, v in cfg.trainer.items()},
+         decision_config={k: get(v, v) for k, v in cfg.decision.items()})
+    main()

@@ -1,0 +1,96 @@
+"""MNIST RBM sample — CD-1 feature learning on binarized digits.
+
+Ref: veles/znicz samples exercising rbm_units [M] (SURVEY §2.3).  Same
+non-SGD cycle shape as the Kohonen sample: Repeater → Loader → RBMTrainer →
+RBMDecision.
+"""
+
+from __future__ import annotations
+
+from veles_tpu.config import root, get
+from veles_tpu.ops.nn_units import NNWorkflow
+from veles_tpu.ops.rbm import RBMTrainer, RBMForward, RBMDecision
+from veles_tpu.samples.mnist import MnistLoader
+from veles_tpu.workflow import Repeater
+
+
+class MnistRBMLoader(MnistLoader):
+    """MNIST rescaled from [-1, 1] to [0, 1] (Bernoulli probability scale)."""
+
+    def load_data(self):
+        super().load_data()
+        self.original_data.reset((self.original_data.mem + 1.0) / 2.0)
+
+
+class MnistRBMWorkflow(NNWorkflow):
+    def __init__(self, workflow=None, name=None, loader_config=None,
+                 trainer_config=None, decision_config=None, **kwargs):
+        super().__init__(workflow, name=name, **kwargs)
+        self.repeater = Repeater(self, name="repeater")
+        self.repeater.link_from(self.start_point)
+
+        self.loader = MnistRBMLoader(self, name="loader",
+                                     **(loader_config or {}))
+        self.loader.link_from(self.repeater)
+
+        self.trainer = RBMTrainer(self, name="trainer",
+                                  **(trainer_config or {}))
+        self.trainer.link_from(self.loader)
+        self.trainer.link_attrs(self.loader, ("input", "minibatch_data"),
+                                ("mask", "minibatch_mask"))
+
+        self.decision = RBMDecision(self, name="decision",
+                                    **(decision_config or {}))
+        self.decision.link_from(self.trainer)
+        self.decision.link_attrs(self.loader, "minibatch_class",
+                                 "minibatch_size", "last_minibatch",
+                                 "class_lengths", "epoch_number")
+        self.decision.link_attrs(self.trainer, "metrics")
+
+        self.forward = RBMForward(self, name="forward")
+        self.forward.link_attrs(self.loader, ("input", "minibatch_data"))
+        self.forward.link_attrs(self.trainer, "weights", "hbias")
+        self.forward.link_from(self.decision)
+        self.forward.gate_skip = ~self.decision.complete
+
+        self.repeater.link_from(self.decision)
+        self.end_point.link_from(self.forward)
+        self.end_point.gate_block = ~self.decision.complete
+
+
+def default_config():
+    root.mnist_rbm.defaults({
+        "loader": {"minibatch_size": 100, "n_train": 60000, "n_valid": 0},
+        "trainer": {"n_hidden": 256, "learning_rate": 0.05, "cd_k": 1},
+        "decision": {"max_epochs": 10, "fail_iterations": 20},
+    })
+    return root.mnist_rbm
+
+
+def build(**overrides):
+    cfg = default_config()
+    kwargs = dict(
+        name="mnist_rbm",
+        loader_config={k: get(v, v) for k, v in cfg.loader.items()},
+        trainer_config={k: get(v, v) for k, v in cfg.trainer.items()},
+        decision_config={k: get(v, v) for k, v in cfg.decision.items()})
+    for key in ("loader", "trainer", "decision"):
+        kwargs["%s_config" % key].update(overrides.pop(key, {}))
+    kwargs.update(overrides)
+    return MnistRBMWorkflow(None, **kwargs)
+
+
+def train(**overrides):
+    wf = build(**overrides)
+    wf.initialize()
+    wf.run()
+    return wf
+
+
+def run(load, main):
+    cfg = default_config()
+    load(MnistRBMWorkflow,
+         loader_config={k: get(v, v) for k, v in cfg.loader.items()},
+         trainer_config={k: get(v, v) for k, v in cfg.trainer.items()},
+         decision_config={k: get(v, v) for k, v in cfg.decision.items()})
+    main()
