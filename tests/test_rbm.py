@@ -66,6 +66,21 @@ class TestRBMFunctional:
 
 
 class TestMnistRBMSample:
+    def test_validation_minibatches_do_not_update(self):
+        """Held-out sets are scored, never trained on (eval-leak guard)."""
+        from veles_tpu.config import root
+        root.mnist_rbm.update({
+            "loader": {"minibatch_size": 50, "n_train": 100, "n_valid": 100},
+            "trainer": {"n_hidden": 16, "learning_rate": 0.1},
+            "decision": {"max_epochs": 2, "fail_iterations": 20},
+        })
+        from veles_tpu.samples import mnist_rbm
+        wf = mnist_rbm.train()
+        # 2 epochs x 2 train minibatches; valid minibatches must not count
+        assert wf.trainer.time == 4
+        metrics = wf.decision.epoch_metrics[-1]
+        assert "validation" in metrics and "train" in metrics
+
     def test_converges(self):
         from veles_tpu.config import root
         root.mnist_rbm.update({

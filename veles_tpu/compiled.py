@@ -103,6 +103,10 @@ class FusedRunner:
         err, metrics = self._loss(acts[-1], y_ref, mask)
         new_state = list(state)
         for i in range(len(self.forwards) - 1, -1, -1):
+            if err is None:
+                # the first parameterized gd skipped err_input; everything
+                # below it is weightless (see link_gds) — nothing to do
+                break
             gd, entry = self.gds[i], state[i]
             err_in, grads = gd.backward_fused(
                 acts[i], acts[i + 1], err, entry, self._layer_rng(rng, i))

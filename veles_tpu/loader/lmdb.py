@@ -1,0 +1,159 @@
+"""Caffe-LMDB dataset loader (gated on the optional ``lmdb`` package).
+
+Ref: veles/znicz/loader/loader_lmdb.py [M] (SURVEY §2.2): ImageNet-scale
+datasets prepared for Caffe live in LMDB env files of serialized Datum
+records.  This loader reads them directly when ``lmdb`` is importable; the
+supported in-tree path for large datasets is ``records.py`` (convert once
+with ``lmdb_to_records``, then memmap).
+"""
+
+from __future__ import annotations
+
+import numpy
+
+from veles_tpu.loader.base import Loader
+
+
+def _require_lmdb():
+    try:
+        import lmdb
+    except ImportError as e:
+        raise ImportError(
+            "LMDBLoader needs the 'lmdb' package, which is not installed in "
+            "this environment; convert the dataset once with "
+            "veles_tpu.loader.lmdb.lmdb_to_records(...) on a machine that "
+            "has it, or use RecordsLoader / image loaders") from e
+    return lmdb
+
+
+def _iter_datums(env):
+    """Yield (key, uint8 CHW array, label) from a Caffe LMDB environment."""
+    with env.begin() as txn:
+        for key, raw in txn.cursor():
+            arr, label = _parse_datum(raw)
+            yield key, arr, label
+
+
+def _parse_datum(raw):
+    """Minimal Caffe Datum protobuf parse (channels/height/width/data/label)
+    without a protobuf dependency — wire format is stable."""
+    pos, fields = 0, {}
+    data = raw
+    while pos < len(data):
+        tag = data[pos]
+        pos += 1
+        field, wire = tag >> 3, tag & 7
+        if wire == 0:  # varint
+            val, shift = 0, 0
+            while True:
+                b = data[pos]
+                pos += 1
+                val |= (b & 0x7F) << shift
+                if not b & 0x80:
+                    break
+                shift += 7
+            fields[field] = val
+        elif wire == 2:  # length-delimited
+            ln, shift = 0, 0
+            while True:
+                b = data[pos]
+                pos += 1
+                ln |= (b & 0x7F) << shift
+                if not b & 0x80:
+                    break
+                shift += 7
+            fields[field] = data[pos:pos + ln]
+            pos += ln
+        else:
+            raise ValueError("unsupported Datum wire type %d" % wire)
+    c, h, w = fields.get(1, 0), fields.get(2, 0), fields.get(3, 0)
+    pixels = numpy.frombuffer(fields[4], numpy.uint8).reshape(c, h, w)
+    return pixels, int(fields.get(5, 0))
+
+
+def lmdb_to_records(lmdb_path, out_path, class_lengths=None):
+    """Convert a Caffe LMDB to the in-tree record format (HWC uint8).
+
+    Streams sample-by-sample — only one decoded image is resident at a time
+    (ImageNet-scale LMDBs do not fit in RAM); labels (4 bytes each) are
+    buffered and appended after the data blob, matching records.py's layout.
+    """
+    import json
+    import struct
+    from veles_tpu.loader.records import MAGIC
+    lmdb = _require_lmdb()
+    env = lmdb.open(lmdb_path, readonly=True, lock=False)
+    n = env.stat()["entries"]
+    if class_lengths is None:
+        class_lengths = [0, 0, n]
+    if sum(class_lengths) != n:
+        raise ValueError("class_lengths %s don't sum to %d"
+                         % (class_lengths, n))
+    labels = numpy.zeros(n, numpy.int32)
+    written = 0
+    with open(out_path, "wb") as f:
+        header_written = False
+        for _, chw, label in _iter_datums(env):
+            hwc = numpy.ascontiguousarray(chw.transpose(1, 2, 0))
+            if not header_written:
+                header = {"shape": [n] + list(hwc.shape), "dtype": "uint8",
+                          "labels": True,
+                          "class_lengths": [int(c) for c in class_lengths]}
+                blob = json.dumps(header).encode("utf-8")
+                f.write(MAGIC)
+                f.write(struct.pack("<I", len(blob)))
+                f.write(blob)
+                header_written = True
+            f.write(hwc.tobytes())
+            labels[written] = label
+            written += 1
+        if written != n:
+            raise ValueError("LMDB yielded %d records, stat said %d"
+                             % (written, n))
+        f.write(labels.tobytes())
+    return out_path
+
+
+class LMDBLoader(Loader):
+    """Direct LMDB minibatch loader (train split; optional valid split)."""
+
+    def __init__(self, workflow, train_path=None, validation_path=None,
+                 **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.train_path = train_path
+        self.validation_path = validation_path
+        self._splits = {}
+
+    def _load_split(self, path):
+        """uint8 HWC arrays — float conversion happens per minibatch (a
+        float32 copy of an ImageNet split would 4x the resident set)."""
+        lmdb = _require_lmdb()
+        env = lmdb.open(path, readonly=True, lock=False)
+        xs, ys = [], []
+        for _, chw, label in _iter_datums(env):
+            xs.append(chw.transpose(1, 2, 0))
+            ys.append(label)
+        return numpy.stack(xs), numpy.asarray(ys, numpy.int32)
+
+    def load_data(self):
+        _require_lmdb()
+        valid = ((self._load_split(self.validation_path))
+                 if self.validation_path else
+                 (numpy.zeros((0, 1, 1, 1), numpy.uint8),
+                  numpy.zeros(0, numpy.int32)))
+        train = self._load_split(self.train_path)
+        self._data = numpy.concatenate(
+            [valid[0], train[0]]) if len(valid[0]) else train[0]
+        self._labels = numpy.concatenate([valid[1], train[1]])
+        self.class_lengths = [0, len(valid[1]), len(train[1])]
+
+    def create_minibatch_data(self):
+        mb = self.max_minibatch_size
+        self.minibatch_data.reset(numpy.zeros(
+            (mb,) + self._data.shape[1:], numpy.float32))
+        self.minibatch_labels.reset(numpy.zeros(mb, numpy.int32))
+
+    def fill_minibatch(self, indices, actual_size):
+        batch = self._data[indices].astype(numpy.float32) / 127.5 - 1.0
+        self.minibatch_data.reset(batch)
+        self.minibatch_labels.reset(self._labels[indices])

@@ -1,0 +1,113 @@
+"""Record-file dataset: fixed-shape binary records with memory-mapped reads.
+
+The reference consumed ImageNet-scale data from Caffe LMDB files
+(veles/znicz/loader/loader_lmdb.py [M], SURVEY §2.2).  The TPU-native
+equivalent is a flat binary format that memory-maps: a JSON header (shapes,
+dtype, split sizes) + a contiguous sample tensor + a label vector.  Memmap
+gather feeds minibatches without materializing the dataset in RAM, and the
+layout is exactly the [test | validation | train] axis the Loader expects.
+
+Write once with ``write_records`` (offline preprocessing — decode/resize
+images, then capture), train forever from the mapped file.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+
+import numpy
+
+from veles_tpu.loader.base import Loader
+from veles_tpu.memory import Vector
+
+MAGIC = b"VTRECS1\n"
+
+
+def write_records(path, data, labels, class_lengths):
+    """Write a record file: data (N, ...) float32/uint8, labels (N,) int32,
+    class_lengths [test, valid, train] summing to N."""
+    data = numpy.ascontiguousarray(data)
+    labels = (numpy.ascontiguousarray(labels, numpy.int32)
+              if labels is not None else None)
+    if sum(class_lengths) != len(data):
+        raise ValueError("class_lengths %s don't sum to %d"
+                         % (class_lengths, len(data)))
+    header = {
+        "shape": list(data.shape),
+        "dtype": str(data.dtype),
+        "labels": labels is not None,
+        "class_lengths": list(int(n) for n in class_lengths),
+    }
+    blob = json.dumps(header).encode("utf-8")
+    with open(path, "wb") as f:
+        f.write(MAGIC)
+        f.write(struct.pack("<I", len(blob)))
+        f.write(blob)
+        f.write(data.tobytes())
+        if labels is not None:
+            f.write(labels.tobytes())
+    return path
+
+
+def open_records(path):
+    """(header dict, data memmap, labels array-or-None)."""
+    with open(path, "rb") as f:
+        if f.read(len(MAGIC)) != MAGIC:
+            raise ValueError("%s is not a record file" % path)
+        (hlen,) = struct.unpack("<I", f.read(4))
+        header = json.loads(f.read(hlen).decode("utf-8"))
+        data_off = f.tell()
+    shape = tuple(header["shape"])
+    dtype = numpy.dtype(header["dtype"])
+    data = numpy.memmap(path, dtype=dtype, mode="r", offset=data_off,
+                        shape=shape)
+    labels = None
+    if header["labels"]:
+        lab_off = data_off + dtype.itemsize * int(numpy.prod(shape))
+        labels = numpy.memmap(path, dtype=numpy.int32, mode="r",
+                              offset=lab_off, shape=(shape[0],))
+    return header, data, labels
+
+
+class RecordsLoader(Loader):
+    """Minibatch engine over a record file (memmap gather per step).
+
+    Unlike FullBatchLoader the dataset does NOT live in HBM — per step the
+    minibatch is gathered host-side from the mapped file and uploaded once
+    (the ImageNet-at-scale tradeoff; the reference's LMDB loader worked the
+    same way).  ``scale`` optionally rescales uint8 pixels to [-1, 1].
+    """
+
+    def __init__(self, workflow, path=None, scale_uint8=True, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.path = path
+        self.scale_uint8 = scale_uint8
+        self._data = None
+        self._labels = None
+        self.has_labels = True
+
+    def load_data(self):
+        if not self.path or not os.path.exists(self.path):
+            raise ValueError("%s: record file %r not found"
+                             % (self.name, self.path))
+        header, self._data, self._labels = open_records(self.path)
+        self.class_lengths = list(header["class_lengths"])
+        self.has_labels = self._labels is not None
+
+    def create_minibatch_data(self):
+        mb = self.max_minibatch_size
+        self.minibatch_data.reset(numpy.zeros(
+            (mb,) + self._data.shape[1:], numpy.float32))
+        if self.has_labels:
+            self.minibatch_labels.reset(numpy.zeros(mb, numpy.int32))
+
+    def fill_minibatch(self, indices, actual_size):
+        batch = numpy.asarray(self._data[indices], numpy.float32)
+        if self.scale_uint8 and self._data.dtype == numpy.uint8:
+            batch = batch / 127.5 - 1.0
+        self.minibatch_data.reset(batch)
+        if self.has_labels:
+            self.minibatch_labels.reset(
+                numpy.asarray(self._labels[indices], numpy.int32))

@@ -1,0 +1,33 @@
+"""Augmentation units — device-side input randomization.
+
+Ref: the reference's ImageNet sample pipelines cropped/mirrored on the host
+(veles/znicz/samples/imagenet [M], SURVEY §2.2); TPU-native augmentation is a
+stochastic weightless layer INSIDE the jitted step (functional.
+random_crop_flip), with eval minibatches center-cropped deterministically.
+"""
+
+from __future__ import annotations
+
+from veles_tpu.ops.nn_units import (TransformUnit, TransformGD,
+                                    register_layer_type, register_gd_for)
+from veles_tpu.ops import functional as F
+
+
+@register_layer_type("random_crop_flip")
+class RandomCropFlip(TransformUnit):
+    """Config: crop (H, W) output size; flip enables horizontal mirroring."""
+
+    STOCHASTIC = True
+
+    def __init__(self, workflow, crop=(24, 24), flip=True, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.crop = tuple(crop)
+        self.flip = bool(flip)
+
+    def transform(self, x, rng, train):
+        return F.random_crop_flip(x, rng, self.crop, self.flip, train)
+
+
+@register_gd_for(RandomCropFlip)
+class GDRandomCropFlip(TransformGD):
+    """vjp of the crop = zero-padded scatter back to the source window."""

@@ -367,6 +367,37 @@ def dropout(x, rng, rate, train):
     return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
 
 
+# -------------------------------------------------------------- augmentation
+def random_crop_flip(x, rng, out_hw, flip=True, train=True):
+    """AlexNet-style augmentation ON DEVICE: per-sample random crop (+
+    horizontal mirror); eval mode center-crops.
+
+    Ref: the reference's ImageNet sample preprocessing (veles/znicz/samples/
+    imagenet processor pipelines [M], SURVEY §2.2) did this on the host per
+    minibatch; here it traces into the jitted step (vmapped dynamic_slice +
+    select), so augmentation is free of host round-trips and fully
+    deterministic from the step rng.
+    """
+    b, h, w, c = x.shape
+    oh, ow = out_hw
+    if not train or rng is None:
+        top, left = (h - oh) // 2, (w - ow) // 2
+        return jax.lax.slice(x, (0, top, left, 0),
+                             (b, top + oh, left + ow, c))
+    k_top, k_left, k_flip = jax.random.split(rng, 3)
+    tops = jax.random.randint(k_top, (b,), 0, h - oh + 1)
+    lefts = jax.random.randint(k_left, (b,), 0, w - ow + 1)
+
+    def crop_one(img, top, left):
+        return jax.lax.dynamic_slice(img, (top, left, 0), (oh, ow, c))
+
+    out = jax.vmap(crop_one)(x, tops, lefts)
+    if flip:
+        mirror = jax.random.bernoulli(k_flip, 0.5, (b,))
+        out = jnp.where(mirror[:, None, None, None], out[:, :, ::-1, :], out)
+    return out
+
+
 # ----------------------------------------------------------------- kohonen
 def kohonen_distances(x, weights):
     """Squared euclidean distances (mb, n_neurons) between samples and SOM

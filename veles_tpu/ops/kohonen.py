@@ -77,8 +77,22 @@ class KohonenTrainer(AcceleratedUnit):
             return F.kohonen_update(weights, x, mask, jnp.asarray(grid),
                                     lr, sigma)
 
+        def evaluate(weights, x, mask):
+            import jax.numpy as jnp
+            _, dmin = F.kohonen_winners(x, weights)
+            qe = (jnp.sqrt(jnp.maximum(dmin, 0.0)) * mask).sum()
+            return {"qe_sum": qe, "loss_sum": qe}
+
         self._upd = self.jit("update", update)
+        self._eval = self.jit("evaluate", evaluate)
         super().initialize(device=device, **kwargs)
+
+    def _is_train_minibatch(self):
+        """Update only on TRAIN minibatches: evaluation sets must not leak
+        into the codebook (link minibatch_class from the loader; absent ⇒
+        train-only loader)."""
+        from veles_tpu.loader.base import TRAIN
+        return getattr(self, "minibatch_class", TRAIN) == TRAIN
 
     def schedules(self):
         t = self.time / max(self.decay_steps, 1)
@@ -88,6 +102,10 @@ class KohonenTrainer(AcceleratedUnit):
 
     def run(self):
         import jax.numpy as jnp
+        if not self._is_train_minibatch():
+            self.metrics = self._eval(self.weights.devmem,
+                                      self.input.devmem, self.mask.devmem)
+            return
         lr, sigma = self.schedules()
         new_w, metrics = self._upd(
             self.weights.devmem, self.input.devmem, self.mask.devmem,

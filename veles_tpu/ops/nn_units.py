@@ -259,6 +259,8 @@ class TransformGD(AcceleratedUnit):
 
     def backward_fused(self, x, y, err_output, entry, rng):
         import jax
+        if not self.need_err_input:
+            return None, None
         fwd = self.forward
         if fwd.STOCHASTIC:
             _, vjp = jax.vjp(lambda a: fwd.transform(a, rng, True), x)

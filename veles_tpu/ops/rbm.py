@@ -68,10 +68,31 @@ class RBMTrainer(AcceleratedUnit):
                                  jnp.asarray(self.learning_rate, w.dtype),
                                  self.cd_k)
 
+        def evaluate(w, vb, hb, v, mask):
+            import jax.numpy as jnp
+            v = v.reshape(v.shape[0], -1)
+            h = F.rbm_hidden(v, w, hb)
+            recon = F.rbm_visible(h, w, vb)
+            err = jnp.sqrt(
+                (((v - recon) * mask[:, None]) ** 2).sum(axis=1)).sum()
+            return {"recon_sum": err, "loss_sum": err}
+
         self._step = self.jit("cd", step)
+        self._eval = self.jit("recon_eval", evaluate)
         super().initialize(device=device, **kwargs)
 
+    def _is_train_minibatch(self):
+        """CD updates only on TRAIN minibatches — held-out sets are scored
+        by reconstruction without touching the parameters."""
+        from veles_tpu.loader.base import TRAIN
+        return getattr(self, "minibatch_class", TRAIN) == TRAIN
+
     def run(self):
+        if not self._is_train_minibatch():
+            self.metrics = self._eval(
+                self.weights.devmem, self.vbias.devmem, self.hbias.devmem,
+                self.input.devmem, self.mask.devmem)
+            return
         key = prng.get("rbm").key()
         new_w, new_vb, new_hb, metrics = self._step(
             self.weights.devmem, self.vbias.devmem, self.hbias.devmem,

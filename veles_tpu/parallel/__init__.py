@@ -58,6 +58,9 @@ class ShardedTrainer:
         self._batch = NamedSharding(mesh, P("data"))
         shardings = []
         for i, entry in enumerate(runner.state):
+            if not entry:      # weightless layer (pooling, dropout, crop…)
+                shardings.append({})
+                continue
             if i in model_shard_layers:
                 w = NamedSharding(mesh, P(None, "model"))
                 b = NamedSharding(mesh, P("model"))
@@ -69,6 +72,8 @@ class ShardedTrainer:
                 spec["vb"] = b
             shardings.append(spec)
         self.state_shardings = shardings
+        #: global train-step counter (lr policies); see train_step
+        self.step_count = 0
         #: device state, placed according to the sharding plan
         self.state = jax.device_put(runner.state, shardings)
         # out_shardings pins the updated state to the plan — otherwise
@@ -86,11 +91,21 @@ class ShardedTrainer:
         mask = jax.device_put(mask, self._batch)
         return x, labels, mask
 
-    def train_step(self, x, labels, mask, batch_size):
+    def train_step(self, x, labels, mask, batch_size, rng=None, step=None):
+        """One SPMD train step; ``step`` defaults to an internal counter so
+        lr policies decay in the distributed path exactly as they do under
+        FusedStep (pass it explicitly to resume from a checkpointed step)."""
         import jax.numpy as jnp
+        if rng is None and self.runner._has_stochastic:
+            from veles_tpu import prng
+            rng = prng.get("dropout").key()
+        if step is None:
+            step = self.step_count
         x, labels, mask = self.put_batch(x, labels, mask)
         self.state, metrics = self._train(
-            self.state, x, labels, mask, jnp.asarray(batch_size, jnp.int32))
+            self.state, x, labels, mask, jnp.asarray(batch_size, jnp.int32),
+            rng, jnp.asarray(step, jnp.int32))
+        self.step_count = int(step) + 1
         return metrics
 
     def eval_step(self, x, labels, mask):

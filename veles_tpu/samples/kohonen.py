@@ -11,7 +11,7 @@ from __future__ import annotations
 import numpy
 
 from veles_tpu import prng
-from veles_tpu.config import root, get
+from veles_tpu.config import root
 from veles_tpu.loader.fullbatch import FullBatchLoader
 from veles_tpu.ops.kohonen import (KohonenTrainer, KohonenForward,
                                    KohonenDecision)
@@ -57,7 +57,8 @@ class KohonenWorkflow(NNWorkflow):
                                       **(trainer_config or {}))
         self.trainer.link_from(self.loader)
         self.trainer.link_attrs(self.loader, ("input", "minibatch_data"),
-                                ("mask", "minibatch_mask"))
+                                ("mask", "minibatch_mask"),
+                                "minibatch_class")
 
         self.decision = KohonenDecision(self, name="decision",
                                         **(decision_config or {}))
@@ -91,30 +92,7 @@ def default_config():
     return root.kohonen
 
 
-def build(**overrides):
-    cfg = default_config()
-    kwargs = dict(
-        name="kohonen",
-        loader_config={k: get(v, v) for k, v in cfg.loader.items()},
-        trainer_config={k: get(v, v) for k, v in cfg.trainer.items()},
-        decision_config={k: get(v, v) for k, v in cfg.decision.items()})
-    for key in ("loader", "trainer", "decision"):
-        kwargs["%s_config" % key].update(overrides.pop(key, {}))
-    kwargs.update(overrides)
-    return KohonenWorkflow(None, **kwargs)
+from veles_tpu.samples import make_trainer_sample  # noqa: E402
 
-
-def train(**overrides):
-    wf = build(**overrides)
-    wf.initialize()
-    wf.run()
-    return wf
-
-
-def run(load, main):
-    cfg = default_config()
-    load(KohonenWorkflow,
-         loader_config={k: get(v, v) for k, v in cfg.loader.items()},
-         trainer_config={k: get(v, v) for k, v in cfg.trainer.items()},
-         decision_config={k: get(v, v) for k, v in cfg.decision.items()})
-    main()
+build, train, run = make_trainer_sample("kohonen", KohonenWorkflow,
+                                        default_config)

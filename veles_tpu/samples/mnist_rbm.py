@@ -7,7 +7,7 @@ RBMDecision.
 
 from __future__ import annotations
 
-from veles_tpu.config import root, get
+from veles_tpu.config import root
 from veles_tpu.ops.nn_units import NNWorkflow
 from veles_tpu.ops.rbm import RBMTrainer, RBMForward, RBMDecision
 from veles_tpu.samples.mnist import MnistLoader
@@ -37,7 +37,8 @@ class MnistRBMWorkflow(NNWorkflow):
                                   **(trainer_config or {}))
         self.trainer.link_from(self.loader)
         self.trainer.link_attrs(self.loader, ("input", "minibatch_data"),
-                                ("mask", "minibatch_mask"))
+                                ("mask", "minibatch_mask"),
+                                "minibatch_class")
 
         self.decision = RBMDecision(self, name="decision",
                                     **(decision_config or {}))
@@ -67,30 +68,7 @@ def default_config():
     return root.mnist_rbm
 
 
-def build(**overrides):
-    cfg = default_config()
-    kwargs = dict(
-        name="mnist_rbm",
-        loader_config={k: get(v, v) for k, v in cfg.loader.items()},
-        trainer_config={k: get(v, v) for k, v in cfg.trainer.items()},
-        decision_config={k: get(v, v) for k, v in cfg.decision.items()})
-    for key in ("loader", "trainer", "decision"):
-        kwargs["%s_config" % key].update(overrides.pop(key, {}))
-    kwargs.update(overrides)
-    return MnistRBMWorkflow(None, **kwargs)
+from veles_tpu.samples import make_trainer_sample  # noqa: E402
 
-
-def train(**overrides):
-    wf = build(**overrides)
-    wf.initialize()
-    wf.run()
-    return wf
-
-
-def run(load, main):
-    cfg = default_config()
-    load(MnistRBMWorkflow,
-         loader_config={k: get(v, v) for k, v in cfg.loader.items()},
-         trainer_config={k: get(v, v) for k, v in cfg.trainer.items()},
-         decision_config={k: get(v, v) for k, v in cfg.decision.items()})
-    main()
+build, train, run = make_trainer_sample("mnist_rbm", MnistRBMWorkflow,
+                                        default_config)

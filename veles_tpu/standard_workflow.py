@@ -160,12 +160,19 @@ class StandardWorkflowBase(NNWorkflow):
     def link_gds(self):
         """Backward chain in reverse layer order, closing the cycle."""
         prev_gd = None
+        # err_input is only needed by gds BELOW; everything at or before the
+        # first parameterized layer can skip that GEMM/conv (the reference's
+        # need_err_input flag, extended past leading weightless layers like
+        # augmentation/normalization)
+        first_param = next(
+            (i for i, f in enumerate(self.forwards) if f.has_params),
+            len(self.forwards))
         for fwd in reversed(self.forwards):
-            _, _, _, gd_kwargs = parse_layer(
-                self.layers_config[self.forwards.index(fwd)])
+            idx = self.forwards.index(fwd)
+            _, _, _, gd_kwargs = parse_layer(self.layers_config[idx])
             gd_cls = gd_class_for(fwd)
             gd = gd_cls(self, forward=fwd,
-                        need_err_input=fwd is not self.forwards[0],
+                        need_err_input=idx > first_param,
                         **gd_kwargs)
             if prev_gd is None:
                 gd.link_from(self.decision)
