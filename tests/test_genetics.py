@@ -1,0 +1,111 @@
+"""Genetics (Tune + GA) and ensemble tests (SURVEY §2.1, §3.5)."""
+
+import numpy
+
+from veles_tpu.config import Config, Tune, root
+from veles_tpu.genetics import find_tunes, optimize, Population, set_leaf
+
+
+class TestTuneDiscovery:
+    def test_find_and_set(self):
+        cfg = Config("root")
+        cfg.model.lr = Tune(0.01, 0.001, 0.1)
+        cfg.model.momentum = 0.9
+        cfg.loader.size = Tune(100, 10, 1000)
+        tunes = find_tunes(cfg, "root")
+        assert [p for p, _ in tunes] == ["root.loader.size", "root.model.lr"]
+        set_leaf("root.model.lr", 0.05, cfg)
+        assert cfg.model.lr == 0.05
+
+
+class TestGA:
+    def test_converges_on_quadratic(self):
+        from veles_tpu import prng
+        prng.reset()
+        prng.seed_all(7)
+        genes = [("root.ga_test.x", Tune(5.0, -10.0, 10.0)),
+                 ("root.ga_test.y", Tune(-5.0, -10.0, 10.0))]
+
+        def evaluate(individual):
+            x, y = individual
+            return (x - 2.0) ** 2 + (y + 3.0) ** 2
+
+        best_fit, best_genes, pop = optimize(evaluate, generations=12,
+                                             population=12, genes=genes)
+        assert best_fit < 0.5, (best_fit, best_genes)
+        assert abs(best_genes["root.ga_test.x"] - 2.0) < 1.0
+        assert abs(best_genes["root.ga_test.y"] + 3.0) < 1.0
+        # fitness history is monotone non-increasing at the elite
+        fits = [h[0] for h in pop.history]
+        assert fits[-1] <= fits[0]
+
+    def test_bounds_respected(self):
+        from veles_tpu import prng
+        prng.reset()
+        prng.seed_all(3)
+        genes = [("root.ga_b.x", Tune(0.5, 0.0, 1.0))]
+        seen = []
+
+        def evaluate(ind):
+            seen.append(ind[0])
+            return ind[0]
+
+        optimize(evaluate, generations=4, population=6, genes=genes)
+        assert all(0.0 <= v <= 1.0 for v in seen)
+
+
+class TestWorkflowOptimize:
+    def test_optimizes_mnist_lr(self):
+        """Tiny end-to-end GA over the MNIST sample's learning rate."""
+        from veles_tpu import prng
+        from veles_tpu.genetics import optimize_workflow
+        prng.reset()
+        prng.seed_all(1)
+        root.mnist.update({
+            "loader": {"minibatch_size": 50, "n_train": 200, "n_valid": 100},
+            "decision": {"max_epochs": 2, "fail_iterations": 5},
+            "layers": [
+                {"type": "all2all_tanh", "output_sample_shape": 16,
+                 "learning_rate": Tune(0.001, 0.0005, 0.1), "momentum": 0.9},
+                {"type": "softmax", "output_sample_shape": 10,
+                 "learning_rate": 0.03, "momentum": 0.9},
+            ],
+        })
+        from veles_tpu.samples import mnist
+        best_fit, best_genes, _ = optimize_workflow(
+            mnist, generations=2, population=3, seed=1)
+        assert numpy.isfinite(best_fit)
+        (path, value), = best_genes.items()
+        assert "learning_rate" in path
+        assert 0.0005 <= value <= 0.1
+
+
+class TestEnsemble:
+    def test_members_and_combination(self):
+        from veles_tpu import prng
+        from veles_tpu.ensemble import train_ensemble
+        prng.reset()
+        prng.seed_all(1)
+        root.mnist.update({
+            "loader": {"minibatch_size": 50, "n_train": 300, "n_valid": 100},
+            "decision": {"max_epochs": 2, "fail_iterations": 5},
+            "layers": [
+                {"type": "all2all_tanh", "output_sample_shape": 16,
+                 "learning_rate": 0.03, "momentum": 0.9},
+                {"type": "softmax", "output_sample_shape": 10,
+                 "learning_rate": 0.03, "momentum": 0.9},
+            ],
+        })
+        from veles_tpu.samples import mnist
+        trainer, combined = train_ensemble(mnist, size=3, base_seed=5)
+        assert len(trainer.members) == 3
+        assert combined["count"] == 100
+        assert len(combined["members"]) == 3
+        # the ensemble should not be (much) worse than its best member
+        assert combined["ensemble_n_err"] <= min(combined["members"]) + 5
+        # different seeds really produced different members (weights differ)
+        w0 = numpy.asarray(
+            trainer.members[0][1].forwards[0].weights.mem)
+        w1 = numpy.asarray(
+            trainer.members[1][1].forwards[0].weights.mem)
+        assert not numpy.allclose(w0, w1)

@@ -1,0 +1,120 @@
+"""Plotting stack tests: spec rendering, plotter units in a live training
+run, and the ZMQ graphics server→client transport (SURVEY §2.1/§5.5)."""
+
+import os
+
+import numpy
+import pytest
+
+from veles_tpu.plotter import render_spec
+
+
+class TestRenderSpec:
+    def test_curve(self, tmp_path):
+        path = render_spec({"kind": "curve",
+                            "series": {"train": [3, 2, 1],
+                                       "validation": [4, 3, 2]},
+                            "title": "err"}, str(tmp_path / "c.png"))
+        assert os.path.getsize(path) > 0
+
+    def test_matrix(self, tmp_path):
+        path = render_spec({"kind": "matrix",
+                            "matrix": numpy.eye(4)}, str(tmp_path / "m.png"))
+        assert os.path.getsize(path) > 0
+
+    def test_hist(self, tmp_path):
+        path = render_spec({"kind": "hist",
+                            "values": numpy.random.RandomState(0).randn(100)},
+                           str(tmp_path / "h.png"))
+        assert os.path.getsize(path) > 0
+
+    def test_image_grid(self, tmp_path):
+        imgs = numpy.random.RandomState(0).rand(6, 8, 8)
+        path = render_spec({"kind": "image_grid", "images": imgs},
+                           str(tmp_path / "g.png"))
+        assert os.path.getsize(path) > 0
+
+    def test_unknown_kind(self, tmp_path):
+        with pytest.raises(ValueError):
+            render_spec({"kind": "nope"}, str(tmp_path / "x.png"))
+
+
+class TestPlottersInTraining:
+    def test_standard_plotters_produce_files(self, tmp_path):
+        from veles_tpu import prng
+        from veles_tpu.config import root
+        prng.reset()
+        prng.seed_all(1)
+        root.mnist.update({
+            "loader": {"minibatch_size": 50, "n_train": 200, "n_valid": 100},
+            "decision": {"max_epochs": 2, "fail_iterations": 10},
+            "layers": [
+                {"type": "all2all_tanh", "output_sample_shape": 16,
+                 "learning_rate": 0.03, "momentum": 0.9},
+                {"type": "softmax", "output_sample_shape": 10,
+                 "learning_rate": 0.03, "momentum": 0.9},
+            ],
+        })
+        from veles_tpu.samples import mnist
+        wf = mnist.build(fused=True)
+        plot_dir = str(tmp_path / "plots")
+        wf.link_plotters(output_dir=plot_dir)
+        wf.initialize()
+        wf.run()
+        files = sorted(os.listdir(plot_dir))
+        kinds = {f.rsplit("_", 1)[0] for f in files}
+        assert kinds == {"plot_curve", "plot_confusion", "plot_weights"}
+        # one redraw per epoch boundary (x 2 epochs, x3 sets finishing —
+        # at least 2 curve files)
+        assert sum(f.startswith("plot_curve") for f in files) >= 2
+        # specs carry the data for tests/publishing
+        curve = wf.plotters[0].specs[-1]
+        assert "validation" in curve["series"]
+
+    def test_weights2d_conv_kernels(self):
+        from veles_tpu import prng
+        from veles_tpu.config import root
+        prng.reset()
+        prng.seed_all(1)
+        root.cifar.update({
+            "loader": {"minibatch_size": 25, "n_train": 50, "n_valid": 25},
+            "decision": {"max_epochs": 1, "fail_iterations": 5},
+            # explicit layers: root is a process-global tree, other tests
+            # may have installed a different topology under root.cifar
+            "layers": [
+                {"type": "conv_relu", "n_kernels": 8, "kx": 3, "ky": 3,
+                 "padding": "SAME", "learning_rate": 0.01, "momentum": 0.9},
+                {"type": "max_pooling", "kx": 2, "ky": 2},
+                {"type": "softmax", "output_sample_shape": 10,
+                 "learning_rate": 0.01, "momentum": 0.9},
+            ],
+        })
+        from veles_tpu.samples import cifar
+        wf = cifar.build(fused=False)
+        wf.initialize()
+        from veles_tpu.nn_plotting_units import Weights2D
+        w2d = Weights2D(wf, name="w2d")
+        w2d.input = wf.forwards[0]
+        w2d._initialized = True
+        spec = w2d.plot_spec()
+        assert spec["kind"] == "image_grid"
+        assert len(spec["images"]) == wf.forwards[0].n_kernels
+
+
+class TestGraphicsTransport:
+    def test_pub_sub_roundtrip(self, tmp_path):
+        from veles_tpu.graphics_server import GraphicsServer
+        from veles_tpu.graphics_client import GraphicsClient
+        import time
+        server = GraphicsServer("tcp://127.0.0.1:0")
+        client = GraphicsClient(server.endpoint,
+                                out_dir=str(tmp_path / "out"))
+        time.sleep(0.2)        # PUB/SUB slow-joiner
+        server.send({"kind": "curve", "series": {"a": [1, 2]},
+                     "name": "roundtrip"})
+        assert client.poll_once(5000)
+        files = os.listdir(tmp_path / "out")
+        assert files and files[0].startswith("roundtrip")
+        server.close()
+        assert not client.poll_once(2000)   # end-of-stream marker
+        client.close()

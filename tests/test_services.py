@@ -1,0 +1,220 @@
+"""Aux services: REST serving, ZMQ/interactive loaders, forge, publishing,
+web status, shell (SURVEY §2.1 auxiliary rows + §3.4)."""
+
+import json
+import os
+import urllib.request
+
+import numpy
+import pytest
+
+
+def _train_tiny_mnist(tmp_path, snapshot=False):
+    from veles_tpu import prng
+    from veles_tpu.config import root
+    prng.reset()
+    prng.seed_all(1)
+    cfg = {
+        "loader": {"minibatch_size": 50, "n_train": 200, "n_valid": 100},
+        "decision": {"max_epochs": 2, "fail_iterations": 5},
+        "layers": [
+            {"type": "all2all_tanh", "output_sample_shape": 16,
+             "learning_rate": 0.03, "momentum": 0.9},
+            {"type": "softmax", "output_sample_shape": 10,
+             "learning_rate": 0.03, "momentum": 0.9},
+        ],
+    }
+    if snapshot:
+        cfg["snapshotter"] = {"directory": str(tmp_path / "snaps"),
+                              "interval": 1, "compression": "gz"}
+    root.mnist.update(cfg)
+    from veles_tpu.samples import mnist
+    return mnist.train()
+
+
+class TestRESTServing:
+    def test_predict_roundtrip(self, tmp_path):
+        from veles_tpu.restful_api import RESTfulAPI
+        wf = _train_tiny_mnist(tmp_path)
+        api = RESTfulAPI(wf).start(port=0)
+        try:
+            x = numpy.zeros((2, 784), numpy.float32).tolist()
+            req = urllib.request.Request(
+                "http://127.0.0.1:%d/predict" % api.port,
+                data=json.dumps({"input": x}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                out = json.loads(resp.read())
+            assert len(out["output"]) == 2
+            assert len(out["output"][0]) == 10
+            assert abs(sum(out["output"][0]) - 1.0) < 1e-3   # softmax
+            assert out["argmax"][0] in range(10)
+        finally:
+            api.stop()
+
+    def test_bad_request_is_400(self, tmp_path):
+        from veles_tpu.restful_api import RESTfulAPI
+        wf = _train_tiny_mnist(tmp_path)
+        api = RESTfulAPI(wf).start(port=0)
+        try:
+            req = urllib.request.Request(
+                "http://127.0.0.1:%d/predict" % api.port,
+                data=b"{}", headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(req, timeout=10)
+            assert err.value.code == 400
+        finally:
+            api.stop()
+
+
+class TestZmqLoader:
+    def test_stream_minibatch(self):
+        from veles_tpu.workflow import Workflow
+        from veles_tpu.zmq_loader import ZeroMQLoader, push_samples
+        wf = Workflow(None, name="wf")
+        loader = ZeroMQLoader(wf, sample_shape=(4,), minibatch_size=3,
+                              timeout_ms=10000, name="loader")
+        loader.initialize()
+        samples = [{"data": numpy.full(4, i, numpy.float32), "label": i}
+                   for i in range(5)]
+        push_samples(loader.endpoint, samples)
+        loader.run()
+        assert loader.minibatch_size == 3
+        numpy.testing.assert_array_equal(loader.minibatch_labels.mem,
+                                         [0, 1, 2])
+        loader.run()   # second minibatch: 2 live + end-of-stream
+        assert loader.minibatch_size == 2
+        assert loader.exhausted
+        assert not bool(loader.complete)
+        loader.run()   # drained: empty minibatch flips complete
+        assert loader.minibatch_size == 0
+        assert bool(loader.complete)
+        loader.stop()
+
+
+class TestInteractiveLoader:
+    def test_feed_and_fill(self):
+        from veles_tpu.workflow import Workflow
+        from veles_tpu.loader.interactive import InteractiveLoader
+        wf = Workflow(None, name="wf")
+        loader = InteractiveLoader(wf, sample_shape=(3,), minibatch_size=2,
+                                   name="loader")
+        loader.feed(numpy.ones(3), label=7)
+        loader.feed(numpy.zeros((2, 3)), label=[1, 2])
+        loader.initialize()
+        loader.run()
+        assert loader.minibatch_size == 2
+        numpy.testing.assert_array_equal(loader.minibatch_labels.mem[:2],
+                                         [7, 1])
+        loader.run()
+        assert loader.minibatch_size == 1
+
+
+class TestForge:
+    def test_pack_publish_fetch_restore(self, tmp_path):
+        from veles_tpu import forge, prng
+        from veles_tpu.config import root
+        wf = _train_tiny_mnist(tmp_path, snapshot=True)
+        snap = wf.snapshotter.destination
+        assert snap and os.path.exists(snap)
+
+        pkg = forge.pack(snap, str(tmp_path / "model.forge.tar.gz"),
+                         name="mnist_fc", description="test model",
+                         metrics={"n_err": wf.decision.best_metric})
+        manifest = forge.read_manifest(pkg)
+        assert manifest["name"] == "mnist_fc"
+        assert manifest["metrics"]["n_err"] == wf.decision.best_metric
+
+        store = str(tmp_path / "store")
+        forge.publish(pkg, store)
+        listed = forge.list_store(store)
+        assert len(listed) == 1 and listed[0][1]["name"] == "mnist_fc"
+
+        fetched_manifest, snap_path = forge.fetch(
+            store, "mnist_fc", str(tmp_path / "fetched"))
+        assert os.path.exists(snap_path)
+
+        # restore into a freshly built workflow; weights must match
+        prng.reset()
+        prng.seed_all(99)  # different seed: restore must overwrite init
+        from veles_tpu.samples import mnist
+        wf2, _ = forge.restore_package(
+            pkg, lambda: mnist.build().initialize(),
+            out_dir=str(tmp_path / "restored"))
+        runner2 = wf2._fused_runner
+        runner2.state = runner2._pull_state()
+        numpy.testing.assert_allclose(
+            numpy.asarray(wf2.forwards[0].weights.mem),
+            numpy.asarray(wf.forwards[0].weights.mem), atol=1e-6)
+
+
+class TestPublishing:
+    def test_reports(self, tmp_path):
+        from veles_tpu.publishing import Publisher
+        wf = _train_tiny_mnist(tmp_path)
+        paths = Publisher(("markdown", "html", "json")).publish(
+            wf, str(tmp_path / "report"))
+        assert len(paths) == 3
+        md = open(paths[0], encoding="utf-8").read()
+        assert "Training report: mnist" in md
+        assert "validation_n_err" in md
+        html_text = open(paths[1], encoding="utf-8").read()
+        assert "<table>" in html_text
+        facts = json.load(open(paths[2], encoding="utf-8"))
+        assert facts["best_epoch"] >= 1
+
+
+class TestWebStatus:
+    def test_dashboard(self, tmp_path):
+        from veles_tpu.web_status import WebStatus, StatusReporter
+        status = WebStatus().start(port=0)
+        try:
+            wf = _train_tiny_mnist(tmp_path)
+            reporter = StatusReporter(wf, status=status, name="reporter")
+            reporter._initialized = True
+            reporter.run()
+            with urllib.request.urlopen(
+                    "http://127.0.0.1:%d/status.json" % status.port,
+                    timeout=10) as resp:
+                data = json.loads(resp.read())
+            assert "mnist" in data
+            assert data["mnist"]["epoch"] >= 2
+            with urllib.request.urlopen(
+                    "http://127.0.0.1:%d/" % status.port,
+                    timeout=10) as resp:
+                page = resp.read().decode()
+            assert "mnist" in page
+        finally:
+            status.stop()
+
+
+class TestShell:
+    def test_skips_without_tty(self, tmp_path, capsys):
+        from veles_tpu.workflow import Workflow
+        from veles_tpu.interaction import Shell
+        wf = Workflow(None, name="wf")
+        shell = Shell(wf, name="shell")
+        shell.initialize()
+        shell.run()          # no tty in tests: must not block
+        assert bool(shell.fired)
+
+    def test_interact_receives_workflow(self):
+        from veles_tpu.workflow import Workflow
+        from veles_tpu.interaction import Shell
+        wf = Workflow(None, name="wf")
+        seen = {}
+
+        class TestableShell(Shell):
+            def interact(self, local):
+                seen.update(local)
+
+        shell = TestableShell(wf, name="shell")
+        shell.initialize()
+        import sys
+        real_isatty = sys.stdin.isatty
+        sys.stdin.isatty = lambda: True
+        try:
+            shell.run()
+        finally:
+            sys.stdin.isatty = real_isatty
+        assert seen["wf"] is wf

@@ -116,6 +116,16 @@ class FusedRunner:
             err = err_in
         return new_state, metrics
 
+    def eval_forward(self):
+        """Jitted eval-mode forward ``(state, x) -> last activation``,
+        compiled once and shared (REST serving, ensemble combination)."""
+        import jax
+        if not hasattr(self, "_eval_forward_jit"):
+            self._eval_forward_jit = jax.jit(
+                lambda state, x: self._forward_chain(
+                    state, x, rng=None, train=False)[-1])
+        return self._eval_forward_jit
+
     # ----------------------------------------------------- epoch-scan (fast)
     # One device dispatch per EPOCH: lax.scan over the minibatch index
     # matrix with the dataset resident in HBM.  This is the pure TPU-native

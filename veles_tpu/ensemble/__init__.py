@@ -1,0 +1,96 @@
+"""Ensemble training and combined evaluation.
+
+Ref: veles/ensemble/ [M] (SURVEY §2.1): train N instances of a workflow
+(seed variations), collect per-model results, then evaluate the combined
+model.  TPU-native: members train sequentially in-process (one TPU
+attachment); combination averages the members' softmax outputs over the
+validation set with one jitted eval per member.
+"""
+
+from __future__ import annotations
+
+import numpy
+
+from veles_tpu import prng
+from veles_tpu.logger import Logger
+from veles_tpu.loader.base import VALID
+
+
+class EnsembleTrainer(Logger):
+    """Train ``size`` members of a sample module (``run(load, main)``
+    convention), seeds base_seed+i, and combine them."""
+
+    def __init__(self, module, size=4, base_seed=1, build_kwargs=None):
+        self.module = module
+        self.size = size
+        self.base_seed = base_seed
+        self.build_kwargs = dict(build_kwargs or {})
+        self.members = []       # (seed, workflow, summary)
+
+    def train(self):
+        for i in range(self.size):
+            seed = self.base_seed + i
+            prng.reset()
+            prng.seed_all(seed)
+            holder = {}
+
+            def load(workflow_cls, **kwargs):
+                kwargs.update(self.build_kwargs)
+                wf = workflow_cls(None, **kwargs)
+                holder["wf"] = wf
+                return wf
+
+            def main():
+                holder["wf"].initialize()
+                holder["wf"].run()
+
+            self.module.run(load, main)
+            wf = holder["wf"]
+            summary = {"seed": seed,
+                       "best_metric": wf.decision.best_metric,
+                       "best_epoch": wf.decision.best_epoch}
+            self.members.append((seed, wf, summary))
+            self.info("member %d/%d (seed %d): best %s", i + 1, self.size,
+                      seed, summary["best_metric"])
+        return self
+
+    # -- combined evaluation -------------------------------------------------
+    def _eval_fn(self):
+        """ONE compiled eval forward for all members: topologies are
+        identical, state is an argument — member 0's jit serves every
+        member's state, so combining N members costs one XLA compile."""
+        return self.members[0][1]._fused_runner.eval_forward()
+
+    def evaluate_combined(self):
+        """Average member probabilities on the validation set → n_err.
+
+        All members must share the loader layout (same seed-independent
+        dataset, e.g. real MNIST or a fixed-stream synthetic set).
+        """
+        if not self.members:
+            raise ValueError("train() first")
+        _, wf0, _ = self.members[0]
+        loader = wf0.loader
+        begin, end = loader.class_offsets()[VALID]
+        if end <= begin:
+            raise ValueError("no validation samples to combine on")
+        data = loader.original_data.devmem[begin:end]
+        labels = numpy.asarray(loader.original_labels.mem[begin:end])
+        total = None
+        per_member_err = []
+        eval_fn = self._eval_fn()
+        for _, wf, _ in self.members:
+            probs = numpy.asarray(eval_fn(wf._fused_runner.state, data))
+            per_member_err.append(
+                int((probs.argmax(1) != labels).sum()))
+            total = probs if total is None else total + probs
+        ens_err = int((total.argmax(1) != labels).sum())
+        return {"members": per_member_err, "ensemble_n_err": ens_err,
+                "count": len(labels)}
+
+
+def train_ensemble(module, size=4, base_seed=1, build_kwargs=None):
+    """One-call convenience: train + combined evaluation."""
+    trainer = EnsembleTrainer(module, size=size, base_seed=base_seed,
+                              build_kwargs=build_kwargs).train()
+    return trainer, trainer.evaluate_combined()

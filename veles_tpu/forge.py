@@ -1,0 +1,110 @@
+"""Forge — the model zoo: package, store, fetch trained workflows.
+
+Ref: veles/forge_client.py / forge_server [M] (SURVEY §2.1): the reference
+packaged a workflow (manifest + snapshot + sources) and uploaded it to a
+forge server.  Redesign: a package is one tar.gz holding ``manifest.json``
++ the snapshot file; the "server" is a store directory (local path — or a
+network mount; the reference's HTTP upload becomes a file copy, which is
+what zero-egress TPU pods can actually use).
+
+API: ``pack`` → package file; ``publish`` → store; ``list_store`` /
+``fetch`` → retrieve; ``restore_package`` → live workflow.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tarfile
+import tempfile
+import time
+
+MANIFEST = "manifest.json"
+
+
+def pack(snapshot_path, out_path, name=None, author=None, description="",
+         metrics=None, extra_files=()):
+    """Create a forge package from a snapshot file."""
+    if not os.path.exists(snapshot_path):
+        raise FileNotFoundError(snapshot_path)
+    manifest = {
+        "name": name or os.path.basename(snapshot_path).split("_")[0],
+        "author": author or os.environ.get("USER", "unknown"),
+        "description": description,
+        "metrics": metrics or {},
+        "snapshot": os.path.basename(snapshot_path),
+        "packaged_at": time.time(),
+        "format": 1,
+    }
+    with tarfile.open(out_path, "w:gz") as tar:
+        with tempfile.NamedTemporaryFile("w", suffix=".json",
+                                         delete=False) as f:
+            json.dump(manifest, f, indent=2)
+            tmp = f.name
+        tar.add(tmp, arcname=MANIFEST)
+        os.unlink(tmp)
+        tar.add(snapshot_path, arcname=manifest["snapshot"])
+        for path in extra_files:
+            tar.add(path, arcname=os.path.basename(path))
+    return out_path
+
+
+def read_manifest(package_path):
+    with tarfile.open(package_path, "r:gz") as tar:
+        member = tar.extractfile(MANIFEST)
+        if member is None:
+            raise ValueError("%s has no %s" % (package_path, MANIFEST))
+        return json.load(member)
+
+
+def unpack(package_path, out_dir):
+    """Extract a package; returns (manifest, snapshot_path)."""
+    os.makedirs(out_dir, exist_ok=True)
+    with tarfile.open(package_path, "r:gz") as tar:
+        tar.extractall(out_dir, filter="data")
+    with open(os.path.join(out_dir, MANIFEST), encoding="utf-8") as f:
+        manifest = json.load(f)
+    return manifest, os.path.join(out_dir, manifest["snapshot"])
+
+
+def publish(package_path, store_dir):
+    """Upload to the store (versioned by name + timestamp)."""
+    manifest = read_manifest(package_path)
+    os.makedirs(store_dir, exist_ok=True)
+    dest = os.path.join(store_dir, "%s_%d.forge.tar.gz"
+                        % (manifest["name"], int(manifest["packaged_at"])))
+    shutil.copyfile(package_path, dest)
+    return dest
+
+
+def list_store(store_dir):
+    """[(package_path, manifest)] sorted newest-first."""
+    out = []
+    if not os.path.isdir(store_dir):
+        return out
+    for fname in sorted(os.listdir(store_dir), reverse=True):
+        if fname.endswith(".forge.tar.gz"):
+            path = os.path.join(store_dir, fname)
+            out.append((path, read_manifest(path)))
+    return out
+
+
+def fetch(store_dir, name, out_dir):
+    """Fetch the newest package named ``name``; returns (manifest,
+    snapshot_path)."""
+    for path, manifest in list_store(store_dir):
+        if manifest["name"] == name:
+            return unpack(path, out_dir)
+    raise KeyError("no package %r in %s" % (name, store_dir))
+
+
+def restore_package(package_path, build, out_dir=None):
+    """Unpack + restore into a live workflow: ``build()`` must return the
+    initialized workflow (SURVEY §3.3: the snapshot is the artifact)."""
+    from veles_tpu import snapshotter
+    out_dir = out_dir or tempfile.mkdtemp(prefix="forge_")
+    manifest, snapshot_path = unpack(package_path, out_dir)
+    wf = build()
+    snapshotter.restore(wf, snapshot_path)
+    return wf, manifest

@@ -1,0 +1,230 @@
+"""Genetic hyperparameter optimization over ``Tune()`` config leaves.
+
+Ref: veles/genetics/ [H] (SURVEY §2.1, §3.5): config values wrapped in
+``Tune(value, min, max)`` are genes; a GA population evaluates full training
+runs and selects on the Decision's best validation metric.  Driven by
+``--optimize [generations[:population]]`` exactly like the reference.
+
+The reference forked a process per individual; here individuals run
+sequentially in-process (each run rebuilds the workflow and reseeds the PRNG
+streams, so runs are independent), which keeps the TPU attached to one
+process — the distributed path shards DATA, not individuals.
+"""
+
+from __future__ import annotations
+
+from veles_tpu import prng
+from veles_tpu.config import Config, Tune, root
+from veles_tpu.logger import Logger
+
+
+def _walk_container(value, path, out):
+    """Recurse into list/dict leaves — layer configs keep their Tunes inside
+    a list of dicts (``root.x.layers[0].learning_rate``)."""
+    if isinstance(value, Tune):
+        out.append((path, value))
+    elif isinstance(value, dict):
+        for key, item in value.items():
+            _walk_container(item, "%s[%r]" % (path, key), out)
+    elif isinstance(value, (list, tuple)):
+        for i, item in enumerate(value):
+            _walk_container(item, "%s[%d]" % (path, i), out)
+
+
+def find_tunes(node=None, prefix="root"):
+    """[(path, Tune)] for every Tune leaf under ``node``, descending into
+    Config children AND list/dict leaf values."""
+    node = node if node is not None else root
+    out = []
+    for key, value in node.__dict__.items():
+        if key == "_path_":
+            continue
+        path = "%s.%s" % (prefix, key)
+        if isinstance(value, Config):
+            out.extend(find_tunes(value, path))
+        else:
+            _walk_container(value, path, out)
+    return sorted(out, key=lambda pair: pair[0])
+
+
+_TOKEN = __import__("re").compile(r"\.?([A-Za-z_]\w*)|\[([^\]]+)\]")
+
+
+def _tokenize(path):
+    tokens = []
+    for attr, index in _TOKEN.findall(path):
+        if attr:
+            tokens.append(("attr", attr))
+        else:
+            try:
+                tokens.append(("item", ast_literal(index)))
+            except Exception:
+                tokens.append(("item", index))
+    if tokens and tokens[0] == ("attr", "root"):
+        tokens = tokens[1:]
+    return tokens
+
+
+def ast_literal(text):
+    import ast
+    return ast.literal_eval(text)
+
+
+def set_leaf(path, value, cfg=None):
+    """Assign a (possibly container-indexed) config path, e.g.
+    ``root.mnist.layers[0]['learning_rate']``."""
+    node = cfg if cfg is not None else root
+    tokens = _tokenize(path)
+    for kind, token in tokens[:-1]:
+        node = getattr(node, token) if kind == "attr" else node[token]
+    kind, last = tokens[-1]
+    if kind == "attr":
+        setattr(node, last, value)
+    else:
+        node[last] = value
+
+
+class Population(Logger):
+    """Real-valued GA: tournament selection, blend crossover, gaussian
+    mutation, elitism.  Fitness is MINIMIZED."""
+
+    def __init__(self, genes, size=8, mutation_rate=0.3, mutation_scale=0.2,
+                 elite=1, seed_stream="genetics"):
+        #: genes: [(path, Tune)] — bounds come from the Tune markers
+        self.genes = genes
+        self.size = size
+        self.mutation_rate = mutation_rate
+        self.mutation_scale = mutation_scale
+        self.elite = elite
+        self.stream = prng.get(seed_stream)
+        self.individuals = []      # list of [value per gene]
+        self.fitnesses = []
+        self.history = []          # per generation: (best_fitness, best_genes)
+        self._spawn()
+
+    def _spawn(self):
+        self.individuals = []
+        for i in range(self.size):
+            if i == 0:     # seed individual = the configured values
+                self.individuals.append(
+                    [float(tune.value) for _, tune in self.genes])
+            else:
+                self.individuals.append([
+                    float(self.stream.uniform(tune.minv, tune.maxv))
+                    for _, tune in self.genes])
+
+    def apply(self, individual, cfg=None):
+        """Write an individual's gene values into the config tree."""
+        for (path, _), value in zip(self.genes, individual):
+            set_leaf(path, value, cfg)
+
+    def evolve(self):
+        """One generation step from self.fitnesses → new individuals."""
+        order = sorted(range(len(self.individuals)),
+                       key=lambda i: self.fitnesses[i])
+        best = self.individuals[order[0]]
+        self.history.append((self.fitnesses[order[0]], list(best)))
+        next_gen = [list(self.individuals[i]) for i in order[:self.elite]]
+
+        def tournament():
+            a, b = (int(self.stream.uniform(0, len(order))) for _ in "ab")
+            return self.individuals[min(a, b, key=lambda i:
+                                        self.fitnesses[i])]
+
+        while len(next_gen) < self.size:
+            pa, pb = tournament(), tournament()
+            child = []
+            for gi, ((_, tune), va, vb) in enumerate(
+                    zip(self.genes, pa, pb)):
+                mix = self.stream.uniform(0.0, 1.0)
+                value = mix * va + (1.0 - mix) * vb
+                if self.stream.uniform(0.0, 1.0) < self.mutation_rate:
+                    span = tune.maxv - tune.minv
+                    value += self.stream.normal(
+                        0.0, self.mutation_scale * span)
+                child.append(float(min(max(value, tune.minv), tune.maxv)))
+            next_gen.append(child)
+        self.individuals = next_gen
+        self.fitnesses = []
+        return best
+
+
+def optimize(evaluate, generations=5, population=8, genes=None,
+             log=None):
+    """Run the GA: ``evaluate(individual_as_config_applied) -> fitness``.
+
+    ``genes`` defaults to every Tune leaf under root.  Returns
+    (best_fitness, best_gene_dict, population).
+    """
+    genes = genes if genes is not None else find_tunes()
+    if not genes:
+        raise ValueError("no Tune(...) leaves found in the config tree — "
+                         "wrap values to optimize in Tune(value, min, max)")
+    pop = Population(genes, size=population)
+    for gen in range(generations):
+        pop.fitnesses = []
+        for individual in pop.individuals:
+            pop.apply(individual)
+            pop.fitnesses.append(evaluate(individual))
+        best = pop.evolve()
+        if log:
+            log("generation %d: best fitness %.6g (%s)" %
+                (gen, pop.history[-1][0],
+                 {p: round(v, 6) for (p, _), v in zip(genes, best)}))
+    best_fit, best_genes = min(pop.history)
+    # leave the config tree holding the WINNER, not the last-evaluated
+    # individual — "optimize, then train" must train the reported best
+    pop.apply(best_genes)
+    return best_fit, {path: value for (path, _), value in
+                      zip(genes, best_genes)}, pop
+
+
+def optimize_workflow(module, generations=5, population=8, seed=1,
+                      build_kwargs=None):
+    """GA over a sample module exposing ``run(load, main)``.
+
+    Fitness = the Decision's best validation metric of a full (short) run.
+    Each evaluation reseeds every PRNG stream so individuals differ only by
+    their genes.
+    """
+    logger = Logger()
+    genes = find_tunes()
+
+    def evaluate(individual):
+        prng.reset()
+        prng.seed_all(seed)
+        holder = {}
+
+        def load(workflow_cls, **kwargs):
+            kwargs.update(build_kwargs or {})
+            wf = workflow_cls(None, **kwargs)
+            holder["wf"] = wf
+            return wf
+
+        def main():
+            holder["wf"].initialize()
+            holder["wf"].run()
+
+        module.run(load, main)
+        decision = holder["wf"].decision
+        metric = decision.best_metric
+        return float("inf") if metric is None else float(metric)
+
+    return optimize(evaluate, generations=generations, population=population,
+                    genes=genes, log=logger.info)
+
+
+def optimize_cli(module, args):
+    """--optimize entry point (ref: Main --optimize [H])."""
+    spec = str(args.optimize)
+    if ":" in spec:
+        generations, population = (int(x) for x in spec.split(":"))
+    else:
+        generations, population = int(spec), 8
+    best_fit, best_genes, _ = optimize_workflow(
+        module, generations=generations, population=population,
+        seed=args.random_seed or 1)
+    print("best fitness: %s" % best_fit)
+    for path, value in best_genes.items():
+        print("  %s = %s" % (path, value))
+    return 0

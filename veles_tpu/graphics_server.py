@@ -1,0 +1,44 @@
+"""Graphics transport — plot specs over ZeroMQ PUB/SUB.
+
+Ref: veles/graphics_server.py::GraphicsServer [H] (SURVEY §2.1): the
+reference pickled matplotlib state and PUB'd it to a separate renderer
+process so heavy drawing never blocked training.  Same topology here with
+spec dicts (veles_tpu.plotter) as the wire format: the server owns a PUB
+socket, the client (veles_tpu.graphics_client) SUBs and renders to files
+(or a live backend where one exists).
+"""
+
+from __future__ import annotations
+
+import pickle
+
+
+class GraphicsServer:
+    """PUB endpoint the workflow's plotters send specs through.
+
+    ``endpoint`` "tcp://127.0.0.1:0" binds an ephemeral port (read it back
+    from ``self.endpoint``); "inproc://..." works for tests.
+    """
+
+    def __init__(self, endpoint="tcp://127.0.0.1:0", context=None):
+        import zmq
+        self._ctx = context or zmq.Context.instance()
+        self._sock = self._ctx.socket(zmq.PUB)
+        if endpoint.endswith(":0"):
+            port = self._sock.bind_to_random_port(endpoint[:-2])
+            self.endpoint = "%s:%d" % (endpoint[:-2], port)
+        else:
+            self._sock.bind(endpoint)
+            self.endpoint = endpoint
+
+    def send(self, spec):
+        self._sock.send(pickle.dumps(spec, pickle.HIGHEST_PROTOCOL))
+
+    def close(self):
+        """Broadcast end-of-stream and close."""
+        import zmq
+        try:
+            self._sock.send(pickle.dumps(None))
+        except zmq.ZMQError:
+            pass
+        self._sock.close(linger=200)

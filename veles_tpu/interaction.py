@@ -1,0 +1,51 @@
+"""Interactive shell unit — drop into a REPL mid-graph.
+
+Ref: veles/interaction.py::Shell [M] (SURVEY §2.1): a Unit that opens an
+IPython session inside the running graph for live inspection.  Uses IPython
+when importable, stdlib ``code.interact`` otherwise; a non-interactive
+process (no tty) skips with a warning instead of blocking, so graphs with a
+Shell unit still run under CI/batch.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from veles_tpu.mutable import Bool
+from veles_tpu.units import Unit
+
+
+class Shell(Unit):
+    """Gate with ``shell.gate_skip = <Bool>`` or set ``once=True`` (default)
+    to only break on the first pass."""
+
+    def __init__(self, workflow, once=True, banner=None, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.once = once
+        self.banner = banner or (
+            "veles_tpu shell — `wf` is the workflow, `unit` this unit; "
+            "Ctrl-D resumes the graph.")
+        self.fired = Bool(False)
+
+    def initialize(self, device=None, **kwargs):
+        super().initialize(device=device, **kwargs)
+
+    def interact(self, local):
+        """Overridable for tests; runs the actual REPL."""
+        try:
+            from IPython import embed
+            embed(user_ns=local, banner1=self.banner)
+        except ImportError:
+            import code
+            code.interact(banner=self.banner, local=local)
+
+    def run(self):
+        if self.once and bool(self.fired):
+            return
+        if not sys.stdin.isatty():
+            self.warning("no tty — skipping interactive shell")
+            self.fired.set(True)
+            return
+        self.fired.set(True)
+        self.interact({"wf": self.workflow, "unit": self,
+                       "workflow": self.workflow})

@@ -1,0 +1,69 @@
+"""InteractiveLoader — push samples from code into a live graph.
+
+Ref: veles/loader/interactive.py [M] (SURVEY §2.2): a queue the host
+program ``feed()``s; each graph cycle consumes one minibatch.  Used for
+serving/debug sessions where data arrives programmatically.
+"""
+
+from __future__ import annotations
+
+import collections
+
+import numpy
+
+from veles_tpu.loader.base import Loader, TEST
+
+
+class InteractiveLoader(Loader):
+    def __init__(self, workflow, sample_shape=(1,), **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.sample_shape = tuple(sample_shape)
+        self._queue = collections.deque()
+
+    def feed(self, data, label=0):
+        """Queue one sample (exact ``sample_shape``) or a batch
+        (``(n,) + sample_shape``); anything else raises — a silent
+        broadcast would fabricate garbage samples."""
+        data = numpy.asarray(data, numpy.float32)
+        if data.shape == self.sample_shape:
+            self._queue.append((data, int(label)))
+        elif data.shape[1:] == self.sample_shape:
+            labels = (label if hasattr(label, "__len__")
+                      else [label] * len(data))
+            for sample, lab in zip(data, labels):
+                self._queue.append((numpy.asarray(sample), int(lab)))
+        else:
+            raise ValueError(
+                "feed: data shape %s is neither %s nor (n,) + %s"
+                % (data.shape, self.sample_shape, self.sample_shape))
+        return self
+
+    def load_data(self):
+        self.class_lengths = [self.max_minibatch_size, 0, 0]
+
+    def create_minibatch_data(self):
+        mb = self.max_minibatch_size
+        self.minibatch_data.reset(
+            numpy.zeros((mb,) + self.sample_shape, numpy.float32))
+        self.minibatch_labels.reset(numpy.zeros(mb, numpy.int32))
+
+    def fill_minibatch(self, indices, actual_size):
+        mb = self.max_minibatch_size
+        data = numpy.zeros((mb,) + self.sample_shape, numpy.float32)
+        labels = numpy.zeros(mb, numpy.int32)
+        mask = numpy.zeros(mb, numpy.float32)
+        count = 0
+        while count < mb and self._queue:
+            sample, lab = self._queue.popleft()
+            data[count] = sample
+            labels[count] = lab
+            mask[count] = 1.0
+            count += 1
+        self.minibatch_data.reset(data)
+        self.minibatch_labels.reset(labels)
+        self.minibatch_mask.reset(mask)
+        self.minibatch_size = count
+
+    def run(self):
+        super().run()
+        self.minibatch_class = TEST

@@ -109,7 +109,11 @@ class DecisionBase(Unit):
         self.epoch_metrics.append(self._current)
         key_set = ("validation" if "validation" in self._current else
                    "train" if "train" in self._current else "test")
-        metric = self.epoch_metric(self._current.get(key_set, {}))
+        key_metrics = self._current.get(key_set, {})
+        # an empty set (0 live samples — e.g. an exhausted stream loader)
+        # must not register as a perfect-score improvement
+        metric = (self.epoch_metric(key_metrics)
+                  if key_metrics.get("count", 0) > 0 else None)
         self.improved.set(
             metric is not None and
             (self.best_metric is None or metric < self.best_metric))
@@ -166,6 +170,16 @@ class DecisionGD(DecisionBase):
 
     def epoch_metric(self, set_metrics):
         return set_metrics.get("n_err")
+
+    @property
+    def confusion_matrix(self):
+        """Latest confusion matrix (validation preferred) — the
+        MatrixPlotter source."""
+        for metrics in reversed(self.epoch_metrics):
+            for set_name in ("validation", "test", "train"):
+                if "confusion" in metrics.get(set_name, {}):
+                    return metrics[set_name]["confusion"]
+        return None
 
 
 class DecisionMSE(DecisionBase):

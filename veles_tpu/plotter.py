@@ -1,0 +1,119 @@
+"""Plotter base unit + headless spec rendering.
+
+Ref: veles/plotter.py::Plotter + veles/graphics_server.py transport [H]
+(SURVEY §2.1 "Plotting transport", §5.5).  The reference pickled live
+matplotlib state and PUB'd it over ZeroMQ to a separate renderer process.
+Redesign: plotters emit small PICKLABLE SPEC DICTS (kind + arrays); one
+renderer function turns a spec into a PNG/SVG.  The same spec feeds three
+sinks — direct headless file output (default), the ZMQ graphics server
+(separate renderer process, reference parity), or tests asserting on specs
+without matplotlib at all.
+"""
+
+from __future__ import annotations
+
+import os
+
+from veles_tpu.units import Unit
+
+
+def render_spec(spec, path):
+    """Render one plot spec to ``path`` (matplotlib Agg, headless)."""
+    import matplotlib
+    matplotlib.use("Agg", force=False)
+    import matplotlib.pyplot as plt
+
+    kind = spec["kind"]
+    fig, ax = plt.subplots(figsize=spec.get("figsize", (6, 4)))
+    try:
+        if kind == "curve":
+            for label, ys in spec["series"].items():
+                ax.plot(spec.get("x", range(len(ys))), ys, label=label)
+            ax.legend(loc="best")
+            ax.set_xlabel(spec.get("xlabel", "epoch"))
+            ax.set_ylabel(spec.get("ylabel", ""))
+        elif kind == "matrix":
+            im = ax.imshow(spec["matrix"], cmap=spec.get("cmap", "viridis"),
+                           interpolation="nearest")
+            fig.colorbar(im, ax=ax)
+        elif kind == "hist":
+            ax.hist(spec["values"], bins=spec.get("bins", 30))
+            ax.set_xlabel(spec.get("xlabel", ""))
+        elif kind == "image_grid":
+            import numpy
+            images = numpy.asarray(spec["images"])
+            n = len(images)
+            cols = spec.get("cols") or max(1, int(numpy.ceil(n ** 0.5)))
+            rows = -(-n // cols)
+            fig.clf()
+            for i in range(n):
+                sub = fig.add_subplot(rows, cols, i + 1)
+                img = images[i]
+                if img.ndim == 3 and img.shape[-1] == 1:
+                    img = img[:, :, 0]
+                sub.imshow(img, cmap=spec.get("cmap", "gray"))
+                sub.axis("off")
+        else:
+            raise ValueError("unknown plot kind %r" % kind)
+        if spec.get("title"):
+            fig.suptitle(spec["title"])
+        fig.savefig(path, bbox_inches="tight")
+    finally:
+        plt.close(fig)
+    return path
+
+
+class Plotter(Unit):
+    """Base plotter: builds a spec each redraw, hands it to the sink.
+
+    Sinks, in priority order: the workflow's ``graphics_server`` attribute
+    (ZMQ PUB, reference topology) if present, else a PNG under
+    ``output_dir``.  ``specs`` keeps the history for tests/publishing.
+    """
+
+    def __init__(self, workflow, output_dir="plots", redraw_interval=1,
+                 only_on_epoch_end=True, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.output_dir = output_dir
+        self.redraw_interval = int(redraw_interval)
+        #: redraw only on epoch boundaries (the reference gated its plotters
+        #: off decision's epoch-end flags the same way)
+        self.only_on_epoch_end = only_on_epoch_end
+        self.specs = []
+        self._runs = 0
+
+    def plot_spec(self):
+        """Return the current spec dict (or None to skip)."""
+        raise NotImplementedError
+
+    def initialize(self, device=None, **kwargs):
+        super().initialize(device=device, **kwargs)
+
+    def run(self):
+        if self.only_on_epoch_end and not getattr(
+                getattr(self.workflow, "loader", None), "epoch_ended", True):
+            return
+        self._runs += 1
+        if self._runs % self.redraw_interval:
+            return
+        self.redraw()
+
+    def redraw(self):
+        spec = self.plot_spec()
+        if spec is None:
+            return
+        spec.setdefault("name", self.name)
+        self.specs.append(spec)
+        server = getattr(self.workflow, "graphics_server", None)
+        if server is not None:
+            server.send(spec)
+        else:
+            os.makedirs(self.output_dir, exist_ok=True)
+            render_spec(spec, os.path.join(
+                self.output_dir, "%s_%04d.png" % (self.name,
+                                                  len(self.specs))))
+
+    def stop(self):
+        # the completion wave can end the run before the last epoch-end
+        # redraw fires; always capture the final state
+        self.redraw()

@@ -1,0 +1,134 @@
+"""Publishing — post-training report generation.
+
+Ref: veles/publishing/::Publisher (+ HTML/PDF/Confluence backends) [M]
+(SURVEY §2.1).  Gathers the run's facts (workflow, config, epochs, metrics,
+plots) and renders them through a backend; in-tree backends are Markdown and
+self-contained HTML (no jinja2 dependency — stdlib string formatting).
+"""
+
+from __future__ import annotations
+
+import base64
+import html
+import json
+import os
+import time
+
+
+def gather(workflow, launcher=None, plots=()):
+    """Collect the report facts from a finished workflow."""
+    decision = getattr(workflow, "decision", None)
+    facts = {
+        "workflow": workflow.name,
+        "workflow_class": type(workflow).__name__,
+        "generated_at": time.strftime("%Y-%m-%d %H:%M:%S"),
+        "run_seconds": getattr(launcher, "run_seconds", None),
+        "best_metric": getattr(decision, "best_metric", None),
+        "best_epoch": getattr(decision, "best_epoch", None),
+        "epochs": [],
+        "units": [u.name for u in workflow],
+        "plots": list(plots),
+    }
+    if decision is not None:
+        for i, epoch in enumerate(decision.epoch_metrics):
+            row = {"epoch": i + 1}
+            for set_name, metrics in epoch.items():
+                for key, value in metrics.items():
+                    if isinstance(value, (int, float)):
+                        row["%s_%s" % (set_name, key)] = value
+            facts["epochs"].append(row)
+    return facts
+
+
+class MarkdownBackend:
+    suffix = ".md"
+
+    def render(self, facts):
+        lines = ["# Training report: %s" % facts["workflow"],
+                 "",
+                 "- class: `%s`" % facts["workflow_class"],
+                 "- generated: %s" % facts["generated_at"],
+                 "- best metric: **%s** (epoch %s)"
+                 % (facts["best_metric"], facts["best_epoch"])]
+        if facts["run_seconds"]:
+            lines.append("- run time: %.1fs" % facts["run_seconds"])
+        if facts["epochs"]:
+            keys = sorted({k for row in facts["epochs"] for k in row})
+            lines += ["", "| " + " | ".join(keys) + " |",
+                      "|" + "---|" * len(keys)]
+            for row in facts["epochs"]:
+                lines.append("| " + " | ".join(
+                    ("%.6g" % row[k]) if isinstance(row.get(k), float)
+                    else str(row.get(k, "")) for k in keys) + " |")
+        lines += ["", "Units: " + ", ".join(facts["units"])]
+        return "\n".join(lines) + "\n"
+
+
+class HTMLBackend:
+    suffix = ".html"
+
+    def render(self, facts):
+        rows = ""
+        if facts["epochs"]:
+            keys = sorted({k for row in facts["epochs"] for k in row})
+            head = "".join("<th>%s</th>" % html.escape(k) for k in keys)
+            body = ""
+            for row in facts["epochs"]:
+                body += "<tr>" + "".join(
+                    "<td>%s</td>" % (("%.6g" % row[k])
+                                     if isinstance(row.get(k), float)
+                                     else row.get(k, "")) for k in keys) + \
+                    "</tr>"
+            rows = "<table><tr>%s</tr>%s</table>" % (head, body)
+        imgs = ""
+        for path in facts["plots"]:
+            if os.path.exists(path):
+                with open(path, "rb") as f:
+                    b64 = base64.b64encode(f.read()).decode("ascii")
+                imgs += ('<img src="data:image/png;base64,%s" '
+                         'style="max-width:45%%; margin:4px"/>' % b64)
+        return ("<!doctype html><html><head><meta charset='utf-8'>"
+                "<title>%(name)s report</title></head><body>"
+                "<h1>Training report: %(name)s</h1>"
+                "<p>class <code>%(cls)s</code> — generated %(at)s</p>"
+                "<p>best metric <b>%(best)s</b> at epoch %(epoch)s</p>"
+                "%(rows)s%(imgs)s</body></html>") % {
+            "name": html.escape(str(facts["workflow"])),
+            "cls": html.escape(str(facts["workflow_class"])),
+            "at": facts["generated_at"],
+            "best": facts["best_metric"],
+            "epoch": facts["best_epoch"],
+            "rows": rows,
+            "imgs": imgs,
+        }
+
+
+class JSONBackend:
+    suffix = ".json"
+
+    def render(self, facts):
+        return json.dumps(facts, indent=2, default=str)
+
+
+BACKENDS = {"markdown": MarkdownBackend, "html": HTMLBackend,
+            "json": JSONBackend}
+
+
+class Publisher:
+    """Render a finished workflow's report with the chosen backends."""
+
+    def __init__(self, backends=("markdown", "html")):
+        self.backends = [BACKENDS[b]() if isinstance(b, str) else b
+                         for b in backends]
+
+    def publish(self, workflow, out_dir, launcher=None, plots=()):
+        facts = gather(workflow, launcher, plots)
+        os.makedirs(out_dir, exist_ok=True)
+        paths = []
+        for backend in self.backends:
+            path = os.path.join(
+                out_dir, "report_%s%s" % (facts["workflow"], backend.suffix))
+            with open(path, "w", encoding="utf-8") as f:
+                f.write(backend.render(facts))
+            paths.append(path)
+        return paths

@@ -1,0 +1,128 @@
+"""REST serving — HTTP JSON in, forward pass out.
+
+Ref: veles/restful_api.py::RESTfulAPI [M] (SURVEY §2.1, §3.4): feed JSON
+input through a trained forward pass over HTTP.  stdlib http.server on a
+background thread (the reference used Twisted web); the forward is the
+fused chain jitted once, so per-request work is one device dispatch.
+
+Usage::
+
+    api = RESTfulAPI(workflow)          # a trained StandardWorkflow
+    api.start(port=0)                   # 0 → ephemeral
+    ... POST {"input": [[...]]} to http://host:port/predict ...
+    api.stop()
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy
+
+from veles_tpu.logger import Logger
+
+
+class RESTfulAPI(Logger):
+    def __init__(self, workflow, normalizer=None):
+        self.workflow = workflow
+        #: optional input normalizer (a loader's fitted normalizer) applied
+        #: before the forward, so clients send raw feature scale
+        self.normalizer = normalizer
+        self._server = None
+        self._thread = None
+        self._forward = None
+
+    # ------------------------------------------------------------- inference
+    def _ensure_forward(self):
+        if self._forward is not None:
+            return self._forward
+        runner = getattr(self.workflow, "_fused_runner", None)
+        if runner is not None:
+            fn = runner.eval_forward()
+
+            def forward(x):
+                return numpy.asarray(fn(runner.state, x))
+        else:
+            units = self.workflow.forwards
+
+            def forward(x):
+                import jax.numpy as jnp
+                h = jnp.asarray(x)
+                for unit in units:
+                    entry = {}
+                    if unit.has_params:
+                        entry = {"w": unit.weights.devmem}
+                        if unit.include_bias:
+                            entry["b"] = unit.bias.devmem
+                    h = unit.apply_fused(h, entry, None, False)
+                return numpy.asarray(h)
+        self._forward = forward
+        return forward
+
+    def predict(self, batch):
+        x = numpy.asarray(batch, numpy.float32)
+        if self.normalizer is not None:
+            x = self.normalizer.apply(x)
+        probs = self._ensure_forward()(x)
+        return {"output": probs.tolist(),
+                "argmax": probs.reshape(len(probs), -1)
+                               .argmax(axis=1).tolist()}
+
+    # ---------------------------------------------------------------- server
+    def start(self, host="127.0.0.1", port=8180):
+        api = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):
+                if self.path.rstrip("/") != "/predict":
+                    self.send_error(404)
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    payload = json.loads(self.rfile.read(length))
+                    result = api.predict(payload["input"])
+                    body = json.dumps(result).encode("utf-8")
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                except Exception as e:   # noqa: BLE001 — reported to client
+                    body = json.dumps({"error": str(e)}).encode("utf-8")
+                    self.send_response(400)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+
+            def log_message(self, fmt, *args):
+                api.debug("restful: " + fmt, *args)
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        self.port = self._server.server_address[1]
+        self.info("REST serving on http://%s:%d/predict", host, self.port)
+        return self
+
+    def stop(self):
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+
+def serve_snapshot(path, host="127.0.0.1", port=8180, build=None):
+    """CLI helper: restore a snapshot into a rebuilt workflow and serve it.
+
+    ``build`` is a zero-arg callable returning the (initialized) workflow —
+    usually a sample's ``build`` + ``initialize``; the snapshot then restores
+    the trained weights (SURVEY §3.3/§3.4 snapshot-is-the-artifact flow).
+    """
+    from veles_tpu import snapshotter
+    wf = build()
+    snapshotter.restore(wf, path)
+    return RESTfulAPI(wf).start(host=host, port=port)

@@ -202,6 +202,36 @@ class StandardWorkflowBase(NNWorkflow):
         self.snapshotter = snap
         return snap
 
+    def link_plotters(self, output_dir="plots", weights_2d=True):
+        """Attach the standard plotter set: metric curves, confusion matrix
+        (softmax) and first-layer weight images, all redrawn at epoch ends.
+        Ref: veles/znicz/standard_workflow.py's plotter wiring [H]; headless
+        file output by default, ZMQ when a graphics_server is attached.
+        """
+        from veles_tpu.plotting_units import (AccumulatingPlotter,
+                                              MatrixPlotter)
+        from veles_tpu.nn_plotting_units import Weights2D
+        plotters = []
+        metric = "err_pct" if self.loss_function == "softmax" else "rmse"
+        curve = AccumulatingPlotter(self, metric=metric,
+                                    output_dir=output_dir, name="plot_curve")
+        curve.input = self.decision
+        plotters.append(curve)
+        if self.loss_function == "softmax":
+            confusion = MatrixPlotter(self, output_dir=output_dir,
+                                      name="plot_confusion")
+            confusion.input = self.decision
+            plotters.append(confusion)
+        if weights_2d:
+            w2d = Weights2D(self, output_dir=output_dir, name="plot_weights")
+            w2d.input = next((f for f in self.forwards if f.has_params),
+                             self.forwards[0])
+            plotters.append(w2d)
+        for plotter in plotters:
+            plotter.link_from(self.decision)
+        self.plotters = plotters
+        return plotters
+
     def link_end_point(self):
         self.end_point.link_from(self.decision)
         self.end_point.gate_block = ~self.decision.complete

@@ -1,0 +1,133 @@
+"""Web status — live dashboard of running workflows.
+
+Ref: veles/web_status.py + web/ frontend [M] (SURVEY §2.1, §5.5): the
+reference ran a tornado service showing masters/slaves, progress and the
+workflow graph.  Lite redesign: an stdlib HTTP server on a background
+thread serving ``/status.json`` (machine-readable) and ``/`` (a small
+self-refreshing HTML table).  Workflows register themselves; a
+``StatusReporter`` unit linked off the decision pushes per-epoch progress.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from veles_tpu.units import Unit
+
+_PAGE = """<!doctype html><html><head><meta charset="utf-8">
+<meta http-equiv="refresh" content="2"><title>veles_tpu status</title>
+<style>body{font-family:monospace} table{border-collapse:collapse}
+td,th{border:1px solid #999;padding:4px 8px}</style></head><body>
+<h2>veles_tpu — running workflows</h2><table><tr>
+<th>workflow</th><th>epoch</th><th>best</th><th>last metrics</th>
+<th>updated</th></tr>%s</table></body></html>"""
+
+
+class WebStatus:
+    """The dashboard server; share one instance per process."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries = {}
+        self._server = None
+        self._thread = None
+        self.port = None
+
+    # ------------------------------------------------------------- reporting
+    def update(self, name, **fields):
+        with self._lock:
+            entry = self._entries.setdefault(name, {})
+            entry.update(fields, updated=time.time())
+
+    def snapshot(self):
+        with self._lock:
+            return json.loads(json.dumps(self._entries, default=str))
+
+    # ---------------------------------------------------------------- server
+    def start(self, host="127.0.0.1", port=0):
+        status = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path.startswith("/status.json"):
+                    body = json.dumps(status.snapshot(),
+                                      default=str).encode()
+                    ctype = "application/json"
+                elif self.path == "/" or self.path.startswith("/index"):
+                    import html as html_mod
+                    rows = ""
+                    for name, e in sorted(status.snapshot().items()):
+                        rows += ("<tr><td>%s</td><td>%s</td><td>%s</td>"
+                                 "<td>%s</td><td>%s</td></tr>") % tuple(
+                            html_mod.escape(str(v)) for v in (
+                                name, e.get("epoch", ""), e.get("best", ""),
+                                e.get("metrics", ""), e.get("updated", "")))
+                    body = (_PAGE % rows).encode()
+                    ctype = "text/html"
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt, *args):
+                pass
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+
+_default = None
+
+
+def get_default():
+    global _default
+    if _default is None:
+        _default = WebStatus()
+    return _default
+
+
+class StatusReporter(Unit):
+    """Graph unit pushing decision progress into a WebStatus.
+
+    Wire: ``reporter.link_from(decision)`` + link_attrs epoch_number etc.,
+    or just construct with the workflow — it reads the decision directly.
+    """
+
+    def __init__(self, workflow, status=None, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.status = status or get_default()
+
+    def initialize(self, device=None, **kwargs):
+        super().initialize(device=device, **kwargs)
+
+    def run(self):
+        wf = self.workflow
+        decision = getattr(wf, "decision", None)
+        if decision is None:
+            return
+        last = decision.epoch_metrics[-1] if decision.epoch_metrics else {}
+        metrics = {set_name: {k: v for k, v in m.items()
+                              if isinstance(v, (int, float))}
+                   for set_name, m in last.items()}
+        self.status.update(wf.name,
+                           epoch=int(getattr(decision, "epoch_number", 0)),
+                           best=decision.best_metric,
+                           complete=bool(decision.complete),
+                           metrics=metrics)

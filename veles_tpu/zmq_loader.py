@@ -1,0 +1,107 @@
+"""ZeroMQLoader — feed external data into a running graph over ZeroMQ.
+
+Ref: veles/zmq_loader.py::ZeroMQLoader [M] (SURVEY §2.1): a PULL socket
+receives pickled samples from external producers; the loader blocks (with a
+timeout) until a minibatch-worth arrives.  Producers connect with PUSH and
+send ``{"data": ndarray, "label": int}`` pickles; ``None`` signals
+end-of-stream.
+"""
+
+from __future__ import annotations
+
+import pickle
+
+import numpy
+
+from veles_tpu.loader.base import Loader, TRAIN
+from veles_tpu.mutable import Bool
+
+
+class ZeroMQLoader(Loader):
+    """Gate the workflow's end on ``complete``: it flips True once the
+    producer's end-of-stream ``None`` has been consumed (wire
+    ``end_point.gate_block = ~loader.complete`` — or let the decision stop;
+    empty post-stream minibatches score as empty sets, never improvements).
+    """
+
+    def __init__(self, workflow, endpoint="tcp://127.0.0.1:0",
+                 sample_shape=(1,), timeout_ms=10000, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.endpoint = endpoint
+        self.sample_shape = tuple(sample_shape)
+        self.timeout_ms = timeout_ms
+        self._sock = None
+        self.exhausted = False
+        self.complete = Bool(False)
+
+    def load_data(self):
+        import zmq
+        ctx = zmq.Context.instance()
+        self._sock = ctx.socket(zmq.PULL)
+        if self.endpoint.endswith(":0"):
+            port = self._sock.bind_to_random_port(self.endpoint[:-2])
+            self.endpoint = "%s:%d" % (self.endpoint[:-2], port)
+        else:
+            self._sock.bind(self.endpoint)
+        # stream length is unknown; advertise one epoch of one minibatch and
+        # keep re-planning until the producer sends the end-of-stream None
+        self.class_lengths = [0, 0, self.max_minibatch_size]
+
+    def create_minibatch_data(self):
+        mb = self.max_minibatch_size
+        self.minibatch_data.reset(
+            numpy.zeros((mb,) + self.sample_shape, numpy.float32))
+        self.minibatch_labels.reset(numpy.zeros(mb, numpy.int32))
+
+    def _recv(self):
+        import zmq
+        if not self._sock.poll(self.timeout_ms, zmq.POLLIN):
+            raise TimeoutError("ZeroMQLoader: no sample within %dms"
+                               % self.timeout_ms)
+        return pickle.loads(self._sock.recv())
+
+    def fill_minibatch(self, indices, actual_size):
+        mb = self.max_minibatch_size
+        data = numpy.zeros((mb,) + self.sample_shape, numpy.float32)
+        labels = numpy.zeros(mb, numpy.int32)
+        mask = numpy.zeros(mb, numpy.float32)
+        count = 0
+        while count < mb and not self.exhausted:
+            sample = self._recv()
+            if sample is None:
+                self.exhausted = True
+                break
+            data[count] = numpy.asarray(sample["data"], numpy.float32)
+            labels[count] = int(sample.get("label", 0))
+            mask[count] = 1.0
+            count += 1
+        self.minibatch_data.reset(data)
+        self.minibatch_labels.reset(labels)
+        self.minibatch_mask.reset(mask)
+        self.minibatch_size = count
+        if self.exhausted and count == 0:
+            self.complete.set(True)
+
+    def run(self):
+        # the one-minibatch plan makes every delivery its own "epoch", so
+        # downstream epoch bookkeeping (decision, snapshotter) advances per
+        # delivery automatically
+        super().run()
+        self.minibatch_class = TRAIN
+
+    def stop(self):
+        if self._sock is not None:
+            self._sock.close(linger=0)
+            self._sock = None
+
+
+def push_samples(endpoint, samples, context=None):
+    """Producer-side helper: PUSH samples (then None) to a ZeroMQLoader."""
+    import zmq
+    ctx = context or zmq.Context.instance()
+    sock = ctx.socket(zmq.PUSH)
+    sock.connect(endpoint)
+    for sample in samples:
+        sock.send(pickle.dumps(sample, pickle.HIGHEST_PROTOCOL))
+    sock.send(pickle.dumps(None))
+    sock.close(linger=1000)
