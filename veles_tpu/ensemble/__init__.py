@@ -11,7 +11,6 @@ from __future__ import annotations
 
 import numpy
 
-from veles_tpu import prng
 from veles_tpu.logger import Logger
 from veles_tpu.loader.base import VALID
 
@@ -28,24 +27,11 @@ class EnsembleTrainer(Logger):
         self.members = []       # (seed, workflow, summary)
 
     def train(self):
+        from veles_tpu.samples import run_sample
         for i in range(self.size):
             seed = self.base_seed + i
-            prng.reset()
-            prng.seed_all(seed)
-            holder = {}
-
-            def load(workflow_cls, **kwargs):
-                kwargs.update(self.build_kwargs)
-                wf = workflow_cls(None, **kwargs)
-                holder["wf"] = wf
-                return wf
-
-            def main():
-                holder["wf"].initialize()
-                holder["wf"].run()
-
-            self.module.run(load, main)
-            wf = holder["wf"]
+            wf = run_sample(self.module, seed=seed,
+                            build_kwargs=self.build_kwargs)
             summary = {"seed": seed,
                        "best_metric": wf.decision.best_metric,
                        "best_epoch": wf.decision.best_epoch}

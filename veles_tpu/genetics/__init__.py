@@ -13,6 +13,9 @@ process — the distributed path shards DATA, not individuals.
 
 from __future__ import annotations
 
+import ast
+import re
+
 from veles_tpu import prng
 from veles_tpu.config import Config, Tune, root
 from veles_tpu.logger import Logger
@@ -20,13 +23,15 @@ from veles_tpu.logger import Logger
 
 def _walk_container(value, path, out):
     """Recurse into list/dict leaves — layer configs keep their Tunes inside
-    a list of dicts (``root.x.layers[0].learning_rate``)."""
+    a list of dicts (``root.x.layers[0].learning_rate``).  Tuples are
+    immutable (set_leaf could not write the gene back), so they are
+    deliberately NOT descended."""
     if isinstance(value, Tune):
         out.append((path, value))
     elif isinstance(value, dict):
         for key, item in value.items():
             _walk_container(item, "%s[%r]" % (path, key), out)
-    elif isinstance(value, (list, tuple)):
+    elif isinstance(value, list):
         for i, item in enumerate(value):
             _walk_container(item, "%s[%d]" % (path, i), out)
 
@@ -47,7 +52,7 @@ def find_tunes(node=None, prefix="root"):
     return sorted(out, key=lambda pair: pair[0])
 
 
-_TOKEN = __import__("re").compile(r"\.?([A-Za-z_]\w*)|\[([^\]]+)\]")
+_TOKEN = re.compile(r"\.?([A-Za-z_]\w*)|\[([^\]]+)\]")
 
 
 def _tokenize(path):
@@ -57,17 +62,12 @@ def _tokenize(path):
             tokens.append(("attr", attr))
         else:
             try:
-                tokens.append(("item", ast_literal(index)))
-            except Exception:
+                tokens.append(("item", ast.literal_eval(index)))
+            except (ValueError, SyntaxError):
                 tokens.append(("item", index))
     if tokens and tokens[0] == ("attr", "root"):
         tokens = tokens[1:]
     return tokens
-
-
-def ast_literal(text):
-    import ast
-    return ast.literal_eval(text)
 
 
 def set_leaf(path, value, cfg=None):
@@ -161,11 +161,17 @@ def optimize(evaluate, generations=5, population=8, genes=None,
         raise ValueError("no Tune(...) leaves found in the config tree — "
                          "wrap values to optimize in Tune(value, min, max)")
     pop = Population(genes, size=population)
+    # evaluations are deterministic (fixed seed per run), so carried-over
+    # elites reuse their cached fitness instead of re-training
+    fitness_cache = {}
     for gen in range(generations):
         pop.fitnesses = []
         for individual in pop.individuals:
-            pop.apply(individual)
-            pop.fitnesses.append(evaluate(individual))
+            key = tuple(individual)
+            if key not in fitness_cache:
+                pop.apply(individual)
+                fitness_cache[key] = evaluate(individual)
+            pop.fitnesses.append(fitness_cache[key])
         best = pop.evolve()
         if log:
             log("generation %d: best fitness %.6g (%s)" %
@@ -191,23 +197,9 @@ def optimize_workflow(module, generations=5, population=8, seed=1,
     genes = find_tunes()
 
     def evaluate(individual):
-        prng.reset()
-        prng.seed_all(seed)
-        holder = {}
-
-        def load(workflow_cls, **kwargs):
-            kwargs.update(build_kwargs or {})
-            wf = workflow_cls(None, **kwargs)
-            holder["wf"] = wf
-            return wf
-
-        def main():
-            holder["wf"].initialize()
-            holder["wf"].run()
-
-        module.run(load, main)
-        decision = holder["wf"].decision
-        metric = decision.best_metric
+        from veles_tpu.samples import run_sample
+        wf = run_sample(module, seed=seed, build_kwargs=build_kwargs)
+        metric = wf.decision.best_metric
         return float("inf") if metric is None else float(metric)
 
     return optimize(evaluate, generations=generations, population=population,

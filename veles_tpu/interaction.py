@@ -27,9 +27,6 @@ class Shell(Unit):
             "Ctrl-D resumes the graph.")
         self.fired = Bool(False)
 
-    def initialize(self, device=None, **kwargs):
-        super().initialize(device=device, **kwargs)
-
     def interact(self, local):
         """Overridable for tests; runs the actual REPL."""
         try:

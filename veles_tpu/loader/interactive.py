@@ -11,13 +11,13 @@ import collections
 
 import numpy
 
-from veles_tpu.loader.base import Loader, TEST
+from veles_tpu.loader.base import TEST
+from veles_tpu.loader.stream import StreamLoaderBase
 
 
-class InteractiveLoader(Loader):
+class InteractiveLoader(StreamLoaderBase):
     def __init__(self, workflow, sample_shape=(1,), **kwargs):
-        super().__init__(workflow, **kwargs)
-        self.sample_shape = tuple(sample_shape)
+        super().__init__(workflow, sample_shape=sample_shape, **kwargs)
         self._queue = collections.deque()
 
     def feed(self, data, label=0):
@@ -41,28 +41,8 @@ class InteractiveLoader(Loader):
     def load_data(self):
         self.class_lengths = [self.max_minibatch_size, 0, 0]
 
-    def create_minibatch_data(self):
-        mb = self.max_minibatch_size
-        self.minibatch_data.reset(
-            numpy.zeros((mb,) + self.sample_shape, numpy.float32))
-        self.minibatch_labels.reset(numpy.zeros(mb, numpy.int32))
-
-    def fill_minibatch(self, indices, actual_size):
-        mb = self.max_minibatch_size
-        data = numpy.zeros((mb,) + self.sample_shape, numpy.float32)
-        labels = numpy.zeros(mb, numpy.int32)
-        mask = numpy.zeros(mb, numpy.float32)
-        count = 0
-        while count < mb and self._queue:
-            sample, lab = self._queue.popleft()
-            data[count] = sample
-            labels[count] = lab
-            mask[count] = 1.0
-            count += 1
-        self.minibatch_data.reset(data)
-        self.minibatch_labels.reset(labels)
-        self.minibatch_mask.reset(mask)
-        self.minibatch_size = count
+    def next_sample(self):
+        return self._queue.popleft() if self._queue else None
 
     def run(self):
         super().run()

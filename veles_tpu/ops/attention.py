@@ -1,0 +1,129 @@
+"""Attention ops — the long-context compute core.
+
+The reference pre-dates attention entirely (SURVEY §5.7: "absent"), so this
+module is BEYOND-PARITY capability, designed TPU-first rather than ported:
+
+- ``attention``: standard scaled-dot-product (the XLA-fused baseline — on
+  short sequences XLA's fusion of softmax(QK^T)V is already near-roofline);
+- ``blockwise_attention``: flash-style online-softmax over key/value blocks
+  via ``lax.scan`` — O(block) memory instead of O(seq²), the single-chip
+  long-context path;
+- ``mha_forward`` / ``init_mha_params``: a multi-head layer as a pure
+  function over a param pytree (the transformer building block);
+- the multi-chip sequence-parallel path (ring attention over a mesh axis)
+  lives in ``veles_tpu.parallel.ring`` and reuses the same online-softmax
+  update (``_online_update``) so the two decompositions agree numerically.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from veles_tpu.ops.functional import matmul
+
+NEG_INF = -1e30
+
+
+def attention(q, k, v, causal=False, bias=None):
+    """Dense scaled-dot-product attention.
+
+    q, k, v: (..., heads, seq, head_dim) — returns the same shape as q.
+    """
+    dh = q.shape[-1]
+    scores = matmul(q, jnp.swapaxes(k, -1, -2)) / jnp.sqrt(
+        jnp.asarray(dh, q.dtype))
+    if bias is not None:
+        scores = scores + bias
+    if causal:
+        s_q, s_k = scores.shape[-2], scores.shape[-1]
+        mask = jnp.tril(jnp.ones((s_q, s_k), bool), s_k - s_q)
+        scores = jnp.where(mask, scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return matmul(probs, v)
+
+
+def _online_update(carry, q, k, v, score_bias):
+    """One online-softmax accumulation step (flash/ring shared core).
+
+    carry: (o, l, m) with o (..., sq, dh), l/m (..., sq).
+    Returns the updated carry given this key/value block.
+    """
+    o, l, m = carry
+    dh = q.shape[-1]
+    s = matmul(q, jnp.swapaxes(k, -1, -2)) / jnp.sqrt(
+        jnp.asarray(dh, q.dtype))
+    if score_bias is not None:
+        s = s + score_bias
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    alpha = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new[..., None])
+    l_new = l * alpha + p.sum(axis=-1)
+    o_new = o * alpha[..., None] + matmul(p.astype(v.dtype), v)
+    return o_new, l_new, m_new
+
+
+def blockwise_attention(q, k, v, block_size=128, causal=False):
+    """Flash-style attention: scan over key/value blocks with the online
+    softmax — numerically equal to ``attention`` but O(block) live memory,
+    so sequence length is bounded by HBM, not by the seq² score matrix.
+    """
+    *lead, s_q, dh = q.shape
+    s_k = k.shape[-2]
+    if s_k % block_size:
+        raise ValueError("seq %d not divisible by block %d"
+                         % (s_k, block_size))
+    n_blocks = s_k // block_size
+    kb = k.reshape(*lead, n_blocks, block_size, dh)
+    vb = v.reshape(*lead, n_blocks, block_size, dh)
+    # scan axis must lead
+    kb = jnp.moveaxis(kb, -3, 0)
+    vb = jnp.moveaxis(vb, -3, 0)
+    q_pos = jnp.arange(s_q)
+
+    def body(carry, blk):
+        i, kb_i, vb_i = blk
+        bias = None
+        if causal:
+            k_pos = i * block_size + jnp.arange(block_size)
+            allowed = q_pos[:, None] + (s_k - s_q) >= k_pos[None, :]
+            bias = jnp.where(allowed, 0.0, NEG_INF).astype(q.dtype)
+        return _online_update(carry, q, kb_i, vb_i, bias), None
+
+    o0 = jnp.zeros_like(q)
+    l0 = jnp.zeros(q.shape[:-1], q.dtype)
+    m0 = jnp.full(q.shape[:-1], NEG_INF, q.dtype)
+    (o, l, m), _ = jax.lax.scan(
+        body, (o0, l0, m0), (jnp.arange(n_blocks), kb, vb))
+    return o / l[..., None]
+
+
+# ------------------------------------------------------------ MHA as layer
+def init_mha_params(stream, d_model, n_heads, dtype="float32"):
+    """Param pytree for one multi-head attention layer (wq/wk/wv/wo)."""
+    import numpy
+    s = (6.0 / (2 * d_model)) ** 0.5
+
+    def mk():
+        w = numpy.zeros((d_model, d_model), dtype)
+        stream.fill(w, -s, s)
+        return w
+
+    return {"wq": mk(), "wk": mk(), "wv": mk(), "wo": mk()}
+
+
+def mha_forward(params, x, n_heads, causal=True, block_size=None):
+    """Multi-head attention over (batch, seq, d_model)."""
+    b, s, d = x.shape
+    dh = d // n_heads
+
+    def split(w):
+        return matmul(x, w).reshape(b, s, n_heads, dh).transpose(0, 2, 1, 3)
+
+    q, k, v = split(params["wq"]), split(params["wk"]), split(params["wv"])
+    if block_size:
+        o = blockwise_attention(q, k, v, block_size, causal=causal)
+    else:
+        o = attention(q, k, v, causal=causal)
+    o = o.transpose(0, 2, 1, 3).reshape(b, s, d)
+    return matmul(o, params["wo"])

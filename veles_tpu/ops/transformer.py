@@ -1,0 +1,215 @@
+"""Transformer language model — the long-context model family.
+
+Beyond-parity (the reference pre-dates attention, SURVEY §5.7): a decoder-
+only transformer as pure functions over a param pytree, plus a trainer unit
+whose whole step (forward, loss, backward, adam-style update) is ONE jitted
+call — the same non-SGD-trainer shape as Kohonen/RBM, proving the graph
+core carries attention models unchanged.
+
+Long-sequence paths: ``block_size`` switches attention to the flash-style
+blockwise kernel (single chip); ``ring`` runs sequence-parallel ring
+attention over a mesh (veles_tpu.parallel.ring).
+"""
+
+from __future__ import annotations
+
+import numpy
+
+from veles_tpu import prng as prng_mod
+from veles_tpu.accel import AcceleratedUnit
+from veles_tpu.workflow import DeferredInitError
+from veles_tpu.ops import functional as F
+from veles_tpu.ops.attention import mha_forward, init_mha_params
+from veles_tpu.ops.decision import DecisionBase
+
+
+def init_transformer_params(stream, vocab, d_model=64, n_heads=4,
+                            n_layers=2, d_ff=None, max_len=512,
+                            dtype="float32"):
+    d_ff = d_ff or 4 * d_model
+    s_emb = d_model ** -0.5
+
+    def dense(n_in, n_out):
+        w = numpy.zeros((n_in, n_out), dtype)
+        stream.fill(w, -(6.0 / (n_in + n_out)) ** 0.5,
+                    (6.0 / (n_in + n_out)) ** 0.5)
+        return w
+
+    embed = numpy.zeros((vocab, d_model), dtype)
+    stream.fill_normal(embed, 0.0, s_emb)
+    pos = numpy.zeros((max_len, d_model), dtype)
+    stream.fill_normal(pos, 0.0, s_emb)
+    blocks = []
+    for _ in range(n_layers):
+        blocks.append({
+            "attn": init_mha_params(stream, d_model, n_heads, dtype),
+            "ln1": {"g": numpy.ones(d_model, dtype),
+                    "b": numpy.zeros(d_model, dtype)},
+            "ln2": {"g": numpy.ones(d_model, dtype),
+                    "b": numpy.zeros(d_model, dtype)},
+            "w1": dense(d_model, d_ff),
+            "b1": numpy.zeros(d_ff, dtype),
+            "w2": dense(d_ff, d_model),
+            "b2": numpy.zeros(d_model, dtype),
+        })
+    return {"embed": embed, "pos": pos, "blocks": blocks,
+            "ln_f": {"g": numpy.ones(d_model, dtype),
+                     "b": numpy.zeros(d_model, dtype)}}
+
+
+def _layernorm(x, g, b, eps=1e-5):
+    import jax.numpy as jnp
+    mean = x.mean(-1, keepdims=True)
+    var = ((x - mean) ** 2).mean(-1, keepdims=True)
+    return (x - mean) / jnp.sqrt(var + eps) * g + b
+
+
+def transformer_forward(params, tokens, n_heads, block_size=None,
+                        attn_fn=None):
+    """Logits (batch, seq, vocab); ``attn_fn(q_input)`` optionally replaces
+    the attention call (ring attention injection point)."""
+    import jax.numpy as jnp
+    b, s = tokens.shape
+    h = jnp.take(params["embed"], tokens, axis=0) + params["pos"][:s]
+    for blk in params["blocks"]:
+        hn = _layernorm(h, blk["ln1"]["g"], blk["ln1"]["b"])
+        if attn_fn is not None:
+            h = h + attn_fn(blk["attn"], hn)
+        else:
+            h = h + mha_forward(blk["attn"], hn, n_heads, causal=True,
+                                block_size=block_size)
+        hn = _layernorm(h, blk["ln2"]["g"], blk["ln2"]["b"])
+        ff = jnp.maximum(F.matmul(hn, blk["w1"]) + blk["b1"], 0.0)
+        h = h + F.matmul(ff, blk["w2"]) + blk["b2"]
+    h = _layernorm(h, params["ln_f"]["g"], params["ln_f"]["b"])
+    return F.matmul(h, params["embed"].T)    # tied output head
+
+
+def lm_loss(params, tokens, mask, n_heads, block_size=None):
+    """Mean next-token cross-entropy (masked rows excluded)."""
+    import jax
+    import jax.numpy as jnp
+    logits = transformer_forward(params, tokens[:, :-1], n_heads,
+                                 block_size)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    m = mask[:, None]
+    denom = jnp.maximum(m.sum() * nll.shape[1], 1.0)
+    return (nll * m).sum() / denom
+
+
+class TransformerTrainer(AcceleratedUnit):
+    """Whole-model trainer: adam update of the param pytree in one jitted
+    step; gates to TRAIN minibatches; evaluation scores loss only."""
+
+    def __init__(self, workflow, vocab=64, d_model=64, n_heads=4,
+                 n_layers=2, max_len=512, learning_rate=1e-3,
+                 block_size=None, beta1=0.9, beta2=0.999, eps=1e-8,
+                 **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.vocab = vocab
+        self.d_model = d_model
+        self.n_heads = n_heads
+        self.n_layers = n_layers
+        self.max_len = max_len
+        self.learning_rate = learning_rate
+        self.block_size = block_size
+        self.beta1, self.beta2, self.eps = beta1, beta2, eps
+        self.params = None
+        self.opt_state = None
+        self.time = 0
+        self.metrics = {}
+
+    # params are a pytree, not Vectors — custom snapshot marshalling
+    def state_dict(self):
+        import jax
+        tree = jax.tree.map(numpy.asarray, self.params) \
+            if self.params is not None else None
+        opt = jax.tree.map(numpy.asarray, self.opt_state) \
+            if self.opt_state is not None else None
+        return {"params": tree, "opt_state": opt, "time": self.time}
+
+    def load_state_dict(self, d):
+        import jax.numpy as jnp
+        import jax
+        if d.get("params") is not None:
+            self.params = jax.tree.map(jnp.asarray, d["params"])
+            self.opt_state = jax.tree.map(jnp.asarray, d["opt_state"])
+        self.time = d.get("time", 0)
+
+    def initialize(self, device=None, **kwargs):
+        import jax
+        import jax.numpy as jnp
+        if not hasattr(self, "input") or self.input.is_empty:
+            raise DeferredInitError(self.name)
+        if self.params is None:
+            host = init_transformer_params(
+                prng_mod.get("init"), self.vocab, self.d_model,
+                self.n_heads, self.n_layers, max_len=self.max_len)
+            self.params = jax.tree.map(jnp.asarray, host)
+            self.opt_state = (jax.tree.map(jnp.zeros_like, self.params),
+                              jax.tree.map(jnp.zeros_like, self.params))
+
+        def train_step(params, opt_state, tokens, mask, t):
+            loss, grads = jax.value_and_grad(lm_loss)(
+                params, tokens, mask, self.n_heads, self.block_size)
+            m, v = opt_state
+            m = jax.tree.map(
+                lambda a, g: self.beta1 * a + (1 - self.beta1) * g,
+                m, grads)
+            v = jax.tree.map(
+                lambda a, g: self.beta2 * a + (1 - self.beta2) * g * g,
+                v, grads)
+            tf = t.astype(jnp.float32) + 1.0
+            lr = self.learning_rate * jnp.sqrt(
+                1.0 - self.beta2 ** tf) / (1.0 - self.beta1 ** tf)
+            params = jax.tree.map(
+                lambda p, m_, v_: p - lr * m_ / (jnp.sqrt(v_) + self.eps),
+                params, m, v)
+            count = mask.sum()
+            return params, (m, v), {"loss_sum": loss * count,
+                                    "tokens": count}
+
+        def eval_step(params, tokens, mask):
+            loss = lm_loss(params, tokens, mask, self.n_heads,
+                           self.block_size)
+            count = mask.sum()
+            return {"loss_sum": loss * count, "tokens": count}
+
+        self._train = self.jit("train", train_step, donate_argnums=(0, 1))
+        self._evalf = self.jit("eval", eval_step)
+        super().initialize(device=device, **kwargs)
+
+    def _is_train_minibatch(self):
+        from veles_tpu.loader.base import TRAIN
+        return getattr(self, "minibatch_class", TRAIN) == TRAIN
+
+    def run(self):
+        import jax.numpy as jnp
+        tokens = jnp.asarray(self.input.devmem, jnp.int32)
+        mask = self.mask.devmem
+        if not self._is_train_minibatch():
+            self.metrics = self._evalf(self.params, tokens, mask)
+            return
+        self.params, self.opt_state, self.metrics = self._train(
+            self.params, self.opt_state, tokens, mask,
+            jnp.asarray(self.time, jnp.int32))
+        self.time += 1
+
+
+class TransformerDecision(DecisionBase):
+    """Tracks mean next-token loss (improvement = lower)."""
+
+    def should_skip_gd(self, cls):
+        return False
+
+    def reduce_metrics(self, host_totals):
+        out = dict(host_totals)
+        count = max(out.pop("tokens", 1), 1)
+        if "loss_sum" in out:
+            out["loss"] = out.pop("loss_sum") / count
+        return out
+
+    def epoch_metric(self, set_metrics):
+        return set_metrics.get("loss")

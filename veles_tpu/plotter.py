@@ -86,9 +86,6 @@ class Plotter(Unit):
         """Return the current spec dict (or None to skip)."""
         raise NotImplementedError
 
-    def initialize(self, device=None, **kwargs):
-        super().initialize(device=device, **kwargs)
-
     def run(self):
         if self.only_on_epoch_end and not getattr(
                 getattr(self.workflow, "loader", None), "epoch_ended", True):

@@ -9,6 +9,30 @@ wiring lives in :func:`make_sample`.
 from veles_tpu.config import root, get
 
 
+def run_sample(module, seed=None, build_kwargs=None):
+    """Drive one sample's ``run(load, main)`` to completion and return the
+    trained workflow.  The standard one-shot runner genetics and ensemble
+    share: optional full PRNG reseed, then build + initialize + run."""
+    from veles_tpu import prng
+    if seed is not None:
+        prng.reset()
+        prng.seed_all(seed)
+    holder = {}
+
+    def load(workflow_cls, **kwargs):
+        kwargs.update(build_kwargs or {})
+        wf = workflow_cls(None, **kwargs)
+        holder["wf"] = wf
+        return wf
+
+    def main():
+        holder["wf"].initialize()
+        holder["wf"].run()
+
+    module.run(load, main)
+    return holder["wf"]
+
+
 def make_sample(config_name, workflow_cls, loader_cls, default_config,
                 loss_function="softmax"):
     """Standard sample scaffolding: returns (build, train, run).

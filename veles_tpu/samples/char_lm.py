@@ -1,0 +1,91 @@
+"""Character-LM sample — tiny decoder-only transformer (long-context family).
+
+Beyond-parity model family (the reference has no attention — SURVEY §5.7):
+trains a causal transformer on synthetic structured sequences (deterministic
+cyclic grammar from the "charlm_synth" stream, so loss is provably
+reducible), same non-SGD trainer cycle as Kohonen/RBM.
+"""
+
+from __future__ import annotations
+
+import numpy
+
+from veles_tpu import prng
+from veles_tpu.config import root
+from veles_tpu.loader.fullbatch import FullBatchLoader
+from veles_tpu.ops.nn_units import NNWorkflow
+from veles_tpu.ops.transformer import TransformerTrainer, TransformerDecision
+from veles_tpu.workflow import Repeater
+
+
+class CharSequenceLoader(FullBatchLoader):
+    """Synthetic token sequences with predictable structure: each sequence
+    cycles an arithmetic pattern ``t[i+1] = (t[i] + step) % vocab`` whose
+    step is sampled per sequence — a 1-layer model can learn it."""
+
+    def __init__(self, workflow, n_train=512, n_valid=128, seq_len=64,
+                 vocab=32, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.n_train = n_train
+        self.n_valid = n_valid
+        self.seq_len = seq_len
+        self.vocab = vocab
+        self.has_labels = False
+
+    def load_data(self):
+        stream = prng.get("charlm_synth")
+        total = self.n_train + self.n_valid
+        starts = stream.randint(0, self.vocab, total)
+        steps = stream.randint(1, 5, total)
+        idx = numpy.arange(self.seq_len)
+        data = (starts[:, None] + steps[:, None] * idx[None, :]) % self.vocab
+        self.original_data.reset(data.astype(numpy.int32))
+        self.class_lengths = [0, self.n_valid, self.n_train]
+
+
+class CharLMWorkflow(NNWorkflow):
+    def __init__(self, workflow=None, name=None, loader_config=None,
+                 trainer_config=None, decision_config=None, **kwargs):
+        super().__init__(workflow, name=name, **kwargs)
+        self.repeater = Repeater(self, name="repeater")
+        self.repeater.link_from(self.start_point)
+
+        self.loader = CharSequenceLoader(self, name="loader",
+                                         **(loader_config or {}))
+        self.loader.link_from(self.repeater)
+
+        self.trainer = TransformerTrainer(self, name="trainer",
+                                          **(trainer_config or {}))
+        self.trainer.link_from(self.loader)
+        self.trainer.link_attrs(self.loader, ("input", "minibatch_data"),
+                                ("mask", "minibatch_mask"),
+                                "minibatch_class")
+
+        self.decision = TransformerDecision(self, name="decision",
+                                            **(decision_config or {}))
+        self.decision.link_from(self.trainer)
+        self.decision.link_attrs(self.loader, "minibatch_class",
+                                 "minibatch_size", "last_minibatch",
+                                 "class_lengths", "epoch_number")
+        self.decision.link_attrs(self.trainer, "metrics")
+
+        self.repeater.link_from(self.decision)
+        self.end_point.link_from(self.decision)
+        self.end_point.gate_block = ~self.decision.complete
+
+
+def default_config():
+    root.char_lm.defaults({
+        "loader": {"minibatch_size": 64, "n_train": 512, "n_valid": 128,
+                   "seq_len": 64, "vocab": 32},
+        "trainer": {"vocab": 32, "d_model": 64, "n_heads": 4, "n_layers": 2,
+                    "max_len": 64, "learning_rate": 1e-3},
+        "decision": {"max_epochs": 10, "fail_iterations": 20},
+    })
+    return root.char_lm
+
+
+from veles_tpu.samples import make_trainer_sample  # noqa: E402
+
+build, train, run = make_trainer_sample("char_lm", CharLMWorkflow,
+                                        default_config)

@@ -114,9 +114,6 @@ class StatusReporter(Unit):
         super().__init__(workflow, **kwargs)
         self.status = status or get_default()
 
-    def initialize(self, device=None, **kwargs):
-        super().initialize(device=device, **kwargs)
-
     def run(self):
         wf = self.workflow
         decision = getattr(wf, "decision", None)

@@ -1,38 +1,38 @@
 """ZeroMQLoader — feed external data into a running graph over ZeroMQ.
 
 Ref: veles/zmq_loader.py::ZeroMQLoader [M] (SURVEY §2.1): a PULL socket
-receives pickled samples from external producers; the loader blocks (with a
-timeout) until a minibatch-worth arrives.  Producers connect with PUSH and
-send ``{"data": ndarray, "label": int}`` pickles; ``None`` signals
+receives pickled samples from external producers; the loader waits (with a
+timeout) for a minibatch-worth.  Producers connect with PUSH and send
+``{"data": ndarray, "label": int}`` pickles; ``None`` signals
 end-of-stream.
+
+Delivery semantics: a receive timeout mid-minibatch delivers the samples
+already buffered as a PARTIAL minibatch (the mask mechanism handles short
+batches anyway); only a timeout with NOTHING buffered raises.  Gate the
+workflow's end on ``complete`` — it flips True once the end-of-stream
+``None`` has been consumed (empty post-stream minibatches score as empty
+sets, never improvements).
 """
 
 from __future__ import annotations
 
 import pickle
 
-import numpy
-
-from veles_tpu.loader.base import Loader, TRAIN
+from veles_tpu.loader.base import TRAIN
+from veles_tpu.loader.stream import StreamLoaderBase
 from veles_tpu.mutable import Bool
 
 
-class ZeroMQLoader(Loader):
-    """Gate the workflow's end on ``complete``: it flips True once the
-    producer's end-of-stream ``None`` has been consumed (wire
-    ``end_point.gate_block = ~loader.complete`` — or let the decision stop;
-    empty post-stream minibatches score as empty sets, never improvements).
-    """
-
+class ZeroMQLoader(StreamLoaderBase):
     def __init__(self, workflow, endpoint="tcp://127.0.0.1:0",
                  sample_shape=(1,), timeout_ms=10000, **kwargs):
-        super().__init__(workflow, **kwargs)
+        super().__init__(workflow, sample_shape=sample_shape, **kwargs)
         self.endpoint = endpoint
-        self.sample_shape = tuple(sample_shape)
         self.timeout_ms = timeout_ms
         self._sock = None
         self.exhausted = False
         self.complete = Bool(False)
+        self._delivered_any = False
 
     def load_data(self):
         import zmq
@@ -47,39 +47,28 @@ class ZeroMQLoader(Loader):
         # keep re-planning until the producer sends the end-of-stream None
         self.class_lengths = [0, 0, self.max_minibatch_size]
 
-    def create_minibatch_data(self):
-        mb = self.max_minibatch_size
-        self.minibatch_data.reset(
-            numpy.zeros((mb,) + self.sample_shape, numpy.float32))
-        self.minibatch_labels.reset(numpy.zeros(mb, numpy.int32))
-
-    def _recv(self):
+    def next_sample(self):
+        import numpy
         import zmq
+        if self.exhausted:
+            return None
         if not self._sock.poll(self.timeout_ms, zmq.POLLIN):
+            if self._delivered_any:
+                return None   # deliver what we have as a partial minibatch
             raise TimeoutError("ZeroMQLoader: no sample within %dms"
                                % self.timeout_ms)
-        return pickle.loads(self._sock.recv())
+        message = pickle.loads(self._sock.recv())
+        if message is None:
+            self.exhausted = True
+            return None
+        self._delivered_any = True
+        return (numpy.asarray(message["data"], numpy.float32),
+                int(message.get("label", 0)))
 
     def fill_minibatch(self, indices, actual_size):
-        mb = self.max_minibatch_size
-        data = numpy.zeros((mb,) + self.sample_shape, numpy.float32)
-        labels = numpy.zeros(mb, numpy.int32)
-        mask = numpy.zeros(mb, numpy.float32)
-        count = 0
-        while count < mb and not self.exhausted:
-            sample = self._recv()
-            if sample is None:
-                self.exhausted = True
-                break
-            data[count] = numpy.asarray(sample["data"], numpy.float32)
-            labels[count] = int(sample.get("label", 0))
-            mask[count] = 1.0
-            count += 1
-        self.minibatch_data.reset(data)
-        self.minibatch_labels.reset(labels)
-        self.minibatch_mask.reset(mask)
-        self.minibatch_size = count
-        if self.exhausted and count == 0:
+        self._delivered_any = False
+        super().fill_minibatch(indices, actual_size)
+        if self.exhausted and self.minibatch_size == 0:
             self.complete.set(True)
 
     def run(self):
