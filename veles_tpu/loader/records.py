@@ -104,10 +104,15 @@ class RecordsLoader(Loader):
             self.minibatch_labels.reset(numpy.zeros(mb, numpy.int32))
 
     def fill_minibatch(self, indices, actual_size):
-        batch = numpy.asarray(self._data[indices], numpy.float32)
+        # fused gather+convert straight out of the mapped pages — the native
+        # (C++, threaded) hot path when libdataio is built, numpy otherwise
+        from veles_tpu import native
         if self.scale_uint8 and self._data.dtype == numpy.uint8:
-            batch = batch / 127.5 - 1.0
+            batch = native.gather_convert(self._data, indices,
+                                          scale=1.0 / 127.5, offset=-1.0)
+        else:
+            batch = native.gather_convert(self._data, indices)
         self.minibatch_data.reset(batch)
         if self.has_labels:
             self.minibatch_labels.reset(
-                numpy.asarray(self._labels[indices], numpy.int32))
+                native.gather_labels(numpy.asarray(self._labels), indices))

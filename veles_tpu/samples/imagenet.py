@@ -89,8 +89,9 @@ class ImagenetRecordsLoader(RecordsLoader):
     def fill_minibatch(self, indices, actual_size):
         super().fill_minibatch(indices, actual_size)
         if self._mean is not None:
-            self.minibatch_data.reset(
-                self.minibatch_data.mem - self._mean)
+            from veles_tpu import native
+            self.minibatch_data.reset(native.subtract_mean(
+                self.minibatch_data.mem, self._mean))
 
 
 class ImagenetSyntheticLoader(FullBatchLoader):
