@@ -335,6 +335,36 @@ def avg_pooling(x, window=(2, 2), stride=None):
     return patches.mean(axis=3)
 
 
+def stochastic_pooling(x, window=(2, 2), stride=None, rng=None, train=True,
+                       use_abs=False):
+    """Zeiler-style stochastic pooling.
+
+    Train: sample one element per window with probability proportional to
+    its (abs or relu'd) magnitude — Gumbel-trick sampling so the whole op
+    stays inside the jitted step (the reference generated positions with
+    in-kernel device RNG — veles/znicz/pooling.py::StochasticAbsPooling
+    [H]).  Eval: the probability-weighted average (the standard
+    deterministic surrogate).  Output is the SIGNED value at the chosen
+    position.
+    """
+    stride = stride or window
+    patches, oh, ow = _pool_patches(x, window, stride, 0.0)
+    weights = jnp.abs(patches) if use_abs else jnp.maximum(patches, 0.0)
+    total = weights.sum(axis=3, keepdims=True)
+    # empty windows (all zero): fall back to uniform
+    k = patches.shape[3]
+    probs = jnp.where(total > 0, weights / jnp.maximum(total, 1e-30),
+                      1.0 / k)
+    if train:
+        if rng is None:
+            raise ValueError("stochastic pooling needs rng when train=True")
+        gumbel = jax.random.gumbel(rng, probs.shape, probs.dtype)
+        idx = jnp.argmax(jnp.log(jnp.maximum(probs, 1e-30)) + gumbel,
+                         axis=3, keepdims=True)
+        return jnp.take_along_axis(patches, idx, axis=3)[:, :, :, 0, :]
+    return (probs * patches).sum(axis=3)
+
+
 # ------------------------------------------------- local response norm (LRN)
 def lrn_forward(x, alpha=1e-4, beta=0.75, n=5, k=2.0):
     """AlexNet cross-channel local response normalization.

@@ -1,0 +1,145 @@
+"""Pallas TPU kernels for the ops XLA's fusion doesn't fully own.
+
+SURVEY §2.4 names the custom-kernel candidates: the fused GD update (one
+VMEM pass over param/velocity/grad instead of several HBM round-trips when
+XLA declines to fuse across the update's reshapes) and dropout with a
+counter-based in-kernel PRNG (the reference generated masks with device RNG
+inside its OpenCL kernels — veles/znicz/dropout.py + ocl kernels [H]).
+
+Kernels run in interpret mode off-TPU (``interpret=None`` auto-detects), so
+the CPU test suite exercises the exact kernel code the TPU compiles.  Both
+have jax/XLA equivalents in ``functional``; selection is explicit (bench
+flags / caller opt-in), never silent.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _interpret(flag):
+    if flag is not None:
+        return flag
+    return jax.default_backend() != "tpu"
+
+
+# ---------------------------------------------------------- fused SGD update
+def _sgd_kernel(scalars_ref, param_ref, vel_ref, grad_ref, out_p_ref,
+                out_v_ref, *, momentum, weight_decay, l1_vs_l2):
+    lr, inv_batch = scalars_ref[0], scalars_ref[1]
+    g = grad_ref[:] * inv_batch
+    if weight_decay:
+        p = param_ref[:]
+        decay = l1_vs_l2 * jnp.sign(p) + (1.0 - l1_vs_l2) * p
+        g = g + weight_decay * decay
+    v = momentum * vel_ref[:] - lr * g
+    out_v_ref[:] = v
+    out_p_ref[:] = param_ref[:] + v
+
+
+def fused_sgd_update(param, velocity, grad, batch_size, learning_rate,
+                     momentum=0.0, weight_decay=0.0, l1_vs_l2=0.0,
+                     interpret=None):
+    """Momentum-SGD update as ONE Pallas kernel (param, velocity in, new
+    param, velocity out — single VMEM round trip).
+
+    Matches ``functional.sgd_update`` (without clipping) bit-for-bit in
+    fp32; ``batch_size`` and ``learning_rate`` may be traced scalars.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    shape = param.shape
+    flat = param.reshape(-1)
+    n = flat.shape[0]
+    # lane padding: VPU tiles are (8, 128) fp32 — pad to a 2-D multiple
+    lanes = 128
+    rows = -(-n // lanes)
+    pad = rows * lanes - n
+
+    def prep(a):
+        a = a.reshape(-1)
+        if pad:
+            a = jnp.concatenate([a, jnp.zeros(pad, a.dtype)])
+        return a.reshape(rows, lanes)
+
+    inv_batch = 1.0 / jnp.maximum(batch_size, 1).astype(param.dtype)
+    kernel = functools.partial(
+        _sgd_kernel, momentum=momentum, weight_decay=weight_decay,
+        l1_vs_l2=l1_vs_l2)
+    scalars = jnp.stack([jnp.asarray(learning_rate, param.dtype),
+                         inv_batch])
+    new_p, new_v = pl.pallas_call(
+        kernel,
+        out_shape=(jax.ShapeDtypeStruct((rows, lanes), param.dtype),
+                   jax.ShapeDtypeStruct((rows, lanes), param.dtype)),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  pl.BlockSpec(memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=(pl.BlockSpec(memory_space=pltpu.VMEM),
+                   pl.BlockSpec(memory_space=pltpu.VMEM)),
+        interpret=_interpret(interpret),
+    )(scalars, prep(param), prep(velocity), prep(grad))
+    return (new_p.reshape(-1)[:n].reshape(shape),
+            new_v.reshape(-1)[:n].reshape(shape))
+
+
+# -------------------------------------------------- dropout with counter RNG
+def _dropout_kernel(seed_ref, x_ref, out_ref, *, keep_scaled_threshold,
+                    inv_keep):
+    from jax.experimental.pallas import tpu as pltpu
+    pltpu.prng_seed(seed_ref[0])
+    bits = pltpu.prng_random_bits(x_ref.shape)
+    # uniform in [0, 2^32): keep when below keep * 2^32
+    keep = bits.astype(jnp.float32) < keep_scaled_threshold
+    out_ref[:] = jnp.where(keep, x_ref[:] * inv_keep, 0.0)
+
+
+def dropout(x, seed, rate, interpret=None):
+    """Inverted dropout with the in-kernel counter PRNG.
+
+    ``seed`` is an int32 scalar (derive per step/layer on the host); the
+    mask is a pure function of (seed, shape), so backward replays it by
+    re-running with the same seed — the reference's stored-mask scheme
+    without storing anything.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if rate <= 0.0:
+        return x
+    keep_prob = 1.0 - rate
+    if _interpret(interpret):
+        # the TPU PRNG primitives (prng_seed/prng_random_bits) have no CPU
+        # lowering even in interpret mode; off-TPU the same (seed, shape) →
+        # mask contract is served by threefry.  Masks differ ACROSS
+        # backends (both are counter-based and deterministic per backend).
+        key = jax.random.PRNGKey(seed)
+        mask = jax.random.bernoulli(key, keep_prob, x.shape)
+        return jnp.where(mask, x / keep_prob, 0.0).astype(x.dtype)
+    shape = x.shape
+    flat = x.reshape(-1)
+    n = flat.shape[0]
+    lanes = 128
+    rows = -(-n // lanes)
+    pad = rows * lanes - n
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros(pad, x.dtype)])
+    x2 = flat.reshape(rows, lanes)
+    kernel = functools.partial(
+        _dropout_kernel,
+        keep_scaled_threshold=float(keep_prob * 2.0 ** 32),
+        inv_keep=float(1.0 / keep_prob))
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((rows, lanes), x.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        interpret=_interpret(interpret),
+    )(jnp.asarray([seed], jnp.int32), x2)
+    return out.reshape(-1)[:n].reshape(shape)

@@ -50,6 +50,26 @@ class AvgPooling(PoolingBase):
         return F.avg_pooling(x, self.window, self.sliding)
 
 
+@register_layer_type("stochastic_pooling")
+class StochasticPooling(PoolingBase):
+    """Sample-by-magnitude pooling (ref: StochasticPooling [M]); eval mode
+    uses the probability-weighted average."""
+
+    STOCHASTIC = True
+    USE_ABS = False
+
+    def transform(self, x, rng, train):
+        return F.stochastic_pooling(x, self.window, self.sliding, rng,
+                                    train, self.USE_ABS)
+
+
+@register_layer_type("stochastic_abs_pooling")
+class StochasticAbsPooling(StochasticPooling):
+    """Probabilities from |x| (ref: StochasticAbsPooling [H])."""
+
+    USE_ABS = True
+
+
 @register_gd_for(PoolingBase)
 class GDPooling(TransformGD):
     """One backward class for every pooling flavor (vjp of the forward).
