@@ -109,3 +109,12 @@ class TestEnsemble:
         w1 = numpy.asarray(
             trainer.members[1][1].forwards[0].weights.mem)
         assert not numpy.allclose(w0, w1)
+        # ...but every member trained on the SAME dataset (pinned data
+        # streams): evaluating members 1..N on member 0's validation set is
+        # only meaningful if the data matches
+        d0 = numpy.asarray(trainer.members[0][1].loader.original_data.mem)
+        for _, wf, _ in trainer.members[1:]:
+            numpy.testing.assert_array_equal(
+                d0, numpy.asarray(wf.loader.original_data.mem))
+        # and no member predicts at chance on the shared validation set
+        assert max(combined["members"]) < 50

@@ -282,3 +282,58 @@ def test_wine_converges():
     wf = wine.train()
     last = wf.decision.epoch_metrics[-1]["validation"]
     assert last["n_err"] <= 3, last
+
+
+# ------------------------------------------------------------------ lmdb
+class _FakeEnv:
+    def __init__(self, n):
+        self._n = n
+
+    def stat(self):
+        return {"entries": self._n}
+
+
+class _FakeLmdbModule:
+    def __init__(self, n):
+        self._n = n
+
+    def open(self, path, **kwargs):
+        return _FakeEnv(self._n)
+
+
+def test_lmdb_to_records_rejects_empty(tmp_path, monkeypatch):
+    from veles_tpu.loader import lmdb as L
+    monkeypatch.setattr(L, "_require_lmdb", lambda: _FakeLmdbModule(0))
+    with pytest.raises(ValueError, match="empty LMDB"):
+        L.lmdb_to_records("fake.lmdb", str(tmp_path / "out.rec"))
+
+
+def test_lmdb_to_records_rejects_shape_mismatch(tmp_path, monkeypatch):
+    from veles_tpu.loader import lmdb as L
+    monkeypatch.setattr(L, "_require_lmdb", lambda: _FakeLmdbModule(2))
+    shapes = [(3, 4, 4), (3, 5, 5)]
+    monkeypatch.setattr(
+        L, "_iter_datums",
+        lambda env: ((b"k%d" % i, numpy.zeros(s, numpy.uint8), 0)
+                     for i, s in enumerate(shapes)))
+    with pytest.raises(ValueError, match="uniform shapes"):
+        L.lmdb_to_records("fake.lmdb", str(tmp_path / "out.rec"))
+
+
+def test_lmdb_to_records_roundtrip(tmp_path, monkeypatch):
+    from veles_tpu.loader import lmdb as L
+    from veles_tpu.loader.records import open_records
+    rng = numpy.random.RandomState(0)
+    samples = rng.randint(0, 255, (4, 3, 4, 5)).astype(numpy.uint8)
+    labels = [3, 1, 4, 1]
+    monkeypatch.setattr(L, "_require_lmdb", lambda: _FakeLmdbModule(4))
+    monkeypatch.setattr(
+        L, "_iter_datums",
+        lambda env: ((b"k%d" % i, samples[i], labels[i]) for i in range(4)))
+    out = L.lmdb_to_records("fake.lmdb", str(tmp_path / "out.rec"),
+                            class_lengths=[0, 1, 3])
+    header, data, got_labels = open_records(out)
+    assert header["class_lengths"] == [0, 1, 3]
+    numpy.testing.assert_array_equal(
+        numpy.asarray(data), samples.transpose(0, 2, 3, 1))
+    numpy.testing.assert_array_equal(numpy.asarray(got_labels), labels)

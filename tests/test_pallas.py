@@ -71,6 +71,20 @@ class TestPallasDropout:
         numpy.testing.assert_array_equal(numpy.asarray(PK.dropout(x, 1, 0.0)),
                                          numpy.asarray(x))
 
+    @pytest.mark.skipif(jax.default_backend() != "tpu",
+                        reason="real-kernel path needs the TPU PRNG")
+    @pytest.mark.parametrize("rate", [0.3, 0.5, 0.7])
+    def test_real_kernel_statistics(self, rate):
+        """Keep fraction of the NON-interpret kernel — the signed int32
+        random bits must be compared in the signed domain (the unsigned
+        misread made rate<=0.5 a silent no-op on hardware)."""
+        keep_prob = 1.0 - rate
+        x = jnp.ones((256, 512), jnp.float32)
+        out = numpy.asarray(PK.dropout(x, 5, rate, interpret=False))
+        kept = out > 0
+        assert abs(kept.mean() - keep_prob) < 0.01, kept.mean()
+        numpy.testing.assert_allclose(out[kept], 1.0 / keep_prob, rtol=1e-5)
+
 
 class TestStochasticPooling:
     def test_train_samples_from_window(self):

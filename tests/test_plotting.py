@@ -39,6 +39,32 @@ class TestRenderSpec:
             render_spec({"kind": "nope"}, str(tmp_path / "x.png"))
 
 
+class TestStopDedup:
+    def test_stop_skips_identical_final_spec(self, tmp_path):
+        """stop() must not duplicate the last plot when nothing changed,
+        but must emit new state accumulated after the last unit fire."""
+        from veles_tpu.plotter import Plotter
+        from veles_tpu.workflow import Workflow
+
+        class FixedPlotter(Plotter):
+            payload = [1, 2, 3]
+
+            def plot_spec(self):
+                return {"kind": "curve",
+                        "series": {"y": list(self.payload)}}
+
+        wf = Workflow(None, name="wf")
+        p = FixedPlotter(wf, output_dir=str(tmp_path), name="p")
+        p.redraw()
+        assert len(p.specs) == 1
+        p.stop()                       # unchanged state → no duplicate
+        assert len(p.specs) == 1
+        p.payload.append(4)            # state advanced without a fire
+        p.stop()
+        assert len(p.specs) == 2
+        assert p.specs[-1]["series"]["y"] == [1, 2, 3, 4]
+
+
 class TestPlottersInTraining:
     def test_standard_plotters_produce_files(self, tmp_path):
         from veles_tpu import prng

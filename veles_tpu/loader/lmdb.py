@@ -9,6 +9,8 @@ with ``lmdb_to_records``, then memmap).
 
 from __future__ import annotations
 
+import os
+
 import numpy
 
 from veles_tpu.loader.base import Loader
@@ -89,28 +91,49 @@ def lmdb_to_records(lmdb_path, out_path, class_lengths=None):
     if sum(class_lengths) != n:
         raise ValueError("class_lengths %s don't sum to %d"
                          % (class_lengths, n))
+    if n == 0:
+        raise ValueError("empty LMDB %r: nothing to convert (a record "
+                         "file needs at least one sample to fix the "
+                         "header shape)" % lmdb_path)
     labels = numpy.zeros(n, numpy.int32)
     written = 0
-    with open(out_path, "wb") as f:
-        header_written = False
-        for _, chw, label in _iter_datums(env):
-            hwc = numpy.ascontiguousarray(chw.transpose(1, 2, 0))
-            if not header_written:
-                header = {"shape": [n] + list(hwc.shape), "dtype": "uint8",
-                          "labels": True,
-                          "class_lengths": [int(c) for c in class_lengths]}
-                blob = json.dumps(header).encode("utf-8")
-                f.write(MAGIC)
-                f.write(struct.pack("<I", len(blob)))
-                f.write(blob)
-                header_written = True
-            f.write(hwc.tobytes())
-            labels[written] = label
-            written += 1
-        if written != n:
-            raise ValueError("LMDB yielded %d records, stat said %d"
-                             % (written, n))
-        f.write(labels.tobytes())
+    sample_shape = None
+    # stream into a temp file and rename on success: a mid-write abort
+    # (shape mismatch, count mismatch, ENOSPC) must never leave a
+    # valid-looking but truncated record file at out_path
+    tmp_path = "%s.%d.tmp" % (out_path, os.getpid())
+    try:
+        with open(tmp_path, "wb") as f:
+            for _, chw, label in _iter_datums(env):
+                hwc = numpy.ascontiguousarray(chw.transpose(1, 2, 0))
+                if sample_shape is None:
+                    sample_shape = hwc.shape
+                    header = {"shape": [n] + list(hwc.shape),
+                              "dtype": "uint8", "labels": True,
+                              "class_lengths": [int(c)
+                                                for c in class_lengths]}
+                    blob = json.dumps(header).encode("utf-8")
+                    f.write(MAGIC)
+                    f.write(struct.pack("<I", len(blob)))
+                    f.write(blob)
+                elif hwc.shape != sample_shape:
+                    # the record layout is fixed-stride: a differently-
+                    # shaped sample would corrupt every record after it
+                    raise ValueError(
+                        "record %d has shape %s, expected %s (record files "
+                        "require uniform shapes — resize before converting)"
+                        % (written, hwc.shape, sample_shape))
+                f.write(hwc.tobytes())
+                labels[written] = label
+                written += 1
+            if written != n:
+                raise ValueError("LMDB yielded %d records, stat said %d"
+                                 % (written, n))
+            f.write(labels.tobytes())
+        os.replace(tmp_path, out_path)
+    finally:
+        if os.path.exists(tmp_path):
+            os.unlink(tmp_path)
     return out_path
 
 

@@ -82,6 +82,15 @@ def _as_ptr(arr, ctype):
     return arr.ctypes.data_as(ctypes.POINTER(ctype))
 
 
+def _numpy_gather(src, indices, scale, offset, out):
+    """Fallback: src[indices] copies only the minibatch rows, so it is
+    safe for strided/memmapped sources of any size."""
+    numpy.multiply(src[indices], scale, out=out, casting="unsafe")
+    if offset:
+        out += offset
+    return out
+
+
 def gather_convert(src, indices, scale=1.0, offset=0.0, out=None):
     """out[i] = float32(src[indices[i]]) * scale + offset.
 
@@ -94,29 +103,22 @@ def gather_convert(src, indices, scale=1.0, offset=0.0, out=None):
     if out is None:
         out = numpy.empty((len(indices),) + sample_shape, numpy.float32)
     lib = _load()
-    if lib is None:
-        numpy.multiply(src[indices], scale, out=out, casting="unsafe")
-        if offset:
-            out += offset
-        return out
-    if not src.flags.c_contiguous:
-        # the kernel indexes rows as idx * sample_elems — strided views
-        # would gather from wrong memory
-        src = numpy.ascontiguousarray(src)
+    if lib is None or not src.flags.c_contiguous or \
+            src.dtype not in (numpy.uint8, numpy.float32):
+        # no library; or a strided view the kernel cannot index (it reads
+        # rows at idx * sample_elems) — never ascontiguousarray a whole
+        # ImageNet-scale memmap just to gather a minibatch from it
+        return _numpy_gather(src, indices, scale, offset, out)
     if src.dtype == numpy.uint8:
         lib.gather_u8_to_f32(
             _as_ptr(src, ctypes.c_uint8), _as_ptr(indices, ctypes.c_int32),
             len(indices), sample_elems, scale, offset,
             _as_ptr(out, ctypes.c_float))
-    elif src.dtype == numpy.float32:
+    else:
         lib.gather_f32(
             _as_ptr(src, ctypes.c_float), _as_ptr(indices, ctypes.c_int32),
             len(indices), sample_elems, scale, offset,
             _as_ptr(out, ctypes.c_float))
-    else:
-        numpy.multiply(src[indices], scale, out=out, casting="unsafe")
-        if offset:
-            out += offset
     return out
 
 

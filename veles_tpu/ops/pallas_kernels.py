@@ -6,8 +6,11 @@ XLA declines to fuse across the update's reshapes) and dropout with a
 counter-based in-kernel PRNG (the reference generated masks with device RNG
 inside its OpenCL kernels — veles/znicz/dropout.py + ocl kernels [H]).
 
-Kernels run in interpret mode off-TPU (``interpret=None`` auto-detects), so
-the CPU test suite exercises the exact kernel code the TPU compiles.  Both
+Kernels run in interpret mode off-TPU (``interpret=None`` auto-detects).
+The fused SGD kernel is the same code on both paths; the dropout kernel's
+TPU PRNG primitives have no CPU lowering, so its off-TPU branch substitutes
+threefry — the real-kernel keep statistics are asserted by a TPU-marked
+test (tests/test_pallas.py) that must be run on hardware.  Both kernels
 have jax/XLA equivalents in ``functional``; selection is explicit (bench
 flags / caller opt-in), never silent.
 """
@@ -89,13 +92,15 @@ def fused_sgd_update(param, velocity, grad, batch_size, learning_rate,
 
 
 # -------------------------------------------------- dropout with counter RNG
-def _dropout_kernel(seed_ref, x_ref, out_ref, *, keep_scaled_threshold,
+def _dropout_kernel(seed_ref, x_ref, out_ref, *, keep_threshold_i32,
                     inv_keep):
     from jax.experimental.pallas import tpu as pltpu
     pltpu.prng_seed(seed_ref[0])
     bits = pltpu.prng_random_bits(x_ref.shape)
-    # uniform in [0, 2^32): keep when below keep * 2^32
-    keep = bits.astype(jnp.float32) < keep_scaled_threshold
+    # bits are SIGNED int32, uniform over the full range — compare in the
+    # signed domain (threshold = keep*2^32 - 2^31) so the keep fraction is
+    # keep_prob, not the unsigned-domain misread that made rate<=0.5 a no-op
+    keep = bits < keep_threshold_i32
     out_ref[:] = jnp.where(keep, x_ref[:] * inv_keep, 0.0)
 
 
@@ -130,9 +135,11 @@ def dropout(x, seed, rate, interpret=None):
     if pad:
         flat = jnp.concatenate([flat, jnp.zeros(pad, x.dtype)])
     x2 = flat.reshape(rows, lanes)
+    threshold = min(int(round(keep_prob * 2.0 ** 32)) - 2 ** 31,
+                    2 ** 31 - 1)
     kernel = functools.partial(
         _dropout_kernel,
-        keep_scaled_threshold=float(keep_prob * 2.0 ** 32),
+        keep_threshold_i32=threshold,
         inv_keep=float(1.0 / keep_prob))
     out = pl.pallas_call(
         kernel,

@@ -14,7 +14,37 @@ from __future__ import annotations
 
 import os
 
+import numpy
+
 from veles_tpu.units import Unit
+
+
+def _spec_snapshot(v):
+    """Deep-copy array-valued spec entries: plot_specs may return views of
+    live buffers (e.g. SOM hit counts mutated in place), and a stored spec
+    that aliases its source would both corrupt history and defeat stop()'s
+    changed-since-last-redraw comparison."""
+    if type(v) is dict:
+        return {k: _spec_snapshot(x) for k, x in v.items()}
+    if isinstance(v, numpy.ndarray):
+        return v.copy()
+    if isinstance(v, (list, tuple)):
+        return type(v)(_spec_snapshot(x) for x in v)
+    return v
+
+
+def _spec_equal(a, b):
+    """Deep equality over spec values (dicts/lists/arrays/scalars)."""
+    if type(a) is dict or type(b) is dict:
+        return (type(a) is dict and type(b) is dict
+                and a.keys() == b.keys()
+                and all(_spec_equal(v, b[k]) for k, v in a.items()))
+    if isinstance(a, (list, tuple, numpy.ndarray)) or \
+            isinstance(b, (list, tuple, numpy.ndarray)):
+        a, b = numpy.asarray(a), numpy.asarray(b)
+        return a.shape == b.shape and a.dtype == b.dtype \
+            and numpy.array_equal(a, b)
+    return a == b
 
 
 def render_spec(spec, path):
@@ -95,11 +125,13 @@ class Plotter(Unit):
             return
         self.redraw()
 
-    def redraw(self):
-        spec = self.plot_spec()
+    def redraw(self, spec=None):
+        if spec is None:
+            spec = self.plot_spec()
         if spec is None:
             return
         spec.setdefault("name", self.name)
+        spec = _spec_snapshot(spec)
         self.specs.append(spec)
         server = getattr(self.workflow, "graphics_server", None)
         if server is not None:
@@ -112,5 +144,14 @@ class Plotter(Unit):
 
     def stop(self):
         # the completion wave can end the run before the last epoch-end
-        # redraw fires; always capture the final state
-        self.redraw()
+        # redraw fires; capture the final state — but skip when it is
+        # identical to the last emitted spec, else the final plot/PNG is
+        # duplicated (run counts are no proxy: new state can accumulate
+        # without this unit firing again)
+        spec = self.plot_spec()
+        if spec is None:
+            return
+        spec.setdefault("name", self.name)
+        if self.specs and _spec_equal(spec, self.specs[-1]):
+            return
+        self.redraw(spec)

@@ -91,26 +91,43 @@ class RandomGenerator:
 
 
 _streams = {}
+_pinned = set()
 
 
 _default_seed = 1
 
+#: Seed for pinned (dataset-generating) streams.  Fixed so that varying the
+#: run seed (``--random-seed``, ensemble member seeds, genetic individuals)
+#: changes weight init / shuffling / dropout but NOT the synthetic dataset —
+#: otherwise every ensemble member would train on different data and a
+#: combined evaluation on member 0's set would be meaningless.
+_DATA_SEED = 1
 
-def get(name="default"):
-    """Fetch (creating on first use) the named stream."""
+
+def get(name="default", pinned=False):
+    """Fetch (creating on first use) the named stream.
+
+    ``pinned=True`` marks a dataset-generation stream: it is seeded from the
+    fixed ``_DATA_SEED`` and ``seed_all`` leaves it alone.
+    """
     stream = _streams.get(name)
     if stream is None:
-        stream = RandomGenerator(name, _default_seed)
+        stream = RandomGenerator(name,
+                                 _DATA_SEED if pinned else _default_seed)
         _streams[name] = stream
+        if pinned:
+            _pinned.add(name)
     return stream
 
 
 def seed_all(seed):
-    """Seed every existing stream and set the default seed for new ones."""
+    """Seed every existing non-pinned stream and set the default for new
+    ones (pinned data streams keep their fixed seed)."""
     global _default_seed
     _default_seed = seed
-    for stream in _streams.values():
-        stream.seed(seed)
+    for name, stream in _streams.items():
+        if name not in _pinned:
+            stream.seed(seed)
 
 
 def new_stream(name, seed=None):
@@ -122,6 +139,7 @@ def new_stream(name, seed=None):
 def reset():
     """Drop all streams (test isolation)."""
     _streams.clear()
+    _pinned.clear()
 
 
 def state_dict():

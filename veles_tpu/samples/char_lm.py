@@ -33,7 +33,7 @@ class CharSequenceLoader(FullBatchLoader):
         self.has_labels = False
 
     def load_data(self):
-        stream = prng.get("charlm_synth")
+        stream = prng.get("charlm_synth", pinned=True)
         total = self.n_train + self.n_valid
         starts = stream.randint(0, self.vocab, total)
         steps = stream.randint(1, 5, total)

@@ -75,7 +75,7 @@ class CifarLoader(FullBatchLoader):
         self.info("loaded real CIFAR-10 from %s", data_dir)
 
     def _load_synthetic(self):
-        stream = prng.get("cifar_synth")
+        stream = prng.get("cifar_synth", pinned=True)
         total = self.n_train + self.n_valid
         protos = stream.uniform(-1.0, 1.0, (10, 32, 32, 3)).astype(
             numpy.float32)

@@ -107,7 +107,7 @@ class ImagenetSyntheticLoader(FullBatchLoader):
         self.n_classes = n_classes
 
     def load_data(self):
-        stream = prng.get("imagenet_synth")
+        stream = prng.get("imagenet_synth", pinned=True)
         h, w = self.image_hw
         total = self.n_train + self.n_valid
         protos = stream.uniform(-1.0, 1.0,

@@ -30,7 +30,7 @@ class KohonenLoader(FullBatchLoader):
         self.has_labels = False
 
     def load_data(self):
-        stream = prng.get("kohonen_synth")
+        stream = prng.get("kohonen_synth", pinned=True)
         centers = stream.uniform(-1.0, 1.0, (self.n_blobs, 2)).astype(
             numpy.float32)
         which = numpy.arange(self.n_train) % self.n_blobs

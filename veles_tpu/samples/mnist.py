@@ -86,7 +86,7 @@ class MnistLoader(FullBatchLoader):
                   data_dir, n_train, n_valid)
 
     def _load_synthetic(self):
-        stream = prng.get("mnist_synth")
+        stream = prng.get("mnist_synth", pinned=True)
         n_train, n_valid = self.n_train, self.n_valid
         total = n_train + n_valid
         protos = stream.uniform(-1.0, 1.0, (10, 784)).astype(numpy.float32)

@@ -50,7 +50,7 @@ class WineLoader(FullBatchLoader):
             data = raw[:, 1:]
             self.info("loaded real wine data from %s", path)
         else:
-            stream = prng.get("wine_synth")
+            stream = prng.get("wine_synth", pinned=True)
             n, features = 178, 13
             labels = numpy.arange(n, dtype=numpy.int32) % 3
             stream.shuffle(labels)
