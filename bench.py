@@ -1,13 +1,33 @@
-"""Benchmark: MNIST-FC training throughput (BASELINE.json config[0]).
+"""Benchmarks: MNIST-FC, CIFAR-10-conv, AlexNet (BASELINE configs 0-2).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "configs"}.
+The headline metric stays MNIST-FC samples/sec/chip (config[0]); the
+``configs`` field carries the full per-config methodology record — step
+time, analytic model FLOPs, achieved TFLOP/s, and MFU — for every bench.
 
-Protocol (BASELINE.md): steady-state samples/sec/chip after a warm-up epoch
-(jit compile excluded), averaged over >=3 epochs.  ``vs_baseline`` is the
-speedup over the reference's numpy backend FLOOR measured in-process (the
-reference itself is unrecoverable — SURVEY §0/§6 — so its numpy backend is
-reproduced here faithfully: per-minibatch python loop, numpy GEMMs, same
-topology/update rule, which is exactly what `veles ... --backend numpy` ran).
+Measurement protocol (BASELINE.md):
+- steady-state samples/sec/chip after a warm-up epoch (compile excluded),
+  timed over enough epochs to dominate host<->device latency;
+- SYNCHRONIZATION: on this image the TPU is reached through a tunnel whose
+  ``block_until_ready`` does NOT wait for execution (dispatch returns
+  immediately; a 4096^3 matmul "finished" at 7000 TFLOP/s on a 197-TFLOP
+  chip).  Every timing window therefore ends with a VALUE FETCH of one
+  metric leaf, which cannot complete before the computation does.  The
+  fetch round-trip (~70 ms) is amortized by sizing windows >= seconds.
+- MFU = achieved TFLOP/s / bf16 peak of the chip.  Matmul precision is
+  fp32 HIGHEST (convergence parity — SURVEY §7); measured rooflines on
+  TPU v5e: ~28 TF/s fp32-HIGHEST, ~116 TF/s fp32-DEFAULT (bf16 passes),
+  ~124 TF/s pure bf16 at 4096^3.  A bf16 variant of the AlexNet bench is
+  also recorded (the TPU-idiomatic fast path).
+- ``vs_baseline`` is the speedup over the reference's numpy backend FLOOR
+  measured in-process (the reference itself is unrecoverable — SURVEY
+  §0/§6): per-minibatch python loop, numpy GEMMs, same topology.
+
+FLOPs convention: analytic per-sample model FLOPs — dense fwd = 2*in*out,
+conv fwd = 2*ky*kx*cin*cout*oh*ow; training = 3x fwd per parameterized
+layer, minus the dX term of the first parameterized layer (its err_input
+is never formed).  Activations/pools/LRN/softmax are excluded (memory-
+bound, <2% of conv/dense FLOPs at these shapes).
 """
 
 from __future__ import annotations
@@ -19,8 +39,35 @@ import time
 
 import numpy
 
+# bf16 peak TFLOP/s per chip, by device_kind prefix
+PEAK_BF16_TFLOPS = {
+    "TPU v4": 275.0,
+    "TPU v5 lite": 197.0,   # v5e
+    "TPU v5e": 197.0,
+    "TPU v5p": 459.0,
+    "TPU v6": 918.0,
+    "TPU v7": 2300.0,
+}
 
-def build_workflow(n_train, n_valid, mb):
+
+def _peak_tflops():
+    import jax
+    kind = jax.devices()[0].device_kind
+    for prefix, peak in PEAK_BF16_TFLOPS.items():
+        if kind.startswith(prefix):
+            return kind, peak
+    return kind, None
+
+
+def _sync(tree):
+    """Force execution by FETCHING one leaf (see module docstring: the
+    tunnel's block_until_ready does not block)."""
+    import jax
+    return numpy.asarray(jax.tree.leaves(tree)[0]).ravel()[0]
+
+
+# --------------------------------------------------------------- workflows
+def build_mnist(n_train, n_valid, mb):
     from veles_tpu import prng
     from veles_tpu.config import root
     prng.reset()
@@ -42,6 +89,79 @@ def build_workflow(n_train, n_valid, mb):
     return wf
 
 
+# round-1 name of the MNIST builder, kept as an alias
+build_workflow = build_mnist
+
+
+def build_cifar(n_train, n_valid, mb):
+    from veles_tpu import prng
+    from veles_tpu.config import root
+    prng.reset()
+    prng.seed_all(1)
+    root.__dict__.pop("cifar", None)
+    root.cifar.update({
+        "loader": {"minibatch_size": mb, "n_train": n_train,
+                   "n_valid": n_valid},
+        "decision": {"max_epochs": 1000, "fail_iterations": 1000},
+    })
+    from veles_tpu.samples import cifar
+    wf = cifar.build(fused=True)   # default small-conv topology (config[1])
+    wf.initialize()
+    return wf
+
+
+def build_alexnet(n_train, n_valid, mb, image_hw=(256, 256), n_classes=1000,
+                  crop=(227, 227)):
+    """Full-size AlexNet (BASELINE config[2]) on random 256x256 images with
+    the real random-crop+flip augmentation and dropout FC trunk."""
+    from veles_tpu import prng
+    from veles_tpu.loader.fullbatch import FullBatchLoader
+    from veles_tpu.samples.imagenet import ImagenetWorkflow, alexnet_layers
+    prng.reset()
+    prng.seed_all(1)
+
+    class _RandomImages(FullBatchLoader):
+        def load_data(self):
+            rng = numpy.random.RandomState(12345)
+            h, w = image_hw
+            total = n_train + n_valid
+            self.original_data.reset(
+                rng.uniform(-1.0, 1.0, (total, h, w, 3))
+                .astype(numpy.float32))
+            self.original_labels.reset(
+                rng.randint(0, n_classes, total).astype(numpy.int32))
+            self.class_lengths = [0, n_valid, n_train]
+
+    wf = ImagenetWorkflow(
+        None, name="alexnet_bench", loader_factory=_RandomImages,
+        loader_config={"minibatch_size": mb},
+        layers=alexnet_layers(n_classes=n_classes, crop=crop),
+        decision_config={"max_epochs": 1000, "fail_iterations": 1000},
+        loss_function="softmax", fused=True)
+    wf.initialize()
+    return wf
+
+
+# ------------------------------------------------------------------- flops
+def model_train_flops_per_sample(runner):
+    """Analytic training FLOPs per sample (convention in module docstring)."""
+    total = 0.0
+    first = True
+    for fwd in runner.forwards:
+        if not getattr(fwd, "has_params", False) or fwd.weights.is_empty:
+            continue
+        w_shape = tuple(fwd.weights.shape)
+        if len(w_shape) == 4:         # conv (ky, kx, cin, cout)
+            oh, ow = fwd.output_sample_shape[:2]
+            f = 2.0 * numpy.prod(w_shape) * oh * ow
+        else:                         # dense (n_in, n_out)
+            f = 2.0 * numpy.prod(w_shape)
+        total += 3.0 * f - (f if first else 0.0)
+        first = False
+    return float(total)
+
+
+# ------------------------------------------------------------------ timing
 def epoch_plan_arrays(loader):
     """Train-portion (idx, mask) matrices for the epoch-scan fast path."""
     from veles_tpu.loader.base import TRAIN
@@ -57,7 +177,10 @@ def epoch_plan_arrays(loader):
     return numpy.stack(idx), numpy.stack(mask)
 
 
-def bench_tpu(wf, epochs=3):
+def bench_epoch_scan(wf, target_seconds=4.0):
+    """Steady-state samples/sec via the one-dispatch-per-epoch scan path.
+
+    Returns (samples_per_sec, steps_per_epoch, step_time_us)."""
     import jax
     runner = wf._fused_runner
     train_epoch, _ = runner.epoch_fns()
@@ -67,21 +190,61 @@ def bench_tpu(wf, epochs=3):
     idx, mask = epoch_plan_arrays(loader)
     n_samples = int(mask.sum())
     steps_per_epoch = idx.shape[0]
-    # warm-up epoch (compile); step0 threads the global step so lr policies
-    # (when configured) decay across epochs instead of restarting
-    state, totals = train_epoch(runner.state, data, labels, idx, mask,
-                                step0=0)
-    jax.block_until_ready(totals)
-    begin = time.perf_counter()
-    for epoch in range(epochs):
-        state, totals = train_epoch(state, data, labels, idx, mask,
-                                    step0=(epoch + 1) * steps_per_epoch)
-    jax.block_until_ready(totals)
-    elapsed = time.perf_counter() - begin
+    from veles_tpu import prng
+    rng = prng.get("dropout").key() if runner._has_stochastic else None
+
+    def run_epochs(state, n, step0):
+        for e in range(n):
+            state, totals = train_epoch(state, data, labels, idx, mask,
+                                        rng=rng,
+                                        step0=step0 + e * steps_per_epoch)
+        return state, totals
+
+    # warm-up epoch (compile) — must also end in a fetch
+    state, totals = run_epochs(runner.state, 1, 0)
+    _sync(totals)
+    # grow the window until the fetch round-trip is noise
+    epochs, step0 = 1, steps_per_epoch
+    while True:
+        begin = time.perf_counter()
+        state, totals = run_epochs(state, epochs, step0)
+        _sync(totals)
+        elapsed = time.perf_counter() - begin
+        step0 += epochs * steps_per_epoch
+        if elapsed >= target_seconds:
+            break
+        epochs = max(epochs * 2,
+                     int(epochs * 1.3 * target_seconds / max(elapsed, 1e-3)))
     runner.state = state
-    return epochs * n_samples / elapsed
+    sps = epochs * n_samples / elapsed
+    step_us = elapsed / (epochs * steps_per_epoch) * 1e6
+    return sps, steps_per_epoch, step_us
 
 
+def bench_config(name, wf, target_seconds, device_kind, peak_tflops,
+                 precision):
+    sps, steps, step_us = bench_epoch_scan(wf, target_seconds)
+    flops = model_train_flops_per_sample(wf._fused_runner)
+    achieved = sps * flops / 1e12
+    rec = {
+        "samples_per_sec": round(sps, 1),
+        "minibatch": int(wf.loader.max_minibatch_size),
+        "steps_per_epoch": int(steps),
+        "step_time_us": round(step_us, 2),
+        "model_train_mflops_per_sample": round(flops / 1e6, 3),
+        "achieved_tflops": round(achieved, 2),
+        "mfu_pct_of_bf16_peak": (round(100.0 * achieved / peak_tflops, 2)
+                                 if peak_tflops else None),
+        "precision": precision,
+        "device": device_kind,
+    }
+    print("%-16s %12.0f samples/s  %8.1f us/step  %7.2f TF/s  MFU %s%%"
+          % (name, sps, step_us, achieved,
+             rec["mfu_pct_of_bf16_peak"]), file=sys.stderr)
+    return rec
+
+
+# ------------------------------------------------------------- numpy floor
 def bench_numpy_floor(wf, min_seconds=3.0):
     """The reference's numpy backend, reproduced: python minibatch loop with
     numpy GEMMs, same 784->100(tanh)->10(softmax) + momentum SGD."""
@@ -129,26 +292,72 @@ def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--smoke", action="store_true",
                         help="tiny sizes on CPU for CI validation")
-    parser.add_argument("--epochs", type=int, default=3)
+    parser.add_argument("--configs", default="mnist,cifar,alexnet",
+                        help="comma list: mnist,cifar,alexnet")
+    parser.add_argument("--seconds", type=float, default=None,
+                        help="target seconds per timing window")
     args = parser.parse_args()
+    wanted = [c.strip() for c in args.configs.split(",") if c.strip()]
+    known = ("mnist", "cifar", "alexnet")
+    unknown = [c for c in wanted if c not in known]
+    if unknown or not wanted:
+        parser.error("unknown configs %r (choose from %s)"
+                     % (unknown, ", ".join(known)))
 
     if args.smoke:
         import jax
         jax.config.update("jax_platforms", "cpu")
-        n_train, n_valid, mb = 2000, 500, 100
-        floor_seconds = 0.5
+        sizes = {"mnist": (2000, 500, 100), "cifar": (500, 100, 50),
+                 "alexnet": (64, 16, 16)}
+        alex_kwargs = dict(image_hw=(64, 64), n_classes=10, crop=(56, 56))
+        target, floor_seconds = args.seconds or 0.5, 0.5
     else:
-        n_train, n_valid, mb = 60000, 10000, 100
-        floor_seconds = 3.0
+        sizes = {"mnist": (60000, 10000, 100), "cifar": (50000, 10000, 100),
+                 "alexnet": (1024, 128, 128)}
+        alex_kwargs = {}
+        target, floor_seconds = args.seconds or 4.0, 3.0
 
-    wf = build_workflow(n_train, n_valid, mb)
-    tpu_sps = bench_tpu(wf, epochs=args.epochs)
-    floor_sps = bench_numpy_floor(wf, min_seconds=floor_seconds)
+    device_kind, peak = _peak_tflops()
+    results = {}
+
+    if "mnist" in wanted:
+        wf = build_mnist(*sizes["mnist"])
+        results["mnist_fc"] = bench_config(
+            "mnist_fc", wf, target, device_kind, peak, "fp32_highest")
+        floor = bench_numpy_floor(wf, min_seconds=floor_seconds)
+        results["mnist_fc"]["numpy_floor_samples_per_sec"] = round(floor, 1)
+        results["mnist_fc"]["vs_numpy_floor"] = round(
+            results["mnist_fc"]["samples_per_sec"] / floor, 2)
+
+    if "cifar" in wanted:
+        wf = build_cifar(*sizes["cifar"])
+        results["cifar_conv"] = bench_config(
+            "cifar_conv", wf, target, device_kind, peak, "fp32_highest")
+
+    if "alexnet" in wanted:
+        wf = build_alexnet(*sizes["alexnet"], **alex_kwargs)
+        results["alexnet"] = bench_config(
+            "alexnet", wf, target, device_kind, peak, "fp32_highest")
+        # the TPU-idiomatic fast path: bf16 operand casts inside the step
+        from veles_tpu.ops import functional as F
+        F.set_matmul_precision("bfloat16")
+        try:
+            wf_bf16 = build_alexnet(*sizes["alexnet"], **alex_kwargs)
+            results["alexnet_bf16"] = bench_config(
+                "alexnet_bf16", wf_bf16, target, device_kind, peak,
+                "bf16_cast")
+        finally:
+            F.set_matmul_precision("float32")
+
+    headline_name = "mnist_fc" if "mnist_fc" in results \
+        else next(iter(results))
+    headline = results[headline_name]
     print(json.dumps({
-        "metric": "mnist_fc_train_samples_per_sec_per_chip",
-        "value": round(tpu_sps, 1),
+        "metric": "%s_train_samples_per_sec_per_chip" % headline_name,
+        "value": headline["samples_per_sec"],
         "unit": "samples/sec",
-        "vs_baseline": round(tpu_sps / floor_sps, 2),
+        "vs_baseline": headline.get("vs_numpy_floor"),
+        "configs": results,
     }))
     return 0
 

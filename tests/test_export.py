@@ -1,0 +1,136 @@
+"""StableHLO export artifacts — the libVeles serving parity axis.
+
+Ref: SURVEY §2.4 libVeles row, §3.4: a trained model must leave the
+framework as a standalone artifact that serves without constructing the
+training workflow.  Round-trips assert artifact output ≡ in-framework
+forward, REST serving from an artifact, and forge packages carrying one.
+"""
+
+import json
+import urllib.request
+
+import numpy
+import pytest
+
+from veles_tpu.config import root
+
+
+def _train_tiny_mnist():
+    from veles_tpu import prng
+    prng.reset()
+    prng.seed_all(3)
+    root.__dict__.pop("mnist", None)
+    root.mnist.update({
+        "loader": {"minibatch_size": 50, "n_train": 300, "n_valid": 100},
+        "decision": {"max_epochs": 2, "fail_iterations": 5},
+        "layers": [
+            {"type": "all2all_tanh", "output_sample_shape": 32,
+             "learning_rate": 0.03, "momentum": 0.9},
+            {"type": "softmax", "output_sample_shape": 10,
+             "learning_rate": 0.03, "momentum": 0.9},
+        ],
+    })
+    from veles_tpu.samples import mnist
+    return mnist.train(fused=True)
+
+
+@pytest.fixture(scope="module")
+def trained_and_artifact(tmp_path_factory):
+    from veles_tpu import export
+    wf = _train_tiny_mnist()
+    path = str(tmp_path_factory.mktemp("export") / "mnist.veles")
+    export.export_model(wf, path, metadata={"note": "test"})
+    return wf, path
+
+
+class TestExportRoundTrip:
+    def test_artifact_matches_in_framework_forward(self,
+                                                   trained_and_artifact):
+        from veles_tpu import export
+        wf, path = trained_and_artifact
+        model = export.load_model(path)
+        runner = wf._fused_runner
+        x = numpy.asarray(wf.loader.original_data.mem[:17])
+        expect = numpy.asarray(runner.eval_forward()(runner.state, x))
+        got = model.predict(x)
+        numpy.testing.assert_allclose(got, expect, rtol=1e-6, atol=1e-6)
+
+    def test_symbolic_batch(self, trained_and_artifact):
+        from veles_tpu import export
+        _, path = trained_and_artifact
+        model = export.load_model(path)
+        for n in (1, 3, 64):
+            out = model.predict(numpy.zeros((n, 784), numpy.float32))
+            assert out.shape == (n, 10)
+
+    def test_manifest_contents(self, trained_and_artifact):
+        from veles_tpu import export
+        _, path = trained_and_artifact
+        model = export.load_model(path)
+        m = model.manifest
+        assert m["input_sample_shape"] == [784]
+        assert m["output_sample_shape"] == [10]
+        assert "tpu" in m["platforms"] and "cpu" in m["platforms"]
+        assert m["metadata"]["note"] == "test"
+
+    def test_no_velocities_shipped(self, trained_and_artifact):
+        from veles_tpu import export
+        _, path = trained_and_artifact
+        model = export.load_model(path)
+        assert all(not k.split("/")[1].startswith("v")
+                   for k in model.manifest["param_keys"])
+
+
+class TestArtifactServing:
+    def test_rest_serves_artifact_without_workflow(self,
+                                                   trained_and_artifact):
+        from veles_tpu.restful_api import serve_artifact
+        wf, path = trained_and_artifact
+        api = serve_artifact(path, port=0)
+        try:
+            x = numpy.asarray(wf.loader.original_data.mem[:5])
+            req = urllib.request.Request(
+                "http://127.0.0.1:%d/predict" % api.port,
+                data=json.dumps({"input": x.tolist()}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req) as resp:
+                payload = json.load(resp)
+        finally:
+            api.stop()
+        assert len(payload["output"]) == 5
+        runner = wf._fused_runner
+        expect = numpy.asarray(
+            runner.eval_forward()(runner.state, x)).argmax(1)
+        assert payload["argmax"] == expect.tolist()
+
+
+def _snapshot_of(wf, tmp_path):
+    from veles_tpu.snapshotter import Snapshotter
+    snapper = Snapshotter(wf, directory=str(tmp_path / "snaps"),
+                          name="snap_%d" % id(wf))
+    return snapper.export()
+
+
+class TestForgeArtifact:
+    def test_package_carries_and_serves_artifact(self, trained_and_artifact,
+                                                 tmp_path):
+        from veles_tpu import forge
+        wf, artifact = trained_and_artifact
+        snap = _snapshot_of(wf, tmp_path)
+        pkg = str(tmp_path / "mnist.forge.tar.gz")
+        forge.pack(snap, pkg, name="mnist-test", artifact_path=artifact,
+                   metrics={"val_err": 1})
+        manifest = forge.read_manifest(pkg)
+        assert manifest["artifact"] == "mnist.veles"
+        model = forge.load_artifact(pkg, out_dir=str(tmp_path / "unpacked"))
+        out = model.predict(numpy.zeros((2, 784), numpy.float32))
+        assert out.shape == (2, 10)
+
+    def test_missing_artifact_raises(self, trained_and_artifact, tmp_path):
+        from veles_tpu import forge
+        wf, _ = trained_and_artifact
+        snap = _snapshot_of(wf, tmp_path)
+        pkg = str(tmp_path / "plain.forge.tar.gz")
+        forge.pack(snap, pkg, name="plain")
+        with pytest.raises(KeyError):
+            forge.load_artifact(pkg, out_dir=str(tmp_path / "u2"))

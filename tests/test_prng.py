@@ -57,3 +57,29 @@ def test_state_dict_roundtrip():
 def test_get_after_seed_all_honors_default_seed():
     prng.seed_all(42)
     assert prng.get("fresh_stream").initial_seed == 42
+
+
+def test_pinned_streams_survive_snapshot_restore():
+    """Restoring prng state must re-pin data streams, else a later
+    seed_all (ensemble/genetics resume) would regenerate the dataset."""
+    from veles_tpu import prng
+    prng.reset()
+    prng.seed_all(1)
+    data = prng.get("synth_data", pinned=True)
+    baseline = data.uniform(size=4).tolist()
+    saved = prng.state_dict()
+
+    prng.reset()
+    prng.seed_all(1)
+    replay = prng.get("synth_data", pinned=True).uniform(size=4).tolist()
+    assert replay == baseline
+
+    prng.reset()
+    prng.load_state_dict(saved)
+    prng.seed_all(99)          # must NOT touch the restored pinned stream
+    stream = prng.get("synth_data")
+    assert stream.initial_seed == 1
+    # old-format snapshots (bare name->state mapping) still load
+    prng.reset()
+    prng.load_state_dict(saved["streams"])
+    assert prng.get("synth_data").initial_seed == 1

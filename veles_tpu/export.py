@@ -1,0 +1,159 @@
+"""Framework-independent inference artifacts — the libVeles role.
+
+Ref: SURVEY §2.4 ``libVeles/libZnicz`` row — the reference shipped a
+standalone C++ engine that executed exported snapshots without Python.  The
+TPU-native equivalent is a **StableHLO artifact**: the trained forward pass
+is captured with ``jax.export`` (version-stable serialized StableHLO with a
+symbolic batch dimension), bundled with the weights and a manifest into ONE
+file.  Loading it needs jax + numpy only — no veles_tpu units, loaders, or
+workflow construction — and the same bytes execute on CPU or TPU (the
+artifact is lowered for both platforms), which is exactly the "snapshot is
+the deployment artifact" contract of SURVEY §3.3/§3.4 minus the framework.
+
+Artifact layout (tar.gz):
+    manifest.json     input/output specs, sample metadata, format version
+    model.shlo        jax.export serialized bytes (forward: (*params, x))
+    weights.npz       flattened parameter arrays, insertion-ordered
+
+``export_model`` captures a trained workflow; ``load_model`` returns an
+:class:`ExportedModel` whose ``predict`` is one device dispatch.  The REST
+server (restful_api.serve_artifact) and forge packages both consume these.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import tarfile
+import time
+
+import numpy
+
+MANIFEST = "manifest.json"
+MODEL = "model.shlo"
+WEIGHTS = "weights.npz"
+FORMAT = 1
+
+#: platforms every artifact is lowered for (the artifact must serve on a
+#: CPU host and on TPU alike)
+PLATFORMS = ("cpu", "tpu")
+
+
+def _flatten_state(state):
+    """Runner state (list of per-layer dicts) -> ordered {key: array}."""
+    flat = {}
+    for i, entry in enumerate(state):
+        for k in sorted(entry):
+            flat["%d/%s" % (i, k)] = numpy.asarray(entry[k])
+    return flat
+
+
+def export_model(workflow, path, metadata=None):
+    """Export a trained (fused) workflow's eval forward as an artifact.
+
+    The forward is re-traced as a pure function of (params..., x) with a
+    symbolic batch dimension, so the artifact serves any batch size.
+    """
+    import jax
+    from jax import export as jexport
+
+    runner = getattr(workflow, "_fused_runner", None)
+    if runner is None:
+        raise ValueError("export_model needs a fused workflow "
+                         "(StandardWorkflow(..., fused=True))")
+    # inference does not need velocities — ship weights/biases only
+    state = [{k: v for k, v in entry.items() if not k.startswith("v")}
+             for entry in runner.state]
+    flat = _flatten_state(state)
+    keys = list(flat)
+
+    def forward(*args):
+        params, x = args[:-1], args[-1]
+        rebuilt = []
+        it = iter(zip(keys, params))
+        for i, entry in enumerate(state):
+            d = {}
+            for _ in range(len(entry)):
+                key, arr = next(it)
+                d[key.split("/", 1)[1]] = arr
+            rebuilt.append(d)
+        return runner._forward_chain(rebuilt, x, rng=None, train=False)[-1]
+
+    batch = jexport.symbolic_shape("b")[0]
+    sample_shape = tuple(workflow.loader.minibatch_data.shape[1:])
+    arg_specs = [jax.ShapeDtypeStruct(a.shape, a.dtype)
+                 for a in flat.values()]
+    arg_specs.append(jax.ShapeDtypeStruct((batch,) + sample_shape,
+                                          numpy.float32))
+    exported = jexport.export(jax.jit(forward),
+                              platforms=list(PLATFORMS))(*arg_specs)
+    out_spec = exported.out_avals[0]
+
+    manifest = {
+        "format": FORMAT,
+        "name": workflow.name,
+        "input_sample_shape": list(sample_shape),
+        "input_dtype": "float32",
+        "output_sample_shape": [int(d) for d in out_spec.shape[1:]],
+        "output_dtype": str(out_spec.dtype),
+        "param_keys": keys,
+        "platforms": list(PLATFORMS),
+        "exported_at": time.time(),
+        "metadata": metadata or {},
+    }
+    with tarfile.open(path, "w:gz") as tar:
+        def add_bytes(name, data):
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tar.addfile(info, io.BytesIO(data))
+
+        add_bytes(MANIFEST, json.dumps(manifest, indent=2).encode("utf-8"))
+        add_bytes(MODEL, bytes(exported.serialize()))
+        buf = io.BytesIO()
+        numpy.savez(buf, **flat)
+        add_bytes(WEIGHTS, buf.getvalue())
+    return path
+
+
+class ExportedModel:
+    """A loaded artifact: ``predict(x)`` with zero framework dependencies
+    (no units, loaders, or workflow graph — the libVeles contract)."""
+
+    def __init__(self, manifest, exported, params):
+        self.manifest = manifest
+        self._exported = exported
+        self._params = params
+
+    @property
+    def name(self):
+        return self.manifest.get("name")
+
+    def predict(self, x):
+        x = numpy.ascontiguousarray(x, numpy.float32)
+        sample_shape = tuple(self.manifest["input_sample_shape"])
+        if x.shape[1:] != sample_shape:
+            x = x.reshape((len(x),) + sample_shape)
+        out = self._exported.call(*self._params, x)
+        return numpy.asarray(out)
+
+
+def load_model(path):
+    """Load an artifact file into an :class:`ExportedModel`."""
+    from jax import export as jexport
+
+    with tarfile.open(path, "r:gz") as tar:
+        def read(name):
+            member = tar.extractfile(name)
+            if member is None:
+                raise ValueError("%s has no %s" % (path, name))
+            return member.read()
+
+        manifest = json.loads(read(MANIFEST))
+        if manifest.get("format") != FORMAT:
+            raise ValueError("unsupported artifact format %r"
+                             % manifest.get("format"))
+        exported = jexport.deserialize(bytearray(read(MODEL)))
+        npz = numpy.load(io.BytesIO(read(WEIGHTS)))
+        params = [npz[k] for k in manifest["param_keys"]]
+    return ExportedModel(manifest, exported, params)

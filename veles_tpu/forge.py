@@ -24,8 +24,13 @@ MANIFEST = "manifest.json"
 
 
 def pack(snapshot_path, out_path, name=None, author=None, description="",
-         metrics=None, extra_files=()):
-    """Create a forge package from a snapshot file."""
+         metrics=None, extra_files=(), artifact_path=None):
+    """Create a forge package from a snapshot file.
+
+    ``artifact_path`` optionally bundles a StableHLO export artifact
+    (veles_tpu.export) so the package can be SERVED framework-free as well
+    as restored for resume/fine-tune (the reference's snapshot played both
+    roles — SURVEY §3.3/§3.4)."""
     if not os.path.exists(snapshot_path):
         raise FileNotFoundError(snapshot_path)
     manifest = {
@@ -37,6 +42,10 @@ def pack(snapshot_path, out_path, name=None, author=None, description="",
         "packaged_at": time.time(),
         "format": 1,
     }
+    if artifact_path is not None:
+        if not os.path.exists(artifact_path):
+            raise FileNotFoundError(artifact_path)
+        manifest["artifact"] = os.path.basename(artifact_path)
     with tarfile.open(out_path, "w:gz") as tar:
         with tempfile.NamedTemporaryFile("w", suffix=".json",
                                          delete=False) as f:
@@ -45,6 +54,8 @@ def pack(snapshot_path, out_path, name=None, author=None, description="",
         tar.add(tmp, arcname=MANIFEST)
         os.unlink(tmp)
         tar.add(snapshot_path, arcname=manifest["snapshot"])
+        if artifact_path is not None:
+            tar.add(artifact_path, arcname=manifest["artifact"])
         for path in extra_files:
             tar.add(path, arcname=os.path.basename(path))
     return out_path
@@ -97,6 +108,36 @@ def fetch(store_dir, name, out_dir):
         if manifest["name"] == name:
             return unpack(path, out_dir)
     raise KeyError("no package %r in %s" % (name, store_dir))
+
+
+def load_artifact(package_path, out_dir=None):
+    """Load the bundled export artifact of a package as an ExportedModel
+    (framework-free serving); raises KeyError if the package has none.
+
+    Only the artifact member is extracted — the (possibly multi-GB)
+    training snapshot never touches disk on the serving path."""
+    from veles_tpu.export import load_model
+    manifest = read_manifest(package_path)
+    if "artifact" not in manifest:
+        raise KeyError("package %s carries no export artifact"
+                       % package_path)
+    cleanup = out_dir is None
+    out_dir = out_dir or tempfile.mkdtemp(prefix="forge_")
+    artifact_path = os.path.join(out_dir, manifest["artifact"])
+    try:
+        with tarfile.open(package_path, "r:gz") as tar:
+            member = tar.extractfile(manifest["artifact"])
+            if member is None:
+                raise ValueError("%s: manifest names artifact %r but the "
+                                 "member is missing"
+                                 % (package_path, manifest["artifact"]))
+            os.makedirs(out_dir, exist_ok=True)
+            with open(artifact_path, "wb") as f:
+                shutil.copyfileobj(member, f)
+        return load_model(artifact_path)
+    finally:
+        if cleanup:
+            shutil.rmtree(out_dir, ignore_errors=True)
 
 
 def restore_package(package_path, build, out_dir=None):

@@ -130,9 +130,11 @@ def seed_all(seed):
             stream.seed(seed)
 
 
-def new_stream(name, seed=None):
+def new_stream(name, seed=None, pinned=False):
     stream = RandomGenerator(name, seed if seed is not None else _default_seed)
     _streams[name] = stream
+    if pinned:
+        _pinned.add(name)
     return stream
 
 
@@ -143,9 +145,14 @@ def reset():
 
 
 def state_dict():
-    return {name: s.state_dict() for name, s in _streams.items()}
+    return {"streams": {name: s.state_dict()
+                        for name, s in _streams.items()},
+            "pinned": sorted(_pinned)}
 
 
 def load_state_dict(d):
-    for name, sd in d.items():
-        get(name).load_state_dict(sd)
+    # pre-"pinned" snapshots stored the bare {name: stream_state} mapping
+    streams = d.get("streams", d if "pinned" not in d else {})
+    pinned = set(d.get("pinned", ()))
+    for name, sd in streams.items():
+        get(name, pinned=name in pinned).load_state_dict(sd)

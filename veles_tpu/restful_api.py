@@ -25,14 +25,16 @@ from veles_tpu.logger import Logger
 
 
 class RESTfulAPI(Logger):
-    def __init__(self, workflow, normalizer=None):
+    def __init__(self, workflow, normalizer=None, forward=None):
         self.workflow = workflow
         #: optional input normalizer (a loader's fitted normalizer) applied
         #: before the forward, so clients send raw feature scale
         self.normalizer = normalizer
         self._server = None
         self._thread = None
-        self._forward = None
+        #: explicit forward callable (batch ndarray -> ndarray) — used by
+        #: artifact serving, where there is no workflow at all
+        self._forward = forward
 
     # ------------------------------------------------------------- inference
     def _ensure_forward(self):
@@ -113,6 +115,16 @@ class RESTfulAPI(Logger):
             self._server.shutdown()
             self._server.server_close()
             self._server = None
+
+
+def serve_artifact(path, host="127.0.0.1", port=8180):
+    """Serve a StableHLO export artifact (veles_tpu.export) WITHOUT
+    constructing any training workflow — the libVeles serving path
+    (SURVEY §2.4/§3.4): load weights + compiled forward, start HTTP."""
+    from veles_tpu.export import load_model
+    model = load_model(path)
+    return RESTfulAPI(None, forward=model.predict).start(host=host,
+                                                         port=port)
 
 
 def serve_snapshot(path, host="127.0.0.1", port=8180, build=None):
