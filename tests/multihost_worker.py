@@ -1,0 +1,80 @@
+"""Worker process for the multi-host SPMD test (tests/test_multihost.py).
+
+Each of N processes owns 4 virtual CPU devices; together they form one
+8-device global mesh — the process-level analogue of the reference's
+loopback master/slave tests (SURVEY §4 test_client_server.py).  Every
+process builds the identical workflow (same seed, pinned data stream),
+shards the loader by its process index, feeds its LOCAL batch rows, and
+runs lock-step SPMD train steps whose gradient averaging is the GSPMD
+all-reduce.  Per-step metrics are printed as JSON for the parent test to
+compare across processes and against a single-process reference run.
+"""
+
+import json
+import os
+import sys
+
+
+def main(coordinator, num_processes, process_id, steps=3):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=4").strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    assert jax.process_count() == num_processes
+    assert len(jax.devices()) == 4 * num_processes
+
+    import numpy
+    from veles_tpu import prng
+    from veles_tpu.config import root
+    from veles_tpu.parallel import make_mesh, ShardedTrainer
+
+    prng.reset()
+    prng.seed_all(1)
+    root.mnist.update({
+        "loader": {"minibatch_size": 32, "n_train": 128, "n_valid": 32},
+        "decision": {"max_epochs": 1, "fail_iterations": 5},
+        "layers": [
+            {"type": "all2all_tanh", "output_sample_shape": 16,
+             "learning_rate": 0.05, "momentum": 0.9},
+            {"type": "softmax", "output_sample_shape": 10,
+             "learning_rate": 0.05, "momentum": 0.9},
+        ],
+    })
+    from veles_tpu.samples import mnist
+    wf = mnist.build(fused=True)
+    # SPMD loader sharding — every process plans the same global minibatch
+    # sequence and yields its contiguous local rows (SURVEY §5.8: the
+    # reference's index shipping, collapsed into deterministic sharding)
+    wf.loader.shard_spmd(jax.process_index(), jax.process_count())
+    wf.initialize()
+    loader = wf.loader
+    assert loader.local_minibatch_size == 32 // num_processes
+
+    mesh = make_mesh(4 * num_processes, devices=jax.devices())
+    trainer = ShardedTrainer(wf._fused_runner, mesh)
+    assert trainer.multiprocess
+
+    from veles_tpu.loader.base import TRAIN
+    out = []
+    done = 0
+    while done < steps:
+        loader.run()    # fills the LOCAL minibatch Vectors via the plan
+        if loader.minibatch_class != TRAIN:
+            continue
+        x = numpy.asarray(loader.minibatch_data.mem)
+        y = numpy.asarray(loader.minibatch_labels.mem)
+        mask = numpy.asarray(loader.minibatch_mask.mem)
+        metrics = trainer.train_step(x, y, mask, loader.minibatch_size,
+                                     step=done)
+        host = ShardedTrainer.fetch(metrics)
+        out.append({k: float(numpy.ravel(v)[0]) for k, v in host.items()})
+        done += 1
+    print("METRICS " + json.dumps(out))
+
+
+if __name__ == "__main__":
+    main(sys.argv[1], int(sys.argv[2]), int(sys.argv[3]))

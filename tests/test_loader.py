@@ -114,3 +114,54 @@ def test_sharding_partitions_every_set():
         covered |= mine
     assert covered == full
     assert max(counts) - min(counts) <= 3  # balanced within one per set
+
+
+def test_shard_spmd_slices_global_minibatches():
+    """SPMD mode: all processes plan the SAME global minibatches; each
+    yields its contiguous local rows; masks/indices reassemble exactly the
+    unsharded plan, and minibatch_size stays the global live count."""
+    from veles_tpu import prng
+
+    def plans(pc):
+        out = []
+        for pi in range(pc):
+            prng.reset(); prng.seed_all(7)
+            wf = Workflow(None, name="wf%d" % pi)
+            loader = ArrayLoader(wf, lengths=(6, 10, 25), minibatch_size=8)
+            if pc > 1:
+                loader.shard_spmd(pi, pc)
+            loader.initialize()
+            steps = []
+            for _ in range(7):
+                loader.run()
+                steps.append((loader.minibatch_class,
+                              loader.minibatch_size,
+                              numpy.array(loader.minibatch_indices.mem),
+                              numpy.array(loader.minibatch_mask.mem),
+                              numpy.array(loader.minibatch_data.mem)))
+            return_local = loader.local_minibatch_size
+            out.append((steps, return_local))
+        return out
+
+    (global_steps, g_local), = plans(1)
+    shards = plans(2)
+    assert shards[0][1] == 4 and shards[1][1] == 4
+    for step in range(7):
+        cls_g, size_g, idx_g, mask_g, data_g = global_steps[step]
+        for pi in range(2):
+            cls_l, size_l, idx_l, mask_l, data_l = shards[pi][0][step]
+            assert cls_l == cls_g
+            assert size_l == size_g          # GLOBAL live count
+            lo = pi * 4
+            numpy.testing.assert_array_equal(idx_l, idx_g[lo:lo + 4])
+            numpy.testing.assert_array_equal(mask_l, mask_g[lo:lo + 4])
+            numpy.testing.assert_array_equal(data_l, data_g[lo:lo + 4])
+        # every shard step count identical: lock-step guaranteed
+
+
+def test_shard_spmd_rejects_indivisible_minibatch():
+    import pytest
+    wf = Workflow(None, name="wf")
+    loader = ArrayLoader(wf, minibatch_size=9)
+    with pytest.raises(ValueError):
+        loader.shard_spmd(0, 2)

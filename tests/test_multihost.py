@@ -1,0 +1,118 @@
+"""Multi-host SPMD: 2 real processes over one global mesh (loopback).
+
+The reference tested its distributed backbone with master and slaves
+in-process on localhost (SURVEY §4, test_client_server.py [M]); the
+TPU-native analogue is N jax processes joined by
+``jax.distributed.initialize`` over 127.0.0.1, each owning 4 virtual CPU
+devices of one 8-device mesh.  Asserts (1) both processes compute
+IDENTICAL per-step metrics — the all-reduce really spans processes — and
+(2) those metrics equal a single-process run on the same global batches,
+i.e. multi-host changes the wiring, not the math.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _worker_env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # each worker re-adds its own 4-device flag; strip the conftest's 8
+    env["XLA_FLAGS"] = ""
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    return env
+
+
+def _parse_metrics(stdout):
+    for line in stdout.splitlines():
+        if line.startswith("METRICS "):
+            return json.loads(line[len("METRICS "):])
+    raise AssertionError("no METRICS line in worker output:\n" + stdout)
+
+
+def test_two_process_spmd_matches_single_process():
+    port = _free_port()
+    coordinator = "127.0.0.1:%d" % port
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.join(HERE, "multihost_worker.py"),
+             coordinator, "2", str(pid)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=_worker_env(), cwd=REPO)
+        for pid in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            stdout, stderr = p.communicate(timeout=300)
+            assert p.returncode == 0, (
+                "worker failed rc=%d\nstdout:\n%s\nstderr:\n%s"
+                % (p.returncode, stdout, stderr[-4000:]))
+            outs.append(_parse_metrics(stdout))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+
+    # (1) both processes saw the same replicated metrics each step
+    assert outs[0] == outs[1]
+    assert len(outs[0]) == 3
+
+    # (2) equal to the single-process reference on the same global batches
+    from veles_tpu import prng
+    from veles_tpu.config import root
+    from veles_tpu.parallel import make_mesh, ShardedTrainer
+    prng.reset()
+    prng.seed_all(1)
+    root.mnist.update({
+        "loader": {"minibatch_size": 32, "n_train": 128, "n_valid": 32},
+        "decision": {"max_epochs": 1, "fail_iterations": 5},
+        "layers": [
+            {"type": "all2all_tanh", "output_sample_shape": 16,
+             "learning_rate": 0.05, "momentum": 0.9},
+            {"type": "softmax", "output_sample_shape": 10,
+             "learning_rate": 0.05, "momentum": 0.9},
+        ],
+    })
+    from veles_tpu.samples import mnist
+    from veles_tpu.loader.base import TRAIN
+    wf = mnist.build(fused=True)
+    wf.initialize()     # NOT sharded: global plan, same PRNG → same order
+    import jax
+    mesh = make_mesh(8, devices=jax.devices("cpu"))
+    trainer = ShardedTrainer(wf._fused_runner, mesh)
+    assert not trainer.multiprocess
+
+    loader = wf.loader
+    step = 0
+    while step < 3:
+        loader.run()
+        if loader.minibatch_class != TRAIN:
+            continue
+        metrics = trainer.train_step(
+            numpy.asarray(loader.minibatch_data.mem),
+            numpy.asarray(loader.minibatch_labels.mem),
+            numpy.asarray(loader.minibatch_mask.mem),
+            loader.minibatch_size, step=step)
+        host = ShardedTrainer.fetch(metrics)
+        expect = {k: float(numpy.ravel(v)[0]) for k, v in host.items()}
+        for key, val in expect.items():
+            assert abs(outs[0][step][key] - val) <= 1e-4 * (1 + abs(val)), (
+                step, key, outs[0][step][key], val)
+        step += 1

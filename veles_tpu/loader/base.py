@@ -54,6 +54,7 @@ class Loader(Unit):
         self._position = 0             # minibatch cursor within the epoch
         self._order = None             # epoch plan: list of minibatch tuples
         self._shard = (0, 1)           # (process_index, process_count)
+        self._spmd_shard = None        # SPMD slice-of-global-minibatch mode
 
     # -- to be provided by subclasses ---------------------------------------
     def load_data(self):
@@ -74,10 +75,43 @@ class Loader(Unit):
 
         The TPU-native successor of the reference's per-slave index shipping
         (veles/server.py generate_data_for_slave → loader indices [H]):
-        deterministic, no control plane.
+        deterministic, no control plane.  Each process plans its OWN
+        full-size minibatches over its subset — the independent-shard model
+        (per-process evaluation, screening); for lock-step multi-host SPMD
+        training use :meth:`shard_spmd`.
         """
         self._shard = (int(process_index), int(process_count))
+        self._order = None
         return self
+
+    def shard_spmd(self, process_index, process_count):
+        """SPMD sharding: every process plans the SAME global minibatch
+        sequence (identical step counts — required for lock-step SPMD) and
+        this loader yields the process's contiguous rows of each global
+        minibatch.  ``minibatch_size`` stays the GLOBAL live count (the
+        gradient normalizer); the data/label/mask Vectors hold the local
+        rows, which ``ShardedTrainer.put_batch`` assembles into the global
+        batch via ``jax.make_array_from_process_local_data``.
+
+        Requires identical PRNG seeding across processes (same shuffle
+        order) and ``minibatch_size %% process_count == 0``.
+        """
+        process_index, process_count = int(process_index), int(process_count)
+        if self.max_minibatch_size % process_count:
+            raise ValueError(
+                "minibatch_size %d is not divisible by process_count %d"
+                % (self.max_minibatch_size, process_count))
+        self._spmd_shard = (process_index, process_count)
+        self._order = None
+        return self
+
+    @property
+    def local_minibatch_size(self):
+        """Rows this process holds per minibatch (== max_minibatch_size
+        unless SPMD-sharded)."""
+        if self._spmd_shard is None:
+            return self.max_minibatch_size
+        return self.max_minibatch_size // self._spmd_shard[1]
 
     @property
     def total_samples(self):
@@ -107,12 +141,19 @@ class Loader(Unit):
         super().initialize(device=device, **kwargs)
 
     def _plan_epoch(self):
-        """Build this epoch's minibatch plan: test → validation → train."""
+        """Build this epoch's minibatch plan: test → validation → train.
+
+        SPMD mode plans over the GLOBAL index space (identical on every
+        process) and stores each process's contiguous slice of the padded
+        global chunk, keeping the global live count."""
         stream = prng.get(self.prng_stream)
         pi, pc = self._shard
+        spmd = self._spmd_shard
         plan = []
         for cls, (begin, end) in enumerate(self.class_offsets()):
-            idx = numpy.arange(begin, end)[pi::pc]
+            idx = numpy.arange(begin, end)
+            if spmd is None:
+                idx = idx[pi::pc]
             if len(idx) == 0:
                 continue
             if cls == TRAIN and self.shuffle:
@@ -124,7 +165,11 @@ class Loader(Unit):
                 if actual < mb:  # pad with the first index, masked dead
                     chunk = numpy.concatenate(
                         [chunk, numpy.full(mb - actual, chunk[0])])
-                plan.append((cls, chunk.astype(numpy.int32), actual))
+                chunk = chunk.astype(numpy.int32)
+                if spmd is not None:
+                    local = mb // spmd[1]
+                    chunk = chunk[spmd[0] * local:(spmd[0] + 1) * local]
+                plan.append((cls, chunk, actual))
         self._order = plan
 
     def run(self):
@@ -135,8 +180,15 @@ class Loader(Unit):
         self._position += 1
         self.minibatch_class = cls
         self.minibatch_size = actual
-        mask = numpy.zeros(self.max_minibatch_size, numpy.float32)
-        mask[:actual] = 1.0
+        if self._spmd_shard is None:
+            mask = numpy.zeros(self.max_minibatch_size, numpy.float32)
+            mask[:actual] = 1.0
+        else:
+            # local slice of the global liveness mask
+            pi, pc = self._spmd_shard
+            local = self.max_minibatch_size // pc
+            rows = numpy.arange(pi * local, (pi + 1) * local)
+            mask = (rows < actual).astype(numpy.float32)
         self.minibatch_mask.reset(mask)
         self.minibatch_indices.reset(indices)
         self.fill_minibatch(indices, actual)

@@ -171,7 +171,7 @@ class LMDBLoader(Loader):
         self.class_lengths = [0, len(valid[1]), len(train[1])]
 
     def create_minibatch_data(self):
-        mb = self.max_minibatch_size
+        mb = self.local_minibatch_size
         self.minibatch_data.reset(numpy.zeros(
             (mb,) + self._data.shape[1:], numpy.float32))
         self.minibatch_labels.reset(numpy.zeros(mb, numpy.int32))

@@ -97,7 +97,7 @@ class RecordsLoader(Loader):
         self.has_labels = self._labels is not None
 
     def create_minibatch_data(self):
-        mb = self.max_minibatch_size
+        mb = self.local_minibatch_size
         self.minibatch_data.reset(numpy.zeros(
             (mb,) + self._data.shape[1:], numpy.float32))
         if self.has_labels:

@@ -54,6 +54,16 @@ class ShardedTrainer:
 
         self.runner = runner
         self.mesh = mesh
+        #: True when the mesh spans multiple processes (multi-host SPMD):
+        #: arrays are then assembled from per-process local shards instead
+        #: of device_put (which requires every device to be addressable)
+        self.multiprocess = len({d.process_index
+                                 for d in mesh.devices.flat}) > 1
+        if self.multiprocess and model_shard_layers:
+            raise NotImplementedError(
+                "model-axis sharding across processes is not supported: "
+                "keep the model axis within a host (the standard TPU "
+                "layout) and span hosts with the data axis only")
         self._repl = NamedSharding(mesh, P())
         self._batch = NamedSharding(mesh, P("data"))
         shardings = []
@@ -74,22 +84,37 @@ class ShardedTrainer:
         self.state_shardings = shardings
         #: global train-step counter (lr policies); see train_step
         self.step_count = 0
-        #: device state, placed according to the sharding plan
-        self.state = jax.device_put(runner.state, shardings)
+        #: device state, placed according to the sharding plan (replicated
+        #: state: every process holds the full value, so local data == the
+        #: global array in the multi-process assembly)
+        self.state = jax.tree.map(self._put, runner.state, shardings)
         # out_shardings pins the updated state to the plan — otherwise
         # GSPMD may re-shard it to whatever propagation preferred
         self._train = jax.jit(runner._train_step, donate_argnums=(0,),
                               out_shardings=(shardings, None))
         self._eval = jax.jit(runner._eval_step)
 
-    def put_batch(self, x, labels, mask):
-        """Shard one (padded, static-shape) minibatch over the data axis."""
+    def _put(self, arr, sharding):
         import jax
-        x = jax.device_put(x, self._batch)
-        labels = (jax.device_put(labels, self._batch)
-                  if labels is not None else None)
-        mask = jax.device_put(mask, self._batch)
-        return x, labels, mask
+        if arr is None:
+            return None
+        if self.multiprocess:
+            return jax.make_array_from_process_local_data(
+                sharding, numpy.asarray(arr))
+        return jax.device_put(arr, sharding)
+
+    def put_batch(self, x, labels, mask):
+        """Shard one (padded, static-shape) minibatch over the data axis.
+
+        Single-process: the arrays are GLOBAL and device_put splits them.
+        Multi-process: each process passes its LOCAL rows — its contiguous
+        slice of the global batch in process order, exactly what
+        ``Loader.shard_spmd`` yields — and the global array is assembled
+        with ``jax.make_array_from_process_local_data``.
+        """
+        return (self._put(x, self._batch),
+                self._put(labels, self._batch),
+                self._put(mask, self._batch))
 
     def train_step(self, x, labels, mask, batch_size, rng=None, step=None):
         """One SPMD train step; ``step`` defaults to an internal counter so
@@ -112,12 +137,23 @@ class ShardedTrainer:
         x, labels, mask = self.put_batch(x, labels, mask)
         return self._eval(self.state, x, labels, mask)
 
+    @staticmethod
+    def fetch(tree):
+        """Host values of replicated outputs (metrics), multi-process safe:
+        reads the local replica instead of requiring full addressability."""
+        import jax
+
+        def leaf(a):
+            if isinstance(a, jax.Array) and not a.is_fully_addressable:
+                return numpy.asarray(a.addressable_data(0))
+            return numpy.asarray(a)
+        return jax.tree.map(leaf, tree)
+
     def sync_to_runner(self):
         """Gather sharded state back into the runner (for snapshots)."""
         import jax
-        self.runner.state = jax.device_get(self.state)  # host numpy pytree
-        self.runner.state = jax.tree.map(
-            lambda a: jax.numpy.asarray(a), self.runner.state)
+        self.runner.state = jax.tree.map(jax.numpy.asarray,
+                                         self.fetch(self.state))
         self.runner.sync_to_units()
 
 
