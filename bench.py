@@ -195,8 +195,12 @@ def bench_epoch_scan(wf, target_seconds=4.0):
 
     def run_epochs(state, n, step0):
         for e in range(n):
+            # distinct dropout stream per epoch: _epoch_train folds the key
+            # by LOCAL step only, so the base key must differ across epochs
+            epoch_rng = (jax.random.fold_in(rng, step0 + e * steps_per_epoch)
+                         if rng is not None else None)
             state, totals = train_epoch(state, data, labels, idx, mask,
-                                        rng=rng,
+                                        rng=epoch_rng,
                                         step0=step0 + e * steps_per_epoch)
         return state, totals
 

@@ -1,0 +1,79 @@
+"""Subprocess worker for the kill-and-resume failure-recovery harness.
+
+Trains the tiny MNIST-FC config for a fixed number of epochs, snapshotting
+every epoch, and writes a digest of the FINAL model state on completion.
+Modes:
+  control — straight run to completion;
+  victim  — same run but slowed per epoch so the parent can SIGKILL it
+            mid-training (never writes the digest);
+  resume  — ``--snapshot auto`` semantics: picks up the victim's latest
+            snapshot and finishes the run.
+Ref: SURVEY §5.3 — the reference's drop_slave/job-reissue elasticity is
+downgraded by design to kill-and-resume on the SPMD substrate; this worker
+is the proof harness.
+"""
+import hashlib
+import json
+import os
+import sys
+import time
+
+
+def main():
+    out_dir, mode = sys.argv[1], sys.argv[2]
+    epoch_sleep = float(sys.argv[3]) if len(sys.argv) > 3 else 0.0
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from veles_tpu import prng
+    from veles_tpu.config import root
+    prng.reset()
+    prng.seed_all(1)
+    root.mnist.update({
+        "loader": {"minibatch_size": 50, "n_train": 300, "n_valid": 100},
+        "decision": {"max_epochs": 6, "fail_iterations": 100},
+        "layers": [
+            {"type": "all2all_tanh", "output_sample_shape": 32,
+             "learning_rate": 0.05, "momentum": 0.9},
+            {"type": "softmax", "output_sample_shape": 10,
+             "learning_rate": 0.05, "momentum": 0.9},
+        ],
+    })
+    from veles_tpu.samples import mnist
+    from veles_tpu.launcher import Launcher
+    wf = mnist.build(fused=True, snapshotter_config={
+        "directory": os.path.join(out_dir, "snaps"),
+        "interval": 1, "compression": ""})
+
+    if epoch_sleep > 0.0:
+        decision = wf.decision
+        orig_run = decision.run
+
+        def slow_run():
+            orig_run()
+            if bool(wf.loader.epoch_ended):
+                time.sleep(epoch_sleep)
+        decision.run = slow_run
+
+    Launcher(wf, stats=False,
+             snapshot="auto" if mode == "resume" else None).boot()
+
+    digest = hashlib.sha256()
+    for fwd in wf.forwards:
+        digest.update(bytes(memoryview(fwd.weights.mem)))
+        digest.update(bytes(memoryview(fwd.bias.mem)))
+    result = {
+        "weights_sha": digest.hexdigest(),
+        "best_metric": wf.decision.best_metric,
+        "best_epoch": wf.decision.best_epoch,
+        "epochs": int(wf.loader.epoch_number),
+    }
+    with open(os.path.join(out_dir, mode + ".json"), "w",
+              encoding="utf-8") as f:
+        json.dump(result, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,50 @@
+"""Launcher: profiler trace capture + device step-time reporting.
+
+Ref: veles/launcher.py [H] + SURVEY §5.1 (tracing/profiling rebuild note):
+the reference exposed per-unit timing; the TPU rebuild adds a jax.profiler
+trace of the whole run (``--profile DIR``) and a measured fused-step device
+time in print_stats.
+"""
+
+import glob
+import os
+
+
+def _build_tiny_mnist():
+    from veles_tpu import prng
+    from veles_tpu.config import root
+    prng.reset()
+    prng.seed_all(1)
+    root.mnist.update({
+        "loader": {"minibatch_size": 50, "n_train": 200, "n_valid": 100},
+        "decision": {"max_epochs": 2, "fail_iterations": 5},
+        "layers": [
+            {"type": "all2all_tanh", "output_sample_shape": 16,
+             "learning_rate": 0.03, "momentum": 0.9},
+            {"type": "softmax", "output_sample_shape": 10,
+             "learning_rate": 0.03, "momentum": 0.9},
+        ],
+    })
+    from veles_tpu.samples import mnist
+    return mnist.build(fused=True)
+
+
+class TestLauncherProfile:
+    def test_profile_writes_trace(self, tmp_path):
+        from veles_tpu.launcher import Launcher
+        wf = _build_tiny_mnist()
+        trace_dir = str(tmp_path / "trace")
+        launcher = Launcher(wf, stats=False, profile=trace_dir)
+        launcher.boot()
+        assert wf.decision.complete
+        found = glob.glob(os.path.join(
+            trace_dir, "plugins", "profile", "*", "*.xplane.pb"))
+        assert found, "no xplane trace written under %s" % trace_dir
+
+    def test_device_step_time_measured(self, tmp_path):
+        from veles_tpu.launcher import Launcher
+        wf = _build_tiny_mnist()
+        Launcher(wf, stats=False).boot()
+        step_time = wf._fused_runner.measure_device_step_time(iters=3)
+        assert step_time is not None and 0.0 < step_time < 60.0
+        wf.print_stats()  # must not raise with the device-time line

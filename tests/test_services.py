@@ -148,6 +148,48 @@ class TestForge:
             numpy.asarray(wf.forwards[0].weights.mem), atol=1e-6)
 
 
+class TestForgeTraversal:
+    """A crafted package whose manifest names members outside the extraction
+    dir must be rejected (forge packages are untrusted once fetched)."""
+
+    def _evil_package(self, tmp_path, key, member):
+        import json
+        import tarfile
+        pkg = str(tmp_path / "evil.forge.tar.gz")
+        manifest = {"name": "evil", "snapshot": "snap.bin", "format": 1,
+                    "packaged_at": 0, key: member}
+        payload = tmp_path / "payload.bin"
+        payload.write_bytes(b"owned")
+        with tarfile.open(pkg, "w:gz") as tar:
+            mf = tmp_path / "manifest.json"
+            mf.write_text(json.dumps(manifest))
+            tar.add(str(mf), arcname="manifest.json")
+            tar.add(str(payload), arcname="snap.bin")
+        return pkg
+
+    def test_artifact_traversal_rejected(self, tmp_path):
+        import pytest
+        from veles_tpu import forge
+        pkg = self._evil_package(tmp_path, "artifact", "../evil.bin")
+        with pytest.raises(ValueError, match="unsafe member"):
+            forge.load_artifact(pkg, out_dir=str(tmp_path / "out"))
+        assert not (tmp_path / "evil.bin").exists()
+
+    def test_snapshot_traversal_rejected(self, tmp_path):
+        import pytest
+        from veles_tpu import forge
+        pkg = self._evil_package(tmp_path, "snapshot", "../../snap.bin")
+        with pytest.raises(ValueError, match="unsafe member"):
+            forge.unpack(pkg, str(tmp_path / "out"))
+
+    def test_absolute_member_rejected(self, tmp_path):
+        import pytest
+        from veles_tpu import forge
+        pkg = self._evil_package(tmp_path, "artifact", "/tmp/evil.bin")
+        with pytest.raises(ValueError, match="unsafe member"):
+            forge.load_artifact(pkg, out_dir=str(tmp_path / "out"))
+
+
 class TestPublishing:
     def test_reports(self, tmp_path):
         from veles_tpu.publishing import Publisher

@@ -1,0 +1,101 @@
+"""Capture a jax.profiler trace of a bench config's train step and print a
+per-op cost breakdown (top XLA ops by total device time).
+
+Usage: python tools/trace_step.py [mnist|cifar|alexnet] [outdir]
+"""
+import glob
+import gzip
+import json
+import os
+import sys
+import time
+from collections import defaultdict
+
+import numpy
+
+
+def _sync(x):
+    import jax
+    return numpy.asarray(jax.tree.leaves(x)[0]).ravel()[0]
+
+
+def main():
+    config = sys.argv[1] if len(sys.argv) > 1 else "alexnet"
+    outdir = sys.argv[2] if len(sys.argv) > 2 else "/tmp/veles_trace_" + config
+    import jax
+    import bench
+
+    if config == "mnist":
+        wf = bench.build_mnist(60000, 10000, 100)
+    elif config == "cifar":
+        wf = bench.build_cifar(50000, 10000, 100)
+    else:
+        wf = bench.build_alexnet(1024, 128, 128)
+
+    runner = wf._fused_runner
+    train_epoch, _ = runner.epoch_fns()
+    loader = wf.loader
+    data = loader.original_data.devmem
+    labels = loader.original_labels.devmem
+    idx, mask = bench.epoch_plan_arrays(loader)
+    from veles_tpu import prng
+    rng = prng.get("dropout").key() if runner._has_stochastic else None
+
+    # compile + warm
+    state, totals = train_epoch(runner.state, data, labels, idx, mask,
+                                rng=rng, step0=0)
+    _sync(totals)
+    begin = time.perf_counter()
+    state, totals = train_epoch(state, data, labels, idx, mask,
+                                rng=rng, step0=0)
+    _sync(totals)
+    steps = idx.shape[0]
+    wall = time.perf_counter() - begin
+    print("epoch wall %.1f ms, %d steps, %.2f ms/step"
+          % (wall * 1e3, steps, wall / steps * 1e3))
+
+    with jax.profiler.trace(outdir):
+        state, totals = train_epoch(state, data, labels, idx, mask,
+                                    rng=rng, step0=0)
+        _sync(totals)
+
+    # ---- parse the chrome trace: aggregate device-lane events by name
+    paths = glob.glob(os.path.join(outdir, "plugins/profile/*/*.trace.json.gz"))
+    if not paths:
+        print("no trace found under", outdir)
+        return 1
+    path = max(paths, key=os.path.getmtime)
+    with gzip.open(path, "rt") as f:
+        trace = json.load(f)
+    events = trace["traceEvents"]
+    # find device lanes (TPU pids); tid/pid metadata names them
+    pid_names = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            pid_names[e["pid"]] = e["args"].get("name", "")
+    device_pids = {pid for pid, name in pid_names.items()
+                   if "TPU" in name or "/device" in name.lower()}
+    totals_by_name = defaultdict(float)
+    count_by_name = defaultdict(int)
+    total = 0.0
+    for e in events:
+        if e.get("ph") != "X" or e.get("pid") not in device_pids:
+            continue
+        name = e.get("name", "?")
+        dur = e.get("dur", 0.0)  # microseconds
+        totals_by_name[name] += dur
+        count_by_name[name] += 1
+        total += dur
+    print("\ndevice lanes: %s" % {p: pid_names[p] for p in device_pids})
+    print("total device time in trace: %.1f ms over %d steps -> %.2f ms/step"
+          % (total / 1e3, steps, total / 1e3 / steps))
+    print("\n%-72s %10s %6s %6s" % ("op", "total_ms", "count", "pct"))
+    for name, t in sorted(totals_by_name.items(), key=lambda kv: -kv[1])[:40]:
+        print("%-72s %10.2f %6d %5.1f%%"
+              % (name[:72], t / 1e3, count_by_name[name],
+                 100.0 * t / max(total, 1e-9)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -41,7 +41,10 @@ def build_argparser():
     parser.add_argument("--random-seed", type=int, default=None,
                         help="seed every named PRNG stream")
     parser.add_argument("-s", "--snapshot", default=None,
-                        help="resume from this snapshot file")
+                        help="resume from this snapshot file, or 'auto' to "
+                             "resume from the latest snapshot in the "
+                             "workflow's snapshot directory (fresh run if "
+                             "none exists) — crash recovery")
     parser.add_argument("-d", "--device", default=None,
                         choices=("tpu", "cpu"),
                         help="JAX platform to run on (default: auto)")
@@ -67,6 +70,9 @@ def build_argparser():
                         help="write the unit graph as graphviz dot")
     parser.add_argument("--no-stats", action="store_true",
                         help="skip the per-unit run-time table")
+    parser.add_argument("--profile", default=None, metavar="DIR",
+                        help="capture a jax.profiler trace of the run into "
+                             "DIR (view with tensorboard/xprof)")
     parser.add_argument("--optimize", default=None, metavar="GENERATIONS",
                         help="genetic hyperparameter search over Tune() "
                              "leaves: '<generations>' or "
@@ -174,7 +180,7 @@ def main(argv=None):
             wf, snapshot=args.snapshot, distributed=args.distributed,
             coordinator_address=args.coordinator_address,
             num_processes=args.num_processes, process_id=args.process_id,
-            stats=not args.no_stats)
+            stats=not args.no_stats, profile=args.profile)
         holder["launcher"] = launcher
         launcher.boot()
 

@@ -116,6 +116,30 @@ class FusedRunner:
             err = err_in
         return new_state, metrics
 
+    def measure_device_step_time(self, iters=10):
+        """Steady-state device time of one fused train step, by re-running
+        the last dispatched batch ``iters`` times and ending the window in
+        a value fetch (``block_until_ready`` does not block through the
+        TPU tunnel).  None until a train step has run.  Feeds the
+        ``print_stats`` device-time line (SURVEY §5.1 profiling rebuild)."""
+        import time
+        import numpy
+        import jax
+        args = getattr(self, "_last_train_args", None)
+        if args is None:
+            return None
+
+        def fetch(tree):
+            return numpy.asarray(jax.tree.leaves(tree)[0]).ravel()[0]
+
+        _, metrics = self._train(self.state, *args)
+        fetch(metrics)  # warm (already compiled; syncs pending work)
+        begin = time.perf_counter()
+        for _ in range(iters):
+            _, metrics = self._train(self.state, *args)
+        fetch(metrics)
+        return (time.perf_counter() - begin) / iters
+
     def eval_forward(self):
         """Jitted eval-mode forward ``(state, x) -> last activation``,
         compiled once and shared (REST serving, ensemble combination)."""
@@ -258,10 +282,11 @@ class FusedStep(Unit):
                 rng = prng.get("dropout").key()
             else:
                 rng = None
-            self.pending_state, metrics = runner._train(
-                runner.state, x, y_ref, mask,
-                jnp.asarray(loader.minibatch_size, jnp.int32), rng,
-                jnp.asarray(self.train_steps, jnp.int32))
+            args = (x, y_ref, mask,
+                    jnp.asarray(loader.minibatch_size, jnp.int32), rng,
+                    jnp.asarray(self.train_steps, jnp.int32))
+            self.pending_state, metrics = runner._train(runner.state, *args)
+            runner._last_train_args = args  # for measure_device_step_time
             self.train_steps += 1
         else:
             self.pending_state = None

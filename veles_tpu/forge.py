@@ -23,6 +23,17 @@ import time
 MANIFEST = "manifest.json"
 
 
+def _safe_member(name):
+    """Reject manifest-controlled member names that could escape the
+    extraction directory (path traversal via '../' or absolute paths): a
+    member must be a bare file name.  Packages are UNTRUSTED once fetched
+    from a shared store."""
+    if (not name or os.path.basename(name) != name
+            or name in (os.curdir, os.pardir)):
+        raise ValueError("unsafe member name in forge manifest: %r" % (name,))
+    return name
+
+
 def pack(snapshot_path, out_path, name=None, author=None, description="",
          metrics=None, extra_files=(), artifact_path=None):
     """Create a forge package from a snapshot file.
@@ -76,7 +87,7 @@ def unpack(package_path, out_dir):
         tar.extractall(out_dir, filter="data")
     with open(os.path.join(out_dir, MANIFEST), encoding="utf-8") as f:
         manifest = json.load(f)
-    return manifest, os.path.join(out_dir, manifest["snapshot"])
+    return manifest, os.path.join(out_dir, _safe_member(manifest["snapshot"]))
 
 
 def publish(package_path, store_dir):
@@ -121,9 +132,10 @@ def load_artifact(package_path, out_dir=None):
     if "artifact" not in manifest:
         raise KeyError("package %s carries no export artifact"
                        % package_path)
+    artifact_name = _safe_member(manifest["artifact"])  # before mkdtemp
     cleanup = out_dir is None
     out_dir = out_dir or tempfile.mkdtemp(prefix="forge_")
-    artifact_path = os.path.join(out_dir, manifest["artifact"])
+    artifact_path = os.path.join(out_dir, artifact_name)
     try:
         with tarfile.open(package_path, "r:gz") as tar:
             member = tar.extractfile(manifest["artifact"])

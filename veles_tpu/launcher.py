@@ -35,7 +35,7 @@ class Launcher(Logger):
 
     def __init__(self, workflow, snapshot=None, distributed=False,
                  coordinator_address=None, num_processes=None,
-                 process_id=None, stats=True):
+                 process_id=None, stats=True, profile=None):
         self.workflow = workflow
         self.snapshot = snapshot
         self.distributed = distributed
@@ -43,6 +43,9 @@ class Launcher(Logger):
         self.num_processes = num_processes
         self.process_id = process_id
         self.stats = stats
+        #: directory for a jax.profiler trace of the run (open with
+        #: tensorboard / xprof, or tools/trace_step.py's parser)
+        self.profile = profile
         self.restored_payload = None
         self.run_seconds = None
 
@@ -59,13 +62,35 @@ class Launcher(Logger):
                 loader.shard(index, count)
             self.info("joined distributed run as process %d/%d", index, count)
         wf.initialize(**kwargs)
-        if self.snapshot:
+        snapshot = self.snapshot
+        if snapshot == "auto":
+            # resume from the latest published snapshot of this workflow's
+            # snapshotter directory, or start fresh if none exists yet —
+            # the crash-recovery half of SURVEY §5.3 (drop_slave downgrade:
+            # kill-and-resume instead of master-side job reissue)
             from veles_tpu import snapshotter
-            self.restored_payload = snapshotter.restore(wf, self.snapshot)
-            self.info("resumed from %s (epoch %s)", self.snapshot,
+            snap_unit = getattr(wf, "snapshotter", None)
+            if snap_unit is None:
+                raise ValueError("--snapshot auto needs a workflow with a "
+                                 "snapshotter (set --snapshot-dir)")
+            snapshot = snapshotter.find_current(snap_unit.directory,
+                                                snap_unit.prefix)
+            if snapshot is None:
+                self.info("no snapshot in %s — starting fresh",
+                          snap_unit.directory)
+        if snapshot:
+            from veles_tpu import snapshotter
+            self.restored_payload = snapshotter.restore(wf, snapshot)
+            self.info("resumed from %s (epoch %s)", snapshot,
                       self.restored_payload.get("epoch"))
         begin = time.perf_counter()
-        wf.run()
+        if self.profile:
+            import jax.profiler
+            with jax.profiler.trace(self.profile):
+                wf.run()
+            self.info("profiler trace written to %s", self.profile)
+        else:
+            wf.run()
         self.run_seconds = time.perf_counter() - begin
         self.info("workflow %r finished in %.2fs", wf.name, self.run_seconds)
         if self.stats:

@@ -32,7 +32,8 @@ CLASS_NAME = ["test", "validation", "train"]
 class Loader(Unit):
     """Abstract minibatch engine; subclasses provide the data."""
 
-    snapshot_attrs = ("epoch_number", "_position", "_order")
+    snapshot_attrs = ("epoch_number", "_position", "_order", "_shard",
+                      "_spmd_shard")
 
     def __init__(self, workflow, minibatch_size=100, shuffle=True,
                  prng_stream="loader", **kwargs):
@@ -104,6 +105,28 @@ class Loader(Unit):
         self._spmd_shard = (process_index, process_count)
         self._order = None
         return self
+
+    def load_state_dict(self, d):
+        """Snapshot restore, shard-aware.
+
+        Snapshots are written by process 0 only, so the captured
+        ``_shard``/``_spmd_shard`` (and the ``_order`` planned for them) are
+        process 0's.  Resuming with the SAME topology restores them
+        bit-exactly.  Resuming under a DIFFERENT shard identity (another
+        process of a distributed run, or a changed process count) keeps
+        THIS process's runtime identity — set by the launcher before
+        restore — and rebuilds the epoch plan for it; epoch_number and the
+        PRNG streams still come from the snapshot, so coverage is correct
+        but mid-epoch position is restarted (cross-topology resume cannot
+        be bit-exact).
+        """
+        runtime = (self._shard, self._spmd_shard)
+        super().load_state_dict(d)
+        restored = (self._shard, self._spmd_shard)
+        if restored != runtime:
+            self._shard, self._spmd_shard = runtime
+            self._order = None
+            self._position = 0
 
     @property
     def local_minibatch_size(self):

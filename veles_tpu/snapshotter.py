@@ -190,6 +190,35 @@ class Snapshotter(SnapshotterToFile):
     pass
 
 
+def find_current(directory, prefix=None):
+    """Most recent ``*_current.pickle*`` snapshot in ``directory`` or None.
+
+    The resolver behind ``--snapshot auto`` (SURVEY §5.3): a crashed/killed
+    run resumes from the last atomically-published snapshot without the
+    operator having to name the file — the reference's master restarted
+    slaves from its own latest snapshot the same way.
+    """
+    if not os.path.isdir(directory):
+        return None
+    suffixes = tuple(".pickle" + ("." + c if c else "")
+                     for c in _OPENERS)
+    best, best_mtime = None, -1.0
+    for fname in os.listdir(directory):
+        stem = fname.split(".pickle")[0]
+        # exact-suffix check: a crash can leave '*_current.pickle.gz.tmp'
+        # staging files behind — resuming from one would be fatal
+        if (not stem.endswith("_current")
+                or not any(fname == stem + s for s in suffixes)):
+            continue
+        if prefix is not None and stem != prefix + "_current":
+            continue
+        path = os.path.join(directory, fname)
+        mtime = os.path.getmtime(path)
+        if mtime > best_mtime:
+            best, best_mtime = path, mtime
+    return best
+
+
 def import_(path):
     """Load a snapshot payload from disk (ref: Snapshotter.import_ [H])."""
     with _open_for(path, "rb") as f:

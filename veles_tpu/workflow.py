@@ -173,7 +173,9 @@ class Workflow(Unit):
 
     # -------------------------------------------------------------- reporting
     def print_stats(self):
-        """Per-unit wall-time accounting (ref: veles/timeit2.py [M])."""
+        """Per-unit wall-time accounting (ref: veles/timeit2.py [M]) plus,
+        for fused workflows, the measured DEVICE time of one train step
+        (host wall-time per unit cannot see it — dispatch is async)."""
         rows = sorted(self._units, key=lambda u: -u.run_time)
         total = sum(u.run_time for u in self._units)
         self.info("unit run-time breakdown (total %.3fs):", total)
@@ -182,6 +184,12 @@ class Workflow(Unit):
                 continue
             self.info("  %-30s %8d runs %10.3fs", unit.name, unit.run_count,
                       unit.run_time)
+        runner = getattr(self, "_fused_runner", None)
+        if runner is not None:
+            step_time = runner.measure_device_step_time()
+            if step_time is not None:
+                self.info("  fused train step (device)      %10.3f ms/step",
+                          step_time * 1e3)
 
     def generate_graph(self, filename=None):
         """Render the unit graph as graphviz dot text.
