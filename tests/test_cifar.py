@@ -34,6 +34,23 @@ def test_cifar_conv_converges():
     assert final < first
 
 
+def test_cifar_default_topology_converges():
+    """The SAMPLE DEFAULT layer stack must be trainable out of the box
+    (round 4 found the previous smooth-relu/glorot default stalled at
+    chance — convergence of defaults is part of the product contract)."""
+    prng.reset(); prng.seed_all(42)
+    root.__dict__.pop("cifar", None)
+    root.cifar.update({
+        "loader": {"minibatch_size": 50, "n_train": 600, "n_valid": 200},
+        "decision": {"max_epochs": 8, "fail_iterations": 50},
+    })
+    from veles_tpu.samples import cifar
+    wf = cifar.train(fused=True)
+    errs = [m["validation"]["err_pct"] for m in wf.decision.epoch_metrics
+            if "validation" in m]
+    assert errs[-1] < 10.0, errs
+
+
 def test_cifar_fused_and_unit_mode_identical():
     from veles_tpu.samples import cifar
     finals, weights = [], []

@@ -1,0 +1,188 @@
+"""Real-data path + sample-zoo tail (VERDICT r3 Missing #4).
+
+- MnistLoader._load_real/_read_idx against tiny on-disk IDX fixtures
+  (plain and gzipped), ref: veles/loader/mnist.py [H] IDX decode;
+- the MNIST-conv sample (conv topology over 28x28x1);
+- the directory-image sample driving loader/image.py end to end.
+"""
+
+import gzip
+import os
+import struct
+
+import numpy
+import pytest
+
+
+# ------------------------------------------------------------- IDX fixtures
+def _write_idx_images(path, arr, compress=False):
+    """IDX3 ubyte image file (magic 0x00000803), optionally gzipped."""
+    header = struct.pack(">IIII", 0x00000803, *arr.shape)
+    payload = header + arr.astype(numpy.uint8).tobytes()
+    opener = gzip.open if compress else open
+    with opener(path, "wb") as f:
+        f.write(payload)
+
+
+def _write_idx_labels(path, labels, compress=False):
+    header = struct.pack(">II", 0x00000801, len(labels))
+    payload = header + labels.astype(numpy.uint8).tobytes()
+    opener = gzip.open if compress else open
+    with opener(path, "wb") as f:
+        f.write(payload)
+
+
+def _make_mnist_dir(tmp_path, n_train=30, n_valid=20, compress=False):
+    rng = numpy.random.RandomState(0)
+    suffix = ".gz" if compress else ""
+    train_x = rng.randint(0, 256, (n_train, 28, 28), numpy.uint8)
+    train_y = (numpy.arange(n_train) % 10).astype(numpy.uint8)
+    test_x = rng.randint(0, 256, (n_valid, 28, 28), numpy.uint8)
+    test_y = (numpy.arange(n_valid) % 10).astype(numpy.uint8)
+    _write_idx_images(str(tmp_path / ("train-images-idx3-ubyte" + suffix)),
+                      train_x, compress)
+    _write_idx_labels(str(tmp_path / ("train-labels-idx1-ubyte" + suffix)),
+                      train_y, compress)
+    _write_idx_images(str(tmp_path / ("t10k-images-idx3-ubyte" + suffix)),
+                      test_x, compress)
+    _write_idx_labels(str(tmp_path / ("t10k-labels-idx1-ubyte" + suffix)),
+                      test_y, compress)
+    return train_x, train_y, test_x, test_y
+
+
+@pytest.mark.parametrize("compress", [False, True],
+                         ids=["plain", "gzipped"])
+def test_mnist_load_real_idx(tmp_path, compress):
+    from veles_tpu.samples.mnist import MnistLoader
+    train_x, train_y, test_x, test_y = _make_mnist_dir(
+        tmp_path, compress=compress)
+    loader = MnistLoader(None, n_train=30, n_valid=20,
+                         data_dir=str(tmp_path), minibatch_size=10,
+                         name="loader")
+    loader.initialize()
+    assert loader.class_lengths == [0, 20, 30]
+    data = numpy.asarray(loader.original_data.mem)
+    assert data.shape == (50, 784)
+    # [test|valid|train] layout: first 20 rows are the t10k set, scaled
+    expect_valid = test_x.reshape(20, -1).astype(numpy.float32) / 127.5 - 1.0
+    numpy.testing.assert_allclose(data[:20], expect_valid, atol=1e-6)
+    labels = numpy.asarray(loader.original_labels.mem)
+    numpy.testing.assert_array_equal(labels[:20], test_y)
+    numpy.testing.assert_array_equal(labels[20:], train_y)
+    assert data.min() >= -1.0 and data.max() <= 1.0
+
+
+def test_mnist_load_real_truncates_to_requested_sizes(tmp_path):
+    from veles_tpu.samples.mnist import MnistLoader
+    _make_mnist_dir(tmp_path, n_train=30, n_valid=20)
+    loader = MnistLoader(None, n_train=12, n_valid=8,
+                         data_dir=str(tmp_path), minibatch_size=4,
+                         name="loader")
+    loader.initialize()
+    assert loader.class_lengths == [0, 8, 12]
+
+
+def test_mnist_conv_sample_shape_real_data(tmp_path):
+    """The conv loader serves the SAME IDX files in NHWC layout."""
+    from veles_tpu.samples.mnist_conv import MnistConvLoader
+    train_x, _, test_x, _ = _make_mnist_dir(tmp_path)
+    loader = MnistConvLoader(None, n_train=30, n_valid=20,
+                             data_dir=str(tmp_path), minibatch_size=10,
+                             name="loader")
+    loader.initialize()
+    assert loader.original_data.shape == (50, 28, 28, 1)
+
+
+# --------------------------------------------------------- mnist_conv sample
+def _structured_digits(n, rng):
+    """Spatially-STRUCTURED 10-class images a conv net can learn (the
+    loader's iid-noise synthetic prototypes are FC-learnable but carry no
+    translation-robust signal, so they are wrong for a conv topology):
+    class c < 5 — horizontal bar in row band c; c >= 5 — vertical bar in
+    column band c-5."""
+    labels = (numpy.arange(n) % 10).astype(numpy.uint8)
+    rng.shuffle(labels)
+    imgs = rng.randint(0, 40, (n, 28, 28)).astype(numpy.uint8)
+    for i, c in enumerate(labels):
+        band = slice(5 * (c % 5) + 1, 5 * (c % 5) + 4)
+        if c < 5:
+            imgs[i, band, :] = 255
+        else:
+            imgs[i, :, band] = 255
+    return imgs, labels
+
+
+def test_mnist_conv_converges_on_real_idx(tmp_path):
+    """Full conv training run fed through the REAL IDX decode path."""
+    from veles_tpu import prng
+    from veles_tpu.config import root
+    rng = numpy.random.RandomState(5)
+    train_x, train_y = _structured_digits(300, rng)
+    test_x, test_y = _structured_digits(60, rng)
+    _write_idx_images(str(tmp_path / "train-images-idx3-ubyte"), train_x)
+    _write_idx_labels(str(tmp_path / "train-labels-idx1-ubyte"), train_y)
+    _write_idx_images(str(tmp_path / "t10k-images-idx3-ubyte"), test_x)
+    _write_idx_labels(str(tmp_path / "t10k-labels-idx1-ubyte"), test_y)
+
+    prng.reset()
+    prng.seed_all(1)
+    root.__dict__.pop("mnist_conv", None)
+    root.mnist_conv.update({
+        "loader": {"minibatch_size": 30, "n_train": 300, "n_valid": 60,
+                   "data_dir": str(tmp_path)},
+        "decision": {"max_epochs": 6, "fail_iterations": 20},
+    })
+    from veles_tpu.samples import mnist_conv
+    wf = mnist_conv.train()
+    assert wf.decision.complete
+    errs = [m["validation"]["n_err"] for m in wf.decision.epoch_metrics
+            if "validation" in m]
+    assert errs[-1] <= errs[0] // 4, \
+        "conv sample did not learn the structured digits: %s" % errs
+    # topology sanity: conv stack flattened into the FC trunk
+    assert wf.forwards[0].weights.shape == (5, 5, 1, 32)
+    assert wf.forwards[-1].output.shape == (30, 10)
+
+
+# ----------------------------------------------------- directory-image sample
+def _write_image_tree(tmp_path, per_class=12, size=(40, 36)):
+    from PIL import Image
+    rng = numpy.random.RandomState(3)
+    # two visually-distinct classes: bright-red-ish vs dark-blue-ish
+    for cls, base in (("red", (200, 30, 30)), ("blue", (20, 40, 180))):
+        d = tmp_path / cls
+        d.mkdir()
+        for i in range(per_class):
+            arr = numpy.clip(rng.normal(
+                base, 25, size + (3,)), 0, 255).astype(numpy.uint8)
+            Image.fromarray(arr).save(d / ("img_%02d.png" % i))
+
+
+def test_image_dir_sample_end_to_end(tmp_path):
+    from veles_tpu import prng
+    from veles_tpu.config import root
+    _write_image_tree(tmp_path)
+    prng.reset()
+    prng.seed_all(1)
+    root.__dict__.pop("image_dir", None)
+    root.image_dir.update({
+        "loader": {"minibatch_size": 8, "scale": (16, 16),
+                   "validation_ratio": 0.25},
+        "decision": {"max_epochs": 4, "fail_iterations": 10},
+    })
+    from veles_tpu.samples import image_dir
+    wf = image_dir.train(loader={"directory": str(tmp_path)})
+    assert wf.decision.complete
+    assert wf.loader.label_names == ["blue", "red"]
+    errs = [m["validation"]["n_err"] for m in wf.decision.epoch_metrics
+            if "validation" in m]
+    # 2 trivially-separable color classes: the net must solve them
+    assert errs[-1] == 0, "image_dir sample failed to separate: %s" % errs
+
+
+def test_image_dir_sample_requires_directory():
+    from veles_tpu.config import root
+    root.__dict__.pop("image_dir", None)
+    from veles_tpu.samples import image_dir
+    with pytest.raises(ValueError, match="directory"):
+        image_dir.build()

@@ -99,15 +99,22 @@ def default_config():
         "loader": {"minibatch_size": 100, "n_train": 50000,
                    "n_valid": 10000},
         "decision": {"max_epochs": 20, "fail_iterations": 100},
+        # strict-relu convs with explicit gaussian init, caffe-style — the
+        # reference's cifar configs pinned weights_filling/stddev the same
+        # way; the smooth-relu glorot default stalls at chance on this
+        # depth (tests/test_samples_real_data.py documents the contrast)
         "layers": [
-            {"type": "conv_relu", "n_kernels": 32, "kx": 5, "ky": 5,
-             "padding": "SAME", "learning_rate": 0.02, "momentum": 0.9},
+            {"type": "conv_str", "n_kernels": 32, "kx": 5, "ky": 5,
+             "padding": "SAME", "learning_rate": 0.02, "momentum": 0.9,
+             "weights_filling": "gaussian", "weights_stddev": 0.05},
             {"type": "max_pooling", "kx": 2, "ky": 2},
-            {"type": "conv_relu", "n_kernels": 32, "kx": 5, "ky": 5,
-             "padding": "SAME", "learning_rate": 0.02, "momentum": 0.9},
+            {"type": "conv_str", "n_kernels": 32, "kx": 5, "ky": 5,
+             "padding": "SAME", "learning_rate": 0.02, "momentum": 0.9,
+             "weights_filling": "gaussian", "weights_stddev": 0.05},
             {"type": "avg_pooling", "kx": 2, "ky": 2},
-            {"type": "conv_relu", "n_kernels": 64, "kx": 5, "ky": 5,
-             "padding": "SAME", "learning_rate": 0.02, "momentum": 0.9},
+            {"type": "conv_str", "n_kernels": 64, "kx": 5, "ky": 5,
+             "padding": "SAME", "learning_rate": 0.02, "momentum": 0.9,
+             "weights_filling": "gaussian", "weights_stddev": 0.05},
             {"type": "avg_pooling", "kx": 2, "ky": 2},
             {"type": "softmax", "output_sample_shape": 10,
              "learning_rate": 0.02, "momentum": 0.9},

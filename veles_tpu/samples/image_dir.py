@@ -1,0 +1,95 @@
+"""Directory-image-dataset sample: train a small conv net on a directory of
+images, one class per subdirectory.
+
+Ref: the reference's file-image sample pipelines (veles/loader/file_image.py
+driven samples [M], SURVEY §2.2/§2.3): point the framework at a directory
+tree and train — no dataset-specific code.  Uses
+:class:`veles_tpu.loader.image.AutoSplitImageLoader` (PIL decode, scale,
+deterministic validation split) end to end.
+
+Config (``root.image_dir``): ``loader.directory`` is required; class count
+is discovered from the subdirectories at load time, so
+``layers[-1].output_sample_shape`` must match (or use :func:`build` which
+patches it automatically).
+"""
+
+from __future__ import annotations
+
+from veles_tpu.config import root, get
+from veles_tpu.loader.image import AutoSplitImageLoader
+from veles_tpu.standard_workflow import StandardWorkflow
+
+
+class ImageDirWorkflow(StandardWorkflow):
+    """scale→conv→pool→conv→pool→FC over a scanned image directory."""
+
+
+def default_config():
+    root.image_dir.defaults({
+        "loader": {"minibatch_size": 32, "scale": (32, 32),
+                   "validation_ratio": 0.2, "color_space": "RGB"},
+        "decision": {"max_epochs": 10, "fail_iterations": 20},
+        # strict-relu convs with explicit init (see samples/mnist_conv.py)
+        "layers": [
+            {"type": "conv_str", "n_kernels": 16, "kx": 3, "ky": 3,
+             "padding": "SAME", "learning_rate": 0.02, "momentum": 0.9,
+             "weights_filling": "gaussian", "weights_stddev": 0.05},
+            {"type": "max_pooling", "kx": 2, "ky": 2},
+            {"type": "conv_str", "n_kernels": 32, "kx": 3, "ky": 3,
+             "padding": "SAME", "learning_rate": 0.02, "momentum": 0.9,
+             "weights_filling": "gaussian", "weights_stddev": 0.05},
+            {"type": "max_pooling", "kx": 2, "ky": 2},
+            {"type": "softmax", "output_sample_shape": 2,
+             "learning_rate": 0.02, "momentum": 0.9},
+        ],
+    })
+    return root.image_dir
+
+
+def _n_classes(directory):
+    import os
+    return max(2, len([d for d in os.listdir(directory)
+                       if os.path.isdir(os.path.join(directory, d))]))
+
+
+def build(fused=True, **overrides):
+    cfg = default_config()
+    loader_config = {k: get(v, v) for k, v in cfg.loader.items()}
+    loader_config.update(overrides.pop("loader", {}))
+    if "directory" not in loader_config:
+        raise ValueError("image_dir sample needs loader.directory "
+                         "(root.image_dir.loader.directory=PATH)")
+    decision_config = {k: get(v, v) for k, v in cfg.decision.items()}
+    decision_config.update(overrides.pop("decision", {}))
+    layers = [dict(layer) for layer in get(cfg.layers, cfg.layers)]
+    # the output layer's width follows the scanned class count
+    layers[-1]["output_sample_shape"] = _n_classes(
+        loader_config["directory"])
+    return ImageDirWorkflow(
+        None, name="image_dir", loader_factory=AutoSplitImageLoader,
+        loader_config=loader_config, layers=layers,
+        decision_config=decision_config, loss_function="softmax",
+        fused=fused, **overrides)
+
+
+def train(fused=True, **overrides):
+    wf = build(fused=fused, **overrides)
+    wf.initialize()
+    wf.run()
+    return wf
+
+
+def run(load, main):
+    cfg = default_config()
+    loader_config = {k: get(v, v) for k, v in cfg.loader.items()}
+    if "directory" not in loader_config:
+        raise ValueError("set root.image_dir.loader.directory=PATH")
+    layers = [dict(layer) for layer in get(cfg.layers, cfg.layers)]
+    layers[-1]["output_sample_shape"] = _n_classes(
+        loader_config["directory"])
+    load(ImageDirWorkflow, name="image_dir",
+         loader_factory=AutoSplitImageLoader, loader_config=loader_config,
+         layers=layers,
+         decision_config={k: get(v, v) for k, v in cfg.decision.items()},
+         loss_function="softmax")
+    main()

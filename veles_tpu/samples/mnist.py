@@ -43,14 +43,19 @@ def _find_idx(data_dir, stem):
 
 
 class MnistLoader(FullBatchLoader):
-    """MNIST (or synthetic stand-in), flattened to (N, 784) in [-1, 1]."""
+    """MNIST (or synthetic stand-in) in [-1, 1].
+
+    ``sample_shape`` picks the layout: (784,) flat for the FC sample
+    (default), (28, 28, 1) NHWC for the conv sample.
+    """
 
     def __init__(self, workflow, n_train=60000, n_valid=10000,
-                 data_dir=None, **kwargs):
+                 data_dir=None, sample_shape=(784,), **kwargs):
         super().__init__(workflow, **kwargs)
         self.n_train = n_train
         self.n_valid = n_valid
         self.data_dir = data_dir
+        self.sample_shape = tuple(sample_shape)
 
     def _dataset_dir(self):
         if self.data_dir:
@@ -79,7 +84,8 @@ class MnistLoader(FullBatchLoader):
         data = numpy.concatenate([test_x[:n_valid], train_x[:n_train]])
         labels = numpy.concatenate([test_y[:n_valid], train_y[:n_train]])
         self.original_data.reset(
-            (data.reshape(len(data), -1).astype(numpy.float32) / 127.5) - 1.0)
+            (data.astype(numpy.float32) / 127.5 - 1.0)
+            .reshape((len(data),) + self.sample_shape))
         self.original_labels.reset(labels.astype(numpy.int32))
         self.class_lengths = [0, n_valid, n_train]
         self.info("loaded real MNIST from %s (%d train / %d valid)",
@@ -95,7 +101,8 @@ class MnistLoader(FullBatchLoader):
         noise = stream.normal(0.0, 0.8, (total, 784)).astype(numpy.float32)
         data = protos[labels] + noise
         # layout [test | validation | train]
-        self.original_data.reset(data)
+        self.original_data.reset(
+            data.reshape((total,) + self.sample_shape))
         self.original_labels.reset(labels)
         self.class_lengths = [0, n_valid, n_train]
         self.info("generated synthetic MNIST-shaped data "

@@ -186,10 +186,12 @@ class Workflow(Unit):
                       unit.run_time)
         runner = getattr(self, "_fused_runner", None)
         if runner is not None:
-            step_time = runner.measure_device_step_time()
+            step_time = runner.measure_device_step_time(iters=3)
             if step_time is not None:
                 self.info("  fused train step (device)      %10.3f ms/step",
                           step_time * 1e3)
+            # release the pinned minibatch (HBM) once measured
+            runner._last_train_args = None
 
     def generate_graph(self, filename=None):
         """Render the unit graph as graphviz dot text.
