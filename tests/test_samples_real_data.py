@@ -180,6 +180,35 @@ def test_image_dir_sample_end_to_end(tmp_path):
     assert errs[-1] == 0, "image_dir sample failed to separate: %s" % errs
 
 
+def test_image_dir_ignores_imageless_subdirs(tmp_path):
+    """Empty/hidden subdirectories must not widen the softmax: the loader
+    labels only classes that contain images, and the net must agree."""
+    from veles_tpu.config import root
+    _write_image_tree(tmp_path, per_class=4)
+    (tmp_path / ".cache").mkdir()
+    (tmp_path / "empty_class").mkdir()
+    root.__dict__.pop("image_dir", None)
+    from veles_tpu.samples import image_dir
+    wf = image_dir.build(loader={"directory": str(tmp_path),
+                                 "minibatch_size": 4, "scale": (8, 8)})
+    assert wf.layers_config[-1]["output_sample_shape"] == 2
+
+
+def test_image_dir_build_accepts_generic_overrides(tmp_path):
+    """build(**overrides) must merge like every make_sample-based sample."""
+    from veles_tpu.config import root
+    _write_image_tree(tmp_path, per_class=4)
+    root.__dict__.pop("image_dir", None)
+    from veles_tpu.samples import image_dir
+    layers = [{"type": "softmax", "output_sample_shape": 2,
+               "learning_rate": 0.05}]
+    wf = image_dir.build(loader={"directory": str(tmp_path),
+                                 "minibatch_size": 4, "scale": (8, 8)},
+                         layers=layers, name="custom")
+    assert wf.name == "custom"
+    assert len(wf.layers_config) == 1
+
+
 def test_image_dir_sample_requires_directory():
     from veles_tpu.config import root
     root.__dict__.pop("image_dir", None)

@@ -38,6 +38,14 @@ def make_mesh(n_devices=None, model_parallel=1, devices=None):
     return Mesh(grid, ("data", "model"))
 
 
+def model_shard_candidates(runner, min_width=1024):
+    """Layer indices whose output width makes model-axis sharding pay
+    (e.g. AlexNet's 4096-wide FC trunk).  Narrow layers stay replicated —
+    a sharded 10-wide softmax costs more in collectives than it saves."""
+    return [i for i, entry in enumerate(runner.state)
+            if entry and entry["w"].shape[-1] >= min_width]
+
+
 class ShardedTrainer:
     """Runs a FusedRunner's steps SPMD over a mesh.
 
@@ -72,7 +80,13 @@ class ShardedTrainer:
                 shardings.append({})
                 continue
             if i in model_shard_layers:
-                w = NamedSharding(mesh, P(None, "model"))
+                # output-dimension (column/channel) sharding: dense weights
+                # are (n_in, n_out), conv weights HWIO (kh, kw, cin, cout) —
+                # the last axis is the output width either way, the split
+                # the reference could not express at all (SURVEY §2.5
+                # "beyond-parity" TP row)
+                ndim = entry["w"].ndim
+                w = NamedSharding(mesh, P(*([None] * (ndim - 1) + ["model"])))
                 b = NamedSharding(mesh, P("model"))
             else:
                 w = b = self._repl

@@ -7,16 +7,15 @@ tree and train — no dataset-specific code.  Uses
 :class:`veles_tpu.loader.image.AutoSplitImageLoader` (PIL decode, scale,
 deterministic validation split) end to end.
 
-Config (``root.image_dir``): ``loader.directory`` is required; class count
-is discovered from the subdirectories at load time, so
-``layers[-1].output_sample_shape`` must match (or use :func:`build` which
-patches it automatically).
+Config (``root.image_dir``): ``loader.directory`` is required; the softmax
+width follows the classes actually containing images, discovered at build
+time.
 """
 
 from __future__ import annotations
 
 from veles_tpu.config import root, get
-from veles_tpu.loader.image import AutoSplitImageLoader
+from veles_tpu.loader.image import AutoSplitImageLoader, scan_directory
 from veles_tpu.standard_workflow import StandardWorkflow
 
 
@@ -46,30 +45,37 @@ def default_config():
     return root.image_dir
 
 
-def _n_classes(directory):
-    import os
-    return max(2, len([d for d in os.listdir(directory)
-                       if os.path.isdir(os.path.join(directory, d))]))
-
-
-def build(fused=True, **overrides):
+def _workflow_kwargs(loader_overrides=None, decision_overrides=None):
+    """The one cfg→constructor-kwargs assembly (build and run share it,
+    mirroring make_sample; hand-rolled only because the softmax width is
+    discovered from the directory)."""
     cfg = default_config()
     loader_config = {k: get(v, v) for k, v in cfg.loader.items()}
-    loader_config.update(overrides.pop("loader", {}))
+    loader_config.update(loader_overrides or {})
     if "directory" not in loader_config:
         raise ValueError("image_dir sample needs loader.directory "
                          "(root.image_dir.loader.directory=PATH)")
     decision_config = {k: get(v, v) for k, v in cfg.decision.items()}
-    decision_config.update(overrides.pop("decision", {}))
+    decision_config.update(decision_overrides or {})
     layers = [dict(layer) for layer in get(cfg.layers, cfg.layers)]
-    # the output layer's width follows the scanned class count
-    layers[-1]["output_sample_shape"] = _n_classes(
-        loader_config["directory"])
-    return ImageDirWorkflow(
-        None, name="image_dir", loader_factory=AutoSplitImageLoader,
-        loader_config=loader_config, layers=layers,
-        decision_config=decision_config, loss_function="softmax",
-        fused=fused, **overrides)
+    # count only classes that actually CONTAIN images — the loader derives
+    # its label map the same way, so the widths always agree
+    _, names = scan_directory(loader_config["directory"])
+    layers[-1]["output_sample_shape"] = max(2, len(set(names)))
+    kwargs = dict(name="image_dir", loader_factory=AutoSplitImageLoader,
+                  loader_config=loader_config, layers=layers,
+                  decision_config=decision_config, loss_function="softmax")
+    if "snapshotter" in cfg:
+        kwargs["snapshotter_config"] = {
+            k: get(v, v) for k, v in cfg.snapshotter.items()}
+    return kwargs
+
+
+def build(fused=True, **overrides):
+    kwargs = _workflow_kwargs(overrides.pop("loader", None),
+                              overrides.pop("decision", None))
+    kwargs.update(overrides)  # layers / loss_function / name override clean
+    return ImageDirWorkflow(None, fused=fused, **kwargs)
 
 
 def train(fused=True, **overrides):
@@ -80,16 +86,5 @@ def train(fused=True, **overrides):
 
 
 def run(load, main):
-    cfg = default_config()
-    loader_config = {k: get(v, v) for k, v in cfg.loader.items()}
-    if "directory" not in loader_config:
-        raise ValueError("set root.image_dir.loader.directory=PATH")
-    layers = [dict(layer) for layer in get(cfg.layers, cfg.layers)]
-    layers[-1]["output_sample_shape"] = _n_classes(
-        loader_config["directory"])
-    load(ImageDirWorkflow, name="image_dir",
-         loader_factory=AutoSplitImageLoader, loader_config=loader_config,
-         layers=layers,
-         decision_config={k: get(v, v) for k, v in cfg.decision.items()},
-         loss_function="softmax")
+    load(ImageDirWorkflow, **_workflow_kwargs())
     main()
