@@ -248,6 +248,55 @@ def bench_config(name, wf, target_seconds, device_kind, peak_tflops,
     return rec
 
 
+# ------------------------------------------------- sgd backend (XLA/Pallas)
+def bench_sgd_backends(n=4 * 1024 * 1024, iters=20, smoke=False):
+    """XLA-vs-Pallas fused-SGD-update comparison (SURVEY §2.4 custom-kernel
+    row): per-update device time on an AlexNet-FC-sized fp32 tensor,
+    measured by in-jit repetition (K-vs-1 difference — dispatch overhead
+    cancels).  The winner keeps the default (functional._SGD_BACKEND)."""
+    import jax
+    import jax.numpy as jnp
+    from veles_tpu.ops import functional as F
+
+    if smoke:
+        n, iters = 64 * 1024, 4   # interpret-mode pallas is slow off-TPU
+    key = jax.random.PRNGKey(0)
+    p0 = jax.random.normal(key, (n,), jnp.float32)
+    v0 = jnp.zeros((n,), jnp.float32)
+    g0 = jax.random.normal(jax.random.fold_in(key, 1), (n,), jnp.float32)
+    bs = jnp.asarray(128, jnp.int32)
+    record = {"elements": n}
+    for backend in ("xla", "pallas"):
+        F.set_sgd_backend(backend)
+        try:
+            def chain(p, v, g, k):
+                def body(i, pv):
+                    return F.sgd_update(pv[0], pv[1], g, bs, 0.01, 0.9,
+                                        0.0005, 0.0, None)
+                return jax.lax.fori_loop(0, k, body, (p, v))
+
+            f1 = jax.jit(lambda p, v, g: chain(p, v, g, 1))
+            fk = jax.jit(lambda p, v, g: chain(p, v, g, 1 + iters))
+            _sync(f1(p0, v0, g0)); _sync(fk(p0, v0, g0))  # compile
+            times = []
+            for fn in (f1, fk):
+                best = float("inf")
+                for _ in range(3):
+                    begin = time.perf_counter()
+                    out = fn(p0, v0, g0)
+                    _sync(out)
+                    best = min(best, time.perf_counter() - begin)
+                times.append(best)
+            record[backend + "_us"] = round(
+                (times[1] - times[0]) / iters * 1e6, 2)
+        finally:
+            F.set_sgd_backend("xla")
+    if "xla_us" in record and "pallas_us" in record:
+        record["winner"] = ("pallas" if record["pallas_us"] <
+                            record["xla_us"] else "xla")
+    return record
+
+
 # ------------------------------------------------------------- numpy floor
 def bench_numpy_floor(wf, min_seconds=3.0):
     """The reference's numpy backend, reproduced: python minibatch loop with
@@ -296,13 +345,13 @@ def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--smoke", action="store_true",
                         help="tiny sizes on CPU for CI validation")
-    parser.add_argument("--configs", default="mnist,cifar,alexnet",
-                        help="comma list: mnist,cifar,alexnet")
+    parser.add_argument("--configs", default="mnist,cifar,alexnet,sgd",
+                        help="comma list: mnist,cifar,alexnet,sgd")
     parser.add_argument("--seconds", type=float, default=None,
                         help="target seconds per timing window")
     args = parser.parse_args()
     wanted = [c.strip() for c in args.configs.split(",") if c.strip()]
-    known = ("mnist", "cifar", "alexnet")
+    known = ("mnist", "cifar", "alexnet", "sgd")
     unknown = [c for c in wanted if c not in known]
     if unknown or not wanted:
         parser.error("unknown configs %r (choose from %s)"
@@ -353,16 +402,31 @@ def main():
         finally:
             F.set_matmul_precision("float32")
 
-    headline_name = "mnist_fc" if "mnist_fc" in results \
-        else next(iter(results))
-    headline = results[headline_name]
-    print(json.dumps({
-        "metric": "%s_train_samples_per_sec_per_chip" % headline_name,
-        "value": headline["samples_per_sec"],
-        "unit": "samples/sec",
-        "vs_baseline": headline.get("vs_numpy_floor"),
-        "configs": results,
-    }))
+    if "sgd" in wanted:
+        results["sgd_update"] = bench_sgd_backends(smoke=args.smoke)
+        print("sgd_update: %s" % results["sgd_update"], file=sys.stderr)
+
+    model_results = [k for k in results if k != "sgd_update"]
+    if model_results:
+        headline_name = ("mnist_fc" if "mnist_fc" in results
+                         else model_results[0])
+        headline = results[headline_name]
+        print(json.dumps({
+            "metric": "%s_train_samples_per_sec_per_chip" % headline_name,
+            "value": headline["samples_per_sec"],
+            "unit": "samples/sec",
+            "vs_baseline": headline.get("vs_numpy_floor"),
+            "configs": results,
+        }))
+    else:   # sgd-only invocation: the comparison IS the metric
+        rec = results["sgd_update"]
+        print(json.dumps({
+            "metric": "sgd_update_device_us",
+            "value": rec.get("xla_us"),
+            "unit": "us",
+            "vs_baseline": None,
+            "configs": results,
+        }))
     return 0
 
 

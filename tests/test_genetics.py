@@ -80,6 +80,43 @@ class TestWorkflowOptimize:
         assert 0.0005 <= value <= 0.1
 
 
+class TestPopulationParallel:
+    def test_parallel_matches_sequential(self):
+        """Individuals screened across worker subprocesses must give the
+        IDENTICAL GA trajectory as the sequential in-process path (each
+        evaluation is deterministic in (config, genes, seed) — ref:
+        SURVEY §3.5 fork-per-individual population parallelism)."""
+        from veles_tpu import prng
+        from veles_tpu.genetics import optimize_workflow
+        from veles_tpu.samples import mnist
+
+        def configure():
+            prng.reset()
+            prng.seed_all(1)
+            root.__dict__.pop("mnist", None)
+            root.mnist.update({
+                "loader": {"minibatch_size": 50, "n_train": 200,
+                           "n_valid": 100},
+                "decision": {"max_epochs": 2, "fail_iterations": 5},
+                "layers": [
+                    {"type": "all2all_tanh", "output_sample_shape": 16,
+                     "learning_rate": Tune(0.001, 0.0005, 0.1),
+                     "momentum": 0.9},
+                    {"type": "softmax", "output_sample_shape": 10,
+                     "learning_rate": 0.03, "momentum": 0.9},
+                ],
+            })
+
+        configure()
+        seq_fit, seq_genes, _ = optimize_workflow(
+            mnist, generations=2, population=3, seed=1, workers=0)
+        configure()
+        par_fit, par_genes, _ = optimize_workflow(
+            mnist, generations=2, population=3, seed=1, workers=3)
+        assert par_fit == seq_fit
+        assert par_genes == seq_genes
+
+
 class TestEnsemble:
     def test_members_and_combination(self):
         from veles_tpu import prng

@@ -190,6 +190,66 @@ class TestForgeTraversal:
             forge.load_artifact(pkg, out_dir=str(tmp_path / "out"))
 
 
+class TestForgeServer:
+    """HTTP transport over the store (ref: veles/forge_server.py [M]) —
+    upload/list/fetch against a real loopback server."""
+
+    def test_upload_list_fetch_roundtrip(self, tmp_path):
+        from veles_tpu import forge
+        from veles_tpu import forge_server
+        wf = _train_tiny_mnist(tmp_path, snapshot=True)
+        pkg = forge.pack(wf.snapshotter.destination,
+                         str(tmp_path / "m.forge.tar.gz"), name="mnist_fc",
+                         metrics={"n_err": wf.decision.best_metric})
+
+        server = forge_server.ForgeServer(str(tmp_path / "store")).start()
+        try:
+            record = forge_server.upload(pkg, server.url)
+            assert record["name"] == "mnist_fc"
+            listing = forge_server.list_remote(server.url)
+            assert len(listing) == 1
+            assert listing[0][1]["name"] == "mnist_fc"
+            manifest, snap_path = forge_server.fetch_remote(
+                server.url, "mnist_fc", str(tmp_path / "fetched"))
+            assert manifest["name"] == "mnist_fc"
+            assert os.path.exists(snap_path)
+            # the fetched snapshot restores to the published weights
+            from veles_tpu import prng, snapshotter
+            prng.reset()
+            prng.seed_all(99)
+            from veles_tpu.samples import mnist
+            wf2 = mnist.build()
+            wf2.initialize()
+            snapshotter.restore(wf2, snap_path)
+            numpy.testing.assert_allclose(
+                numpy.asarray(wf2.forwards[0].weights.mem),
+                numpy.asarray(wf.forwards[0].weights.mem), atol=1e-6)
+        finally:
+            server.stop()
+
+    def test_rejects_garbage_and_unknown(self, tmp_path):
+        import urllib.error
+        import urllib.request
+        from veles_tpu import forge_server
+        server = forge_server.ForgeServer(str(tmp_path / "store")).start()
+        try:
+            garbage = tmp_path / "garbage.bin"
+            garbage.write_bytes(b"this is not a tarball")
+            with pytest.raises(urllib.error.HTTPError) as err:
+                forge_server.upload(str(garbage), server.url)
+            assert err.value.code == 400
+            assert forge_server.list_remote(server.url) == []
+            with pytest.raises(urllib.error.HTTPError) as err:
+                forge_server.fetch_remote(server.url, "nope",
+                                          str(tmp_path / "out"))
+            assert err.value.code == 404
+            with pytest.raises(ValueError, match="unsafe package name"):
+                forge_server.fetch_remote(server.url, "../evil",
+                                          str(tmp_path / "out"))
+        finally:
+            server.stop()
+
+
 class TestPublishing:
     def test_reports(self, tmp_path):
         from veles_tpu.publishing import Publisher

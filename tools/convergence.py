@@ -1,0 +1,78 @@
+"""Convergence runs for BASELINE.md rows 0-2: train MNIST-FC and CIFAR
+to Decision-complete with pinned seeds, record final val-acc + samples/s.
+
+Usage: python tools/convergence.py [mnist] [cifar]
+Prints one summary line per config:
+  <config>: best val_err <n>/<N> (<pct>%), ..., @<git-sha>
+
+Protocol (BASELINE.md): fixed seed; train to the sample's stopping
+criterion (Decision-complete); wall time covers the whole run.
+"""
+import argparse
+import os
+import subprocess
+import time
+
+
+def git_sha():
+    try:
+        return subprocess.check_output(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))).decode().strip()
+    except Exception:
+        return "unknown"
+
+
+def run_config(name, seed=1):
+    from veles_tpu import prng
+    from veles_tpu.config import root
+    prng.reset()
+    prng.seed_all(seed)
+    if name == "mnist":
+        root.__dict__.pop("mnist", None)
+        root.mnist.update({
+            "loader": {"minibatch_size": 100, "n_train": 60000,
+                       "n_valid": 10000},
+            "decision": {"max_epochs": 25, "fail_iterations": 10},
+        })
+        from veles_tpu.samples import mnist as sample
+    elif name == "cifar":
+        root.__dict__.pop("cifar", None)
+        root.cifar.update({
+            "loader": {"minibatch_size": 100, "n_train": 50000,
+                       "n_valid": 10000},
+            "decision": {"max_epochs": 25, "fail_iterations": 10},
+        })
+        from veles_tpu.samples import cifar as sample
+    else:
+        raise SystemExit("unknown config %r" % name)
+
+    begin = time.perf_counter()
+    wf = sample.train(fused=True)
+    wall = time.perf_counter() - begin
+    hist = [m["validation"] for m in wf.decision.epoch_metrics
+            if "validation" in m]
+    best = wf.decision.best_metric
+    count = hist[-1]["count"]
+    epochs = int(wf.loader.epoch_number)
+    n_train = wf.loader.class_lengths[2]
+    sps = epochs * n_train / wall   # incl. eval epochs: LOWER bound
+    import jax
+    print("%s: best val_err %d/%d (%.2f%%), %d epochs, "
+          "%.0f samples/s overall, %.1fs wall, device=%s, seed=%d, @%s"
+          % (name, best, count, 100.0 * best / count, epochs, sps, wall,
+             jax.devices()[0].device_kind, seed, git_sha()), flush=True)
+    return wf
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("configs", nargs="*", default=["mnist", "cifar"])
+    args = parser.parse_args()
+    for name in (args.configs or ["mnist", "cifar"]):
+        run_config(name)
+
+
+if __name__ == "__main__":
+    main()

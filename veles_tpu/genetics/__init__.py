@@ -5,10 +5,12 @@ Ref: veles/genetics/ [H] (SURVEY §2.1, §3.5): config values wrapped in
 runs and selects on the Decision's best validation metric.  Driven by
 ``--optimize [generations[:population]]`` exactly like the reference.
 
-The reference forked a process per individual; here individuals run
-sequentially in-process (each run rebuilds the workflow and reseeds the PRNG
-streams, so runs are independent), which keeps the TPU attached to one
-process — the distributed path shards DATA, not individuals.
+The reference forked a process per individual (SURVEY §3.5); that
+population parallelism is available here too: ``optimize_workflow(...,
+workers=N)`` screens each generation's individuals across N CPU worker
+subprocesses (genetics/eval_worker.py) while the parent keeps the TPU —
+screen on host cores, train the winner on the accelerator.  ``workers=0``
+(default) runs individuals sequentially in-process.
 """
 
 from __future__ import annotations
@@ -150,11 +152,13 @@ class Population(Logger):
 
 
 def optimize(evaluate, generations=5, population=8, genes=None,
-             log=None):
+             log=None, batch_evaluate=None):
     """Run the GA: ``evaluate(individual_as_config_applied) -> fitness``.
 
-    ``genes`` defaults to every Tune leaf under root.  Returns
-    (best_fitness, best_gene_dict, population).
+    ``genes`` defaults to every Tune leaf under root.  When
+    ``batch_evaluate`` is given it receives the generation's UNCACHED
+    individuals as one list (population-parallel screening); ``evaluate``
+    is then unused.  Returns (best_fitness, best_gene_dict, population).
     """
     genes = genes if genes is not None else find_tunes()
     if not genes:
@@ -165,13 +169,18 @@ def optimize(evaluate, generations=5, population=8, genes=None,
     # elites reuse their cached fitness instead of re-training
     fitness_cache = {}
     for gen in range(generations):
-        pop.fitnesses = []
-        for individual in pop.individuals:
-            key = tuple(individual)
-            if key not in fitness_cache:
+        fresh = [ind for ind in pop.individuals
+                 if tuple(ind) not in fitness_cache]
+        if batch_evaluate is not None:
+            for ind, fit in zip(fresh, batch_evaluate(fresh) if fresh
+                                else []):
+                fitness_cache[tuple(ind)] = fit
+        else:
+            for individual in fresh:
                 pop.apply(individual)
-                fitness_cache[key] = evaluate(individual)
-            pop.fitnesses.append(fitness_cache[key])
+                fitness_cache[tuple(individual)] = evaluate(individual)
+        pop.fitnesses = [fitness_cache[tuple(ind)]
+                         for ind in pop.individuals]
         best = pop.evolve()
         if log:
             log("generation %d: best fitness %.6g (%s)" %
@@ -185,16 +194,106 @@ def optimize(evaluate, generations=5, population=8, genes=None,
                       zip(genes, best_genes)}, pop
 
 
+def _plain(value):
+    """Deep-convert a config value to JSON-serializable plain data (Tune
+    leaves collapse to their current value — the gene assignment overrides
+    them in the worker anyway)."""
+    if isinstance(value, Tune):
+        return _plain(value.value)
+    if isinstance(value, dict):
+        return {k: _plain(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [_plain(v) for v in value]
+    if hasattr(value, "item") and getattr(value, "ndim", None) == 0:
+        return value.item()     # numpy scalar
+    return value
+
+
+def evaluate_population(module_name, genes, individuals, seed,
+                        workers, build_kwargs=None):
+    """Fitnesses of ``individuals``, evaluated across ``workers`` CPU
+    subprocesses (the reference's fork-per-individual, SURVEY §3.5).
+
+    Each worker receives the FULL current config tree plus its gene
+    values, so it reproduces exactly what the in-process evaluation would
+    have trained.  Results arrive in individual order.
+    """
+    import json
+    import os
+    import subprocess
+    import sys
+    import tempfile
+
+    config_snapshot = _plain(root.as_dict())
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)   # workers never claim the TPU
+    pending = list(enumerate(individuals))
+    fitnesses = [None] * len(individuals)
+    running = []   # (index, Popen, stderr_file)
+
+    def launch(index, individual):
+        spec = {
+            "config": config_snapshot,
+            "genes": {path: value for (path, _), value in
+                      zip(genes, individual)},
+            "module": module_name, "seed": seed,
+            "build_kwargs": build_kwargs,
+        }
+        # stderr goes to a FILE, not a pipe: a training worker logs far
+        # more than a pipe buffer holds, and the parent may be blocked on
+        # a DIFFERENT worker when this one fills up — a pipe would
+        # deadlock the whole generation
+        err_file = tempfile.TemporaryFile()
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "veles_tpu.genetics.eval_worker"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=err_file, env=env)
+        proc.stdin.write(json.dumps(spec).encode())
+        proc.stdin.close()
+        running.append((index, proc, err_file))
+
+    def reap(index, proc, err_file):
+        out = proc.stdout.read().decode()  # fitness JSON only: tiny
+        with err_file:
+            if proc.wait() != 0:
+                err_file.seek(0)
+                err = err_file.read().decode(errors="replace")
+                raise RuntimeError("genetics worker %d failed:\n%s"
+                                   % (index, err[-2000:]))
+        fitness = json.loads(out.strip().splitlines()[-1])["fitness"]
+        fitnesses[index] = (float("inf") if fitness is None
+                            else float(fitness))
+
+    while pending or running:
+        while pending and len(running) < workers:
+            launch(*pending.pop(0))
+        reap(*running.pop(0))
+    return fitnesses
+
+
 def optimize_workflow(module, generations=5, population=8, seed=1,
-                      build_kwargs=None):
+                      build_kwargs=None, workers=0):
     """GA over a sample module exposing ``run(load, main)``.
 
     Fitness = the Decision's best validation metric of a full (short) run.
     Each evaluation reseeds every PRNG stream so individuals differ only by
-    their genes.
+    their genes.  ``workers > 0`` screens each generation's individuals
+    across that many CPU subprocesses (requires ``module`` to be
+    importable by name).  Runs are deterministic in (config, genes, seed,
+    platform); parallel and sequential screening agree exactly when both
+    evaluate on the same platform — workers always run on CPU, so on a
+    TPU-attached parent the intended split is: screen the population on
+    host cores, then train the winner (left in the config tree) on the
+    accelerator.
     """
     logger = Logger()
     genes = find_tunes()
+
+    batch_evaluate = None
+    if workers > 0:
+        def batch_evaluate(fresh):
+            return evaluate_population(module.__name__, genes, fresh,
+                                       seed, workers, build_kwargs)
 
     def evaluate(individual):
         from veles_tpu.samples import run_sample
@@ -203,19 +302,23 @@ def optimize_workflow(module, generations=5, population=8, seed=1,
         return float("inf") if metric is None else float(metric)
 
     return optimize(evaluate, generations=generations, population=population,
-                    genes=genes, log=logger.info)
+                    genes=genes, log=logger.info,
+                    batch_evaluate=batch_evaluate)
 
 
 def optimize_cli(module, args):
-    """--optimize entry point (ref: Main --optimize [H])."""
-    spec = str(args.optimize)
-    if ":" in spec:
-        generations, population = (int(x) for x in spec.split(":"))
-    else:
-        generations, population = int(spec), 8
+    """--optimize entry point (ref: Main --optimize [H]).
+
+    Spec: ``<generations>[:<population>[:<workers>]]`` — workers > 0
+    screens individuals across that many CPU subprocesses.
+    """
+    parts = [int(x) for x in str(args.optimize).split(":")]
+    generations = parts[0]
+    population = parts[1] if len(parts) > 1 else 8
+    workers = parts[2] if len(parts) > 2 else 0
     best_fit, best_genes, _ = optimize_workflow(
         module, generations=generations, population=population,
-        seed=args.random_seed or 1)
+        seed=args.random_seed or 1, workers=workers)
     print("best fitness: %s" % best_fit)
     for path, value in best_genes.items():
         print("  %s = %s" % (path, value))

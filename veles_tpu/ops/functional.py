@@ -555,6 +555,21 @@ def rbm_cd_step(weights, vbias, hbias, v0, mask, rng, learning_rate,
 
 
 # ------------------------------------------------------------------- updates
+#: "xla" (default) or "pallas" — routes sgd_update through the fused Pallas
+#: kernel (ops/pallas_kernels.py).  Benchmarked against each other on TPU by
+#: bench.py's sgd_update record; the default stays whichever wins there.
+_SGD_BACKEND = "xla"
+
+
+def set_sgd_backend(mode):
+    """mode: 'xla' | 'pallas'.  Clears jit caches (trace-time flag)."""
+    global _SGD_BACKEND
+    if mode not in ("xla", "pallas"):
+        raise ValueError("unknown sgd backend %r" % (mode,))
+    _SGD_BACKEND = mode
+    jax.clear_caches()
+
+
 def sgd_update(param, velocity, grad, batch_size, learning_rate, momentum,
                weight_decay, l1_vs_l2, gradient_clip):
     """Momentum-SGD with mixed L1/L2 decay and optional clipping.
@@ -564,6 +579,12 @@ def sgd_update(param, velocity, grad, batch_size, learning_rate, momentum,
     nn_units.py::GradientDescentBase [H]).  Gradients arrive as batch SUMS
     and are normalized by the live batch size here.
     """
+    if (_SGD_BACKEND == "pallas"
+            and not gradient_clip):   # the kernel has no clipping path
+        from veles_tpu.ops.pallas_kernels import fused_sgd_update
+        return fused_sgd_update(param, velocity, grad, batch_size,
+                                learning_rate, momentum, weight_decay,
+                                l1_vs_l2)
     g = grad / jnp.maximum(batch_size, 1).astype(grad.dtype)
     if gradient_clip is not None and gradient_clip > 0.0:
         g = jnp.clip(g, -gradient_clip, gradient_clip)
