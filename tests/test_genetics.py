@@ -117,6 +117,17 @@ class TestPopulationParallel:
         assert par_genes == seq_genes
 
 
+    def test_worker_failure_raises_with_stderr(self):
+        """A crashing worker surfaces its stderr; siblings are cleaned up."""
+        import pytest
+        from veles_tpu.config import Tune
+        from veles_tpu.genetics import evaluate_population
+        genes = [("root.ga_fail.x", Tune(0.5, 0.0, 1.0))]
+        with pytest.raises(RuntimeError, match="genetics worker"):
+            evaluate_population("veles_tpu.samples.no_such_module", genes,
+                                [[0.5], [0.6]], seed=1, workers=2)
+
+
 class TestEnsemble:
     def test_members_and_combination(self):
         from veles_tpu import prng

@@ -91,12 +91,18 @@ def unpack(package_path, out_dir):
 
 
 def publish(package_path, store_dir):
-    """Upload to the store (versioned by name + timestamp)."""
+    """Upload to the store (versioned by name + timestamp).
+
+    Atomic: staged under a non-package suffix, then renamed — concurrent
+    ``list_store`` readers (e.g. forge_server /list) never see a
+    half-copied package."""
     manifest = read_manifest(package_path)
     os.makedirs(store_dir, exist_ok=True)
     dest = os.path.join(store_dir, "%s_%d.forge.tar.gz"
                         % (manifest["name"], int(manifest["packaged_at"])))
-    shutil.copyfile(package_path, dest)
+    staging = dest + ".publish.tmp"
+    shutil.copyfile(package_path, staging)
+    os.replace(staging, dest)
     return dest
 
 

@@ -169,8 +169,12 @@ def optimize(evaluate, generations=5, population=8, genes=None,
     # elites reuse their cached fitness instead of re-training
     fitness_cache = {}
     for gen in range(generations):
-        fresh = [ind for ind in pop.individuals
-                 if tuple(ind) not in fitness_cache]
+        fresh, seen = [], set()
+        for ind in pop.individuals:       # dedupe: identical individuals
+            key = tuple(ind)              # (converged populations, twin
+            if key not in fitness_cache and key not in seen:
+                fresh.append(ind)         # crossover children) train once
+                seen.add(key)
         if batch_evaluate is not None:
             for ind, fit in zip(fresh, batch_evaluate(fresh) if fresh
                                 else []):
@@ -248,8 +252,11 @@ def evaluate_population(module_name, genes, individuals, seed,
             [sys.executable, "-m", "veles_tpu.genetics.eval_worker"],
             stdin=subprocess.PIPE, stdout=subprocess.PIPE,
             stderr=err_file, env=env)
-        proc.stdin.write(json.dumps(spec).encode())
-        proc.stdin.close()
+        try:
+            proc.stdin.write(json.dumps(spec).encode())
+            proc.stdin.close()
+        except BrokenPipeError:
+            pass   # worker died before reading the spec; reap() reports it
         running.append((index, proc, err_file))
 
     def reap(index, proc, err_file):
@@ -264,10 +271,27 @@ def evaluate_population(module_name, genes, individuals, seed,
         fitnesses[index] = (float("inf") if fitness is None
                             else float(fitness))
 
-    while pending or running:
-        while pending and len(running) < workers:
-            launch(*pending.pop(0))
-        reap(*running.pop(0))
+    import time as _time
+    try:
+        while pending or running:
+            while pending and len(running) < workers:
+                launch(*pending.pop(0))
+            # reap ANY finished worker (not FIFO): a slow individual must
+            # not hold finished slots hostage and serialize the generation
+            done = next((entry for entry in running
+                         if entry[1].poll() is not None), None)
+            if done is None:
+                if len(running) < workers and pending:
+                    continue
+                _time.sleep(0.05)
+                continue
+            running.remove(done)
+            reap(*done)
+    finally:
+        for _, proc, err_file in running:   # error path: no orphans
+            proc.kill()
+            proc.wait()
+            err_file.close()
     return fitnesses
 
 
