@@ -432,32 +432,39 @@ def main():
         results["mnist_fc"]["vs_numpy_floor"] = round(
             results["mnist_fc"]["samples_per_sec"] / floor, 2)
 
+    def bench_bf16_variant(name, build_fn):
+        """The TPU-idiomatic fast path: bf16 operand casts inside the
+        step, then restore parity precision."""
+        from veles_tpu.ops import functional as F
+        F.set_matmul_precision("bfloat16")
+        try:
+            results[name] = bench_config(
+                name, build_fn(), target, device_kind, peak, "bf16_cast")
+        finally:
+            F.set_matmul_precision("float32")
+
     if "cifar" in wanted:
         wf = build_cifar(*sizes["cifar"])
         results["cifar_conv"] = bench_config(
             "cifar_conv", wf, target, device_kind, peak, "fp32_highest")
+        bench_bf16_variant("cifar_conv_bf16",
+                           lambda: build_cifar(*sizes["cifar"]))
 
     if "alexnet" in wanted:
         wf = build_alexnet(*sizes["alexnet"], **alex_kwargs)
         results["alexnet"] = bench_config(
             "alexnet", wf, target, device_kind, peak, "fp32_highest")
-        # the TPU-idiomatic fast path: bf16 operand casts inside the step
-        from veles_tpu.ops import functional as F
-        F.set_matmul_precision("bfloat16")
-        try:
-            wf_bf16 = build_alexnet(*sizes["alexnet"], **alex_kwargs)
-            results["alexnet_bf16"] = bench_config(
-                "alexnet_bf16", wf_bf16, target, device_kind, peak,
-                "bf16_cast")
-        finally:
-            F.set_matmul_precision("float32")
+        bench_bf16_variant(
+            "alexnet_bf16",
+            lambda: build_alexnet(*sizes["alexnet"], **alex_kwargs))
 
     if "sgd" in wanted:
         results["sgd_update"] = bench_sgd_backends(smoke=args.smoke)
         print("sgd_update: %s" % results["sgd_update"], file=sys.stderr)
 
     if "records" in wanted:
-        results["records_pipeline"] = bench_records(smoke=args.smoke)
+        results["records_pipeline"] = bench_records(
+            smoke=args.smoke, seconds=min(target, 4.0))
         print("records_pipeline: %s" % results["records_pipeline"],
               file=sys.stderr)
 

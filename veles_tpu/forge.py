@@ -101,8 +101,13 @@ def publish(package_path, store_dir):
     dest = os.path.join(store_dir, "%s_%d.forge.tar.gz"
                         % (manifest["name"], int(manifest["packaged_at"])))
     staging = dest + ".publish.tmp"
-    shutil.copyfile(package_path, staging)
-    os.replace(staging, dest)
+    try:
+        shutil.copyfile(package_path, staging)
+        os.replace(staging, dest)
+    except BaseException:
+        if os.path.exists(staging):
+            os.unlink(staging)
+        raise
     return dest
 
 

@@ -281,8 +281,6 @@ def evaluate_population(module_name, genes, individuals, seed,
             done = next((entry for entry in running
                          if entry[1].poll() is not None), None)
             if done is None:
-                if len(running) < workers and pending:
-                    continue
                 _time.sleep(0.05)
                 continue
             running.remove(done)
