@@ -123,7 +123,7 @@ class TestPopulationParallel:
         from veles_tpu.config import Tune
         from veles_tpu.genetics import evaluate_population
         genes = [("root.ga_fail.x", Tune(0.5, 0.0, 1.0))]
-        with pytest.raises(RuntimeError, match="genetics worker"):
+        with pytest.raises(RuntimeError, match="worker"):
             evaluate_population("veles_tpu.samples.no_such_module", genes,
                                 [[0.5], [0.6]], seed=1, workers=2)
 
@@ -166,3 +166,41 @@ class TestEnsemble:
                 d0, numpy.asarray(wf.loader.original_data.mem))
         # and no member predicts at chance on the shared validation set
         assert max(combined["members"]) < 50
+
+    def test_parallel_members_match_sequential(self):
+        """Members trained in worker subprocesses and restored from their
+        snapshots must equal in-process members exactly (same platform) —
+        the reference's members-across-slaves parallelism (SURVEY §3.5)."""
+        from veles_tpu import prng
+        from veles_tpu.ensemble import train_ensemble
+        from veles_tpu.samples import mnist
+
+        def configure():
+            prng.reset()
+            prng.seed_all(1)
+            root.__dict__.pop("mnist", None)
+            root.mnist.update({
+                "loader": {"minibatch_size": 50, "n_train": 200,
+                           "n_valid": 100},
+                "decision": {"max_epochs": 2, "fail_iterations": 5},
+                "layers": [
+                    {"type": "all2all_tanh", "output_sample_shape": 16,
+                     "learning_rate": 0.03, "momentum": 0.9},
+                    {"type": "softmax", "output_sample_shape": 10,
+                     "learning_rate": 0.03, "momentum": 0.9},
+                ],
+            })
+
+        configure()
+        seq_trainer, seq_combined = train_ensemble(mnist, size=2,
+                                                   base_seed=5)
+        configure()
+        par_trainer, par_combined = train_ensemble(mnist, size=2,
+                                                   base_seed=5, workers=2)
+        assert par_combined == seq_combined
+        for (_, seq_wf, seq_sum), (_, par_wf, par_sum) in zip(
+                seq_trainer.members, par_trainer.members):
+            assert par_sum == seq_sum
+            numpy.testing.assert_array_equal(
+                numpy.asarray(seq_wf.forwards[0].weights.mem),
+                numpy.asarray(par_wf.forwards[0].weights.mem))

@@ -2,9 +2,15 @@
 
 Ref: veles/ensemble/ [M] (SURVEY §2.1): train N instances of a workflow
 (seed variations), collect per-model results, then evaluate the combined
-model.  TPU-native: members train sequentially in-process (one TPU
-attachment); combination averages the members' softmax outputs over the
+model.  Combination averages the members' softmax outputs over the
 validation set with one jitted eval per member.
+
+``train(workers=N)`` trains members across N CPU worker subprocesses
+(the reference evaluated members across slaves, SURVEY §3.5): each worker
+trains one member and ships its full snapshot back; the parent restores
+them, so parallel members are indistinguishable from sequential ones
+trained on the same platform.  ``workers=0`` (default) trains members
+sequentially in-process (on the parent's accelerator).
 """
 
 from __future__ import annotations
@@ -26,7 +32,9 @@ class EnsembleTrainer(Logger):
         self.build_kwargs = dict(build_kwargs or {})
         self.members = []       # (seed, workflow, summary)
 
-    def train(self):
+    def train(self, workers=0):
+        if workers > 0:
+            return self._train_parallel(workers)
         from veles_tpu.samples import run_sample
         for i in range(self.size):
             seed = self.base_seed + i
@@ -38,6 +46,61 @@ class EnsembleTrainer(Logger):
             self.members.append((seed, wf, summary))
             self.info("member %d/%d (seed %d): best %s", i + 1, self.size,
                       seed, summary["best_metric"])
+        return self
+
+    def _build_member(self, seed):
+        """Build + initialize (but do not train) one member workflow —
+        the restore target for a worker-trained snapshot."""
+        from veles_tpu import prng
+        prng.reset()
+        prng.seed_all(seed)
+        holder = {}
+
+        def load(workflow_cls, **kwargs):
+            kwargs.update(self.build_kwargs)
+            wf = workflow_cls(None, **kwargs)
+            holder["wf"] = wf
+            return wf
+
+        def main():
+            holder["wf"].initialize()
+
+        self.module.run(load, main)
+        return holder["wf"]
+
+    def _train_parallel(self, workers):
+        import os
+        import pickle
+        import tempfile
+
+        from veles_tpu import snapshotter
+        from veles_tpu.config import root
+        from veles_tpu.subproc import plain_config, run_workers
+
+        config_snapshot = plain_config(root.as_dict())
+        with tempfile.TemporaryDirectory(prefix="ensemble_") as tmp:
+            seeds = [self.base_seed + i for i in range(self.size)]
+            specs = [{
+                "config": config_snapshot,
+                "module": self.module.__name__,
+                "seed": seed,
+                "build_kwargs": self.build_kwargs,
+                "snapshot_path": os.path.join(tmp, "member_%d.pickle"
+                                              % seed),
+            } for seed in seeds]
+            summaries = run_workers("veles_tpu.ensemble.train_worker",
+                                    specs, workers)
+            for seed, spec, summary in zip(seeds, specs, summaries):
+                with open(spec["snapshot_path"], "rb") as f:
+                    payload = pickle.load(f)
+                wf = self._build_member(seed)
+                snapshotter.restore(wf, payload)
+                self.members.append((seed, wf, {
+                    "seed": seed,
+                    "best_metric": summary["best_metric"],
+                    "best_epoch": summary["best_epoch"]}))
+                self.info("member (seed %d): best %s [worker]", seed,
+                          summary["best_metric"])
         return self
 
     # -- combined evaluation -------------------------------------------------
@@ -75,8 +138,10 @@ class EnsembleTrainer(Logger):
                 "count": len(labels)}
 
 
-def train_ensemble(module, size=4, base_seed=1, build_kwargs=None):
+def train_ensemble(module, size=4, base_seed=1, build_kwargs=None,
+                   workers=0):
     """One-call convenience: train + combined evaluation."""
     trainer = EnsembleTrainer(module, size=size, base_seed=base_seed,
-                              build_kwargs=build_kwargs).train()
+                              build_kwargs=build_kwargs).train(
+                                  workers=workers)
     return trainer, trainer.evaluate_combined()

@@ -198,21 +198,6 @@ def optimize(evaluate, generations=5, population=8, genes=None,
                       zip(genes, best_genes)}, pop
 
 
-def _plain(value):
-    """Deep-convert a config value to JSON-serializable plain data (Tune
-    leaves collapse to their current value — the gene assignment overrides
-    them in the worker anyway)."""
-    if isinstance(value, Tune):
-        return _plain(value.value)
-    if isinstance(value, dict):
-        return {k: _plain(v) for k, v in value.items()}
-    if isinstance(value, (list, tuple)):
-        return [_plain(v) for v in value]
-    if hasattr(value, "item") and getattr(value, "ndim", None) == 0:
-        return value.item()     # numpy scalar
-    return value
-
-
 def evaluate_population(module_name, genes, individuals, seed,
                         workers, build_kwargs=None):
     """Fitnesses of ``individuals``, evaluated across ``workers`` CPU
@@ -222,75 +207,19 @@ def evaluate_population(module_name, genes, individuals, seed,
     values, so it reproduces exactly what the in-process evaluation would
     have trained.  Results arrive in individual order.
     """
-    import json
-    import os
-    import subprocess
-    import sys
-    import tempfile
+    from veles_tpu.subproc import plain_config, run_workers
 
-    config_snapshot = _plain(root.as_dict())
-    env = dict(os.environ, JAX_PLATFORMS="cpu")
-    env.pop("PALLAS_AXON_POOL_IPS", None)   # workers never claim the TPU
-    pending = list(enumerate(individuals))
-    fitnesses = [None] * len(individuals)
-    running = []   # (index, Popen, stderr_file)
-
-    def launch(index, individual):
-        spec = {
-            "config": config_snapshot,
-            "genes": {path: value for (path, _), value in
-                      zip(genes, individual)},
-            "module": module_name, "seed": seed,
-            "build_kwargs": build_kwargs,
-        }
-        # stderr goes to a FILE, not a pipe: a training worker logs far
-        # more than a pipe buffer holds, and the parent may be blocked on
-        # a DIFFERENT worker when this one fills up — a pipe would
-        # deadlock the whole generation
-        err_file = tempfile.TemporaryFile()
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "veles_tpu.genetics.eval_worker"],
-            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
-            stderr=err_file, env=env)
-        try:
-            proc.stdin.write(json.dumps(spec).encode())
-            proc.stdin.close()
-        except BrokenPipeError:
-            pass   # worker died before reading the spec; reap() reports it
-        running.append((index, proc, err_file))
-
-    def reap(index, proc, err_file):
-        out = proc.stdout.read().decode()  # fitness JSON only: tiny
-        with err_file:
-            if proc.wait() != 0:
-                err_file.seek(0)
-                err = err_file.read().decode(errors="replace")
-                raise RuntimeError("genetics worker %d failed:\n%s"
-                                   % (index, err[-2000:]))
-        fitness = json.loads(out.strip().splitlines()[-1])["fitness"]
-        fitnesses[index] = (float("inf") if fitness is None
-                            else float(fitness))
-
-    import time as _time
-    try:
-        while pending or running:
-            while pending and len(running) < workers:
-                launch(*pending.pop(0))
-            # reap ANY finished worker (not FIFO): a slow individual must
-            # not hold finished slots hostage and serialize the generation
-            done = next((entry for entry in running
-                         if entry[1].poll() is not None), None)
-            if done is None:
-                _time.sleep(0.05)
-                continue
-            running.remove(done)
-            reap(*done)
-    finally:
-        for _, proc, err_file in running:   # error path: no orphans
-            proc.kill()
-            proc.wait()
-            err_file.close()
-    return fitnesses
+    config_snapshot = plain_config(root.as_dict())
+    specs = [{
+        "config": config_snapshot,
+        "genes": {path: value for (path, _), value in
+                  zip(genes, individual)},
+        "module": module_name, "seed": seed,
+        "build_kwargs": build_kwargs,
+    } for individual in individuals]
+    results = run_workers("veles_tpu.genetics.eval_worker", specs, workers)
+    return [float("inf") if r["fitness"] is None else float(r["fitness"])
+            for r in results]
 
 
 def optimize_workflow(module, generations=5, population=8, seed=1,
