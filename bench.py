@@ -162,13 +162,16 @@ def model_train_flops_per_sample(runner):
 
 
 # ------------------------------------------------------------------ timing
-def epoch_plan_arrays(loader):
-    """Train-portion (idx, mask) matrices for the epoch-scan fast path."""
+def epoch_plan_arrays(loader, wanted_cls=None):
+    """(idx, mask) matrices of one set for the epoch-scan fast path
+    (train by default; pass loader.base.VALID for the validation set)."""
     from veles_tpu.loader.base import TRAIN
+    if wanted_cls is None:
+        wanted_cls = TRAIN
     loader._plan_epoch()
     idx, mask = [], []
     for cls, chunk, actual in loader._order:
-        if cls != TRAIN:
+        if cls != wanted_cls:
             continue
         idx.append(chunk)
         m = numpy.zeros(len(chunk), numpy.float32)
@@ -246,6 +249,63 @@ def bench_config(name, wf, target_seconds, device_kind, peak_tflops,
           % (name, sps, step_us, achieved,
              rec["mfu_pct_of_bf16_peak"]), file=sys.stderr)
     return rec
+
+
+# ------------------------------------------------------------- convergence
+def bench_convergence(build_fn, max_epochs=15, patience=5):
+    """Train to the stopping criterion (no val improvement for ``patience``
+    epochs) via the epoch-scan path and record final val-acc — the
+    convergence half of the BASELINE acceptance (val-acc at throughput),
+    which throughput-only benches never measured (VERDICT r3 Missing #2).
+
+    Runs the SAME pure step functions the Decision-driven graph runs
+    (compiled.py composes one set of fns for both), with a fresh shuffle
+    per epoch, seed pinned by build_fn.
+    """
+    import jax
+    from veles_tpu import prng
+    from veles_tpu.loader.base import VALID
+
+    wf = build_fn()
+    runner = wf._fused_runner
+    train_epoch, eval_epoch = runner.epoch_fns()
+    loader = wf.loader
+    data = loader.original_data.devmem
+    labels = loader.original_labels.devmem
+    vidx, vmask = epoch_plan_arrays(loader, wanted_cls=VALID)
+    n_valid = int(vmask.sum())
+    rng = prng.get("dropout").key() if runner._has_stochastic else None
+
+    state = runner.state
+    best, best_epoch, since = None, 0, 0
+    begin = time.perf_counter()
+    steps_per_epoch = None
+    for epoch in range(max_epochs):
+        idx, mask = epoch_plan_arrays(loader)   # fresh shuffle per epoch
+        steps_per_epoch = idx.shape[0]
+        epoch_rng = (jax.random.fold_in(rng, epoch)
+                     if rng is not None else None)
+        state, _ = train_epoch(state, data, labels, idx, mask,
+                               rng=epoch_rng,
+                               step0=epoch * steps_per_epoch)
+        totals = eval_epoch(state, data, labels, vidx, vmask)
+        n_err = int(numpy.asarray(totals["n_err"]))   # sync point
+        if best is None or n_err < best:
+            best, best_epoch, since = n_err, epoch + 1, 0
+        else:
+            since += 1
+        if since >= patience:
+            break
+    wall = time.perf_counter() - begin
+    runner.state = state
+    return {
+        "best_val_err": best,
+        "val_count": n_valid,
+        "best_val_err_pct": round(100.0 * best / max(n_valid, 1), 2),
+        "best_epoch": best_epoch,
+        "epochs_run": epoch + 1,
+        "wall_s": round(wall, 1),
+    }
 
 
 # ------------------------------------------------- sgd backend (XLA/Pallas)
@@ -395,13 +455,14 @@ def main():
     parser.add_argument("--smoke", action="store_true",
                         help="tiny sizes on CPU for CI validation")
     parser.add_argument("--configs",
-                        default="mnist,cifar,alexnet,sgd,records",
-                        help="comma list: mnist,cifar,alexnet,sgd,records")
+                        default="mnist,cifar,alexnet,sgd,records,convergence",
+                        help="comma list: mnist,cifar,alexnet,sgd,records,convergence")
     parser.add_argument("--seconds", type=float, default=None,
                         help="target seconds per timing window")
     args = parser.parse_args()
     wanted = [c.strip() for c in args.configs.split(",") if c.strip()]
-    known = ("mnist", "cifar", "alexnet", "sgd", "records")
+    known = ("mnist", "cifar", "alexnet", "sgd", "records",
+             "convergence")
     unknown = [c for c in wanted if c not in known]
     if unknown or not wanted:
         parser.error("unknown configs %r (choose from %s)"
@@ -423,7 +484,16 @@ def main():
     device_kind, peak = _peak_tflops()
     results = {}
 
-    if "mnist" in wanted:
+    def guarded(section, fn):
+        """One config blowing up must not zero the whole bench record."""
+        import traceback
+        try:
+            fn()
+        except Exception:
+            traceback.print_exc()
+            results[section + "_error"] = traceback.format_exc()[-800:]
+
+    def _bench_mnist():
         wf = build_mnist(*sizes["mnist"])
         results["mnist_fc"] = bench_config(
             "mnist_fc", wf, target, device_kind, peak, "fp32_highest")
@@ -431,6 +501,9 @@ def main():
         results["mnist_fc"]["numpy_floor_samples_per_sec"] = round(floor, 1)
         results["mnist_fc"]["vs_numpy_floor"] = round(
             results["mnist_fc"]["samples_per_sec"] / floor, 2)
+
+    if "mnist" in wanted:
+        guarded("mnist", _bench_mnist)
 
     def bench_bf16_variant(name, build_fn):
         """The TPU-idiomatic fast path: bf16 operand casts inside the
@@ -443,14 +516,17 @@ def main():
         finally:
             F.set_matmul_precision("float32")
 
-    if "cifar" in wanted:
+    def _bench_cifar():
         wf = build_cifar(*sizes["cifar"])
         results["cifar_conv"] = bench_config(
             "cifar_conv", wf, target, device_kind, peak, "fp32_highest")
         bench_bf16_variant("cifar_conv_bf16",
                            lambda: build_cifar(*sizes["cifar"]))
 
-    if "alexnet" in wanted:
+    if "cifar" in wanted:
+        guarded("cifar", _bench_cifar)
+
+    def _bench_alexnet():
         wf = build_alexnet(*sizes["alexnet"], **alex_kwargs)
         results["alexnet"] = bench_config(
             "alexnet", wf, target, device_kind, peak, "fp32_highest")
@@ -458,18 +534,53 @@ def main():
             "alexnet_bf16",
             lambda: build_alexnet(*sizes["alexnet"], **alex_kwargs))
 
-    if "sgd" in wanted:
+    if "alexnet" in wanted:
+        guarded("alexnet", _bench_alexnet)
+
+    if "convergence" in wanted:
+        # small-but-real convergence runs (val-acc is the OTHER half of the
+        # BASELINE acceptance); sizes keep the wall time in minutes on TPU
+        # (and seconds in --smoke: fp32-HIGHEST convs on CPU are SLOW)
+        if args.smoke:
+            conv_sizes = {"mnist": (2000, 500, 100),
+                          "cifar": (200, 100, 50)}
+            conv_epochs = {"mnist": (8, 4), "cifar": (4, 2)}
+        else:
+            conv_sizes = {"mnist": (60000, 10000, 100),
+                          "cifar": (10000, 2000, 100)}
+            conv_epochs = {"mnist": (15, 5), "cifar": (15, 5)}
+        for name, build_fn in (
+                ("mnist_fc", lambda: build_mnist(*conv_sizes["mnist"])),
+                ("cifar_conv", lambda: build_cifar(*conv_sizes["cifar"]))):
+            def _bench_conv(name=name, build_fn=build_fn):
+                epochs, patience = conv_epochs[name.split("_")[0]]
+                results["convergence_" + name] = bench_convergence(
+                    build_fn, max_epochs=epochs, patience=patience)
+                print("convergence %s: %s"
+                      % (name, results["convergence_" + name]),
+                      file=sys.stderr)
+            guarded("convergence_" + name, _bench_conv)
+
+    def _bench_sgd():
         results["sgd_update"] = bench_sgd_backends(smoke=args.smoke)
         print("sgd_update: %s" % results["sgd_update"], file=sys.stderr)
 
-    if "records" in wanted:
+    if "sgd" in wanted:
+        guarded("sgd", _bench_sgd)
+
+    def _bench_recs():
         results["records_pipeline"] = bench_records(
             smoke=args.smoke, seconds=min(target, 4.0))
         print("records_pipeline: %s" % results["records_pipeline"],
               file=sys.stderr)
 
+    if "records" in wanted:
+        guarded("records", _bench_recs)
+
     model_results = [k for k in results
-                     if k not in ("sgd_update", "records_pipeline")]
+                     if isinstance(results[k], dict)
+                     and "samples_per_sec" in results[k]
+                     and k != "records_pipeline"]  # host-side, not a model
     if model_results:
         headline_name = ("mnist_fc" if "mnist_fc" in results
                          else model_results[0])
@@ -489,7 +600,7 @@ def main():
             "vs_baseline": None,
             "configs": results,
         }))
-    else:
+    elif "records_pipeline" in results:
         print(json.dumps({
             "metric": "records_pipeline_samples_per_sec",
             "value": results["records_pipeline"]["samples_per_sec"],
@@ -497,6 +608,27 @@ def main():
             "vs_baseline": None,
             "configs": results,
         }))
+    elif any(k.startswith("convergence_") and isinstance(results[k], dict)
+             for k in results):   # convergence-only invocation
+        key = next(k for k in ("convergence_mnist_fc",
+                               "convergence_cifar_conv")
+                   if isinstance(results.get(k), dict))
+        print(json.dumps({
+            "metric": key + "_best_val_err_pct",
+            "value": results[key]["best_val_err_pct"],
+            "unit": "percent",
+            "vs_baseline": None,
+            "configs": results,
+        }))
+    else:   # everything failed: still emit the one JSON line with errors
+        print(json.dumps({
+            "metric": "bench_failed",
+            "value": None,
+            "unit": "",
+            "vs_baseline": None,
+            "configs": results,
+        }))
+        return 1
     return 0
 
 
