@@ -64,39 +64,64 @@ def _layernorm(x, g, b, eps=1e-5):
     return (x - mean) / jnp.sqrt(var + eps) * g + b
 
 
-def transformer_forward(params, tokens, n_heads, block_size=None,
-                        attn_fn=None):
-    """Logits (batch, seq, vocab); ``attn_fn(q_input)`` optionally replaces
-    the attention call (ring attention injection point)."""
+def block_forward(blk, h, n_heads, block_size=None, attn_fn=None):
+    """One decoder block (pre-LN attention + FFN with residuals) — shared
+    by the sequential forward and the pipeline-parallel stage runner
+    (veles_tpu.parallel.pipeline)."""
     import jax.numpy as jnp
-    b, s = tokens.shape
-    h = jnp.take(params["embed"], tokens, axis=0) + params["pos"][:s]
-    for blk in params["blocks"]:
-        hn = _layernorm(h, blk["ln1"]["g"], blk["ln1"]["b"])
-        if attn_fn is not None:
-            h = h + attn_fn(blk["attn"], hn)
-        else:
-            h = h + mha_forward(blk["attn"], hn, n_heads, causal=True,
-                                block_size=block_size)
-        hn = _layernorm(h, blk["ln2"]["g"], blk["ln2"]["b"])
-        ff = jnp.maximum(F.matmul(hn, blk["w1"]) + blk["b1"], 0.0)
-        h = h + F.matmul(ff, blk["w2"]) + blk["b2"]
+    hn = _layernorm(h, blk["ln1"]["g"], blk["ln1"]["b"])
+    if attn_fn is not None:
+        h = h + attn_fn(blk["attn"], hn)
+    else:
+        h = h + mha_forward(blk["attn"], hn, n_heads, causal=True,
+                            block_size=block_size)
+    hn = _layernorm(h, blk["ln2"]["g"], blk["ln2"]["b"])
+    ff = jnp.maximum(F.matmul(hn, blk["w1"]) + blk["b1"], 0.0)
+    return h + F.matmul(ff, blk["w2"]) + blk["b2"]
+
+
+def embed_tokens(params, tokens):
+    """Token + positional embedding — the pre-block-stack half, shared by
+    the sequential forward and the pipeline-parallel path."""
+    import jax.numpy as jnp
+    s = tokens.shape[1]
+    return jnp.take(params["embed"], tokens, axis=0) + params["pos"][:s]
+
+
+def head_logits(params, h):
+    """Final LN + tied output head over block-stack activations."""
     h = _layernorm(h, params["ln_f"]["g"], params["ln_f"]["b"])
-    return F.matmul(h, params["embed"].T)    # tied output head
+    return F.matmul(h, params["embed"].T)
 
 
-def lm_loss(params, tokens, mask, n_heads, block_size=None):
-    """Mean next-token cross-entropy (masked rows excluded)."""
+def nll_from_hidden(params, h, targets, mask):
+    """Masked mean next-token cross-entropy from block-stack activations —
+    the post-block half shared by lm_loss and pipeline_lm_loss."""
     import jax
     import jax.numpy as jnp
-    logits = transformer_forward(params, tokens[:, :-1], n_heads,
-                                 block_size)
-    targets = tokens[:, 1:]
-    logp = jax.nn.log_softmax(logits, axis=-1)
+    logp = jax.nn.log_softmax(head_logits(params, h), axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     m = mask[:, None]
     denom = jnp.maximum(m.sum() * nll.shape[1], 1.0)
     return (nll * m).sum() / denom
+
+
+def transformer_forward(params, tokens, n_heads, block_size=None,
+                        attn_fn=None):
+    """Logits (batch, seq, vocab); ``attn_fn(q_input)`` optionally replaces
+    the attention call (ring attention injection point)."""
+    h = embed_tokens(params, tokens)
+    for blk in params["blocks"]:
+        h = block_forward(blk, h, n_heads, block_size, attn_fn)
+    return head_logits(params, h)
+
+
+def lm_loss(params, tokens, mask, n_heads, block_size=None):
+    """Mean next-token cross-entropy (masked rows excluded)."""
+    h = embed_tokens(params, tokens[:, :-1])
+    for blk in params["blocks"]:
+        h = block_forward(blk, h, n_heads, block_size)
+    return nll_from_hidden(params, h, tokens[:, 1:], mask)
 
 
 class TransformerTrainer(AcceleratedUnit):
