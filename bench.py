@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -480,6 +481,31 @@ def main():
                  "alexnet": (1024, 128, 128)}
         alex_kwargs = {}
         target, floor_seconds = args.seconds or 4.0, 3.0
+
+    # Device watchdog: a wedged TPU-tunnel relay makes the first dispatch
+    # hang FOREVER (round 4 observed this for hours).  Probe with a tiny
+    # fetch under a hard deadline so a dead device yields the one-line
+    # JSON record instead of a silent hang.
+    import threading
+    probe_ok = []
+
+    def _probe():
+        import jax
+        probe_ok.append(_sync(jax.jit(lambda a: a + 1)(numpy.ones(2))))
+
+    probe = threading.Thread(target=_probe, daemon=True)
+    probe.start()
+    probe.join(timeout=float(os.environ.get("VELES_BENCH_PROBE_S", 300)))
+    if not probe_ok:
+        print(json.dumps({
+            "metric": "bench_failed",
+            "value": None,
+            "unit": "",
+            "vs_baseline": None,
+            "configs": {"error": "device probe did not complete — "
+                                 "TPU tunnel unreachable"},
+        }))
+        return 1
 
     device_kind, peak = _peak_tflops()
     results = {}

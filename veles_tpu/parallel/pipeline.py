@@ -122,10 +122,26 @@ def pipeline_blocks(stacked_blocks, h, mesh, n_heads, n_microbatches,
 
     fn = shard_map(run, mesh=mesh, in_specs=(P("stage"), P()),
                    out_specs=P(), check_vma=False)
-    stacked_blocks = jax.device_put(
-        stacked_blocks, NamedSharding(mesh, P("stage")))
+    want = NamedSharding(mesh, P("stage"))
+    leaf = jax.tree.leaves(stacked_blocks)[0]
+    already_placed = (
+        not isinstance(leaf, jax.core.Tracer)   # tracers have no .sharding
+        and isinstance(leaf, jax.Array)
+        and leaf.sharding.is_equivalent_to(want, leaf.ndim))
+    if not already_placed:
+        # place once; callers in a training loop should pre-place (see
+        # place_blocks) so repeated eager calls don't re-transfer params.
+        # Under a trace this is the sharding constraint, not a copy.
+        stacked_blocks = jax.device_put(stacked_blocks, want)
     out = fn(stacked_blocks, x)
     return out.reshape(h.shape)
+
+
+def place_blocks(stacked_blocks, mesh):
+    """Pre-place a stacked block pytree on the stage sharding (do this
+    ONCE before a training loop; pipeline_blocks then skips the copy)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    return jax.device_put(stacked_blocks, NamedSharding(mesh, P("stage")))
 
 
 def pipeline_lm_loss(params, tokens, mask, n_heads, mesh, n_microbatches,
