@@ -16,8 +16,12 @@ def tiny_config():
     root.char_lm.update({
         "loader": {"minibatch_size": 32, "n_train": 128, "n_valid": 64,
                    "seq_len": 32, "vocab": 16},
+        # n_experts/pipeline_stages pinned to 0: root is process-global and
+        # update() merges — without explicit zeros, a previous test's MoE/PP
+        # settings would silently leak into later "dense sequential" runs
         "trainer": {"vocab": 16, "d_model": 32, "n_heads": 2, "n_layers": 1,
-                    "max_len": 32, "learning_rate": 3e-3},
+                    "max_len": 32, "learning_rate": 3e-3,
+                    "n_experts": 0, "pipeline_stages": 0},
         "decision": {"max_epochs": 4, "fail_iterations": 10},
     })
 
@@ -115,6 +119,85 @@ class TestCharLM:
         for x, y in zip(a, b):
             numpy.testing.assert_array_equal(numpy.asarray(x),
                                              numpy.asarray(y))
+
+
+class TestMoETrainer:
+    def test_moe_char_lm_converges(self):
+        """n_experts > 0 swaps every block's FFN for the routed MoE; the
+        char LM must still learn the cyclic grammar."""
+        prng.reset()
+        prng.seed_all(1)
+        tiny_config()
+        root.char_lm.update({"trainer": {"n_experts": 4, "n_layers": 2}})
+        from veles_tpu.samples import char_lm
+        wf = char_lm.train()
+        losses = [m["validation"]["loss"] for m in wf.decision.epoch_metrics
+                  if "validation" in m]
+        assert losses[-1] < losses[0] * 0.7, losses
+        # the params really carry routed experts
+        blk0 = wf.trainer.params["blocks"][0]
+        assert "moe" in blk0 and blk0["moe"]["w1"].shape[0] == 4
+
+
+class TestPipelinedTrainer:
+    def test_pp_training_matches_sequential(self):
+        """pipeline_stages > 0 trains through the GPipe schedule; the loss
+        stream must equal the sequential trainer's exactly (same adam on
+        the same per-layer values, just stacked)."""
+        from veles_tpu.samples import char_lm
+
+        def train(stages):
+            prng.reset()
+            prng.seed_all(1)
+            tiny_config()
+            root.char_lm.update({
+                "trainer": {"n_layers": 4,
+                            "pipeline_stages": stages,
+                            "pipeline_microbatches": 4},
+                "decision": {"max_epochs": 2, "fail_iterations": 10},
+            })
+            wf = char_lm.train()
+            return [m["validation"]["loss"]
+                    for m in wf.decision.epoch_metrics
+                    if "validation" in m]
+
+        seq = train(0)
+        pp = train(4)
+        numpy.testing.assert_allclose(pp, seq, rtol=2e-5, atol=1e-6)
+
+    def test_pp_snapshot_portable_to_sequential(self):
+        """Snapshots carry blocks UNSTACKED, so a pipelined trainer's
+        state restores into a sequential trainer (single-chip eval) and
+        scores identically."""
+        from veles_tpu.samples import char_lm
+
+        def build(stages):
+            prng.reset()
+            prng.seed_all(1)
+            tiny_config()
+            root.char_lm.update({
+                "trainer": {"n_layers": 4, "pipeline_stages": stages,
+                            "pipeline_microbatches": 4},
+                "decision": {"max_epochs": 2, "fail_iterations": 10},
+            })
+            return char_lm
+
+        wf = build(4).train()
+        state = wf.snapshot_state()
+        # portable form: per-layer list, not the stacked pytree
+        snap_blocks = state["units"]["trainer"]["params"]["blocks"]
+        assert isinstance(snap_blocks, list) and len(snap_blocks) == 4
+
+        wf2 = build(0).build()
+        wf2.initialize()
+        wf2.load_snapshot_state(state)
+        rng = numpy.random.RandomState(2)
+        tokens = jnp.asarray(rng.randint(0, 16, (8, 32)), jnp.int32)
+        mask = jnp.ones(8, jnp.float32)
+        a = wf.trainer._evalf(wf.trainer.params, tokens, mask)
+        b = wf2.trainer._evalf(wf2.trainer.params, tokens, mask)
+        numpy.testing.assert_allclose(
+            float(a["loss_sum"]), float(b["loss_sum"]), rtol=2e-5)
 
 
 class TestRingLMForward:

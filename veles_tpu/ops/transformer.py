@@ -25,7 +25,10 @@ from veles_tpu.ops.decision import DecisionBase
 
 def init_transformer_params(stream, vocab, d_model=64, n_heads=4,
                             n_layers=2, d_ff=None, max_len=512,
-                            dtype="float32"):
+                            dtype="float32", n_experts=0):
+    """``n_experts > 0`` replaces every block's dense FFN with a
+    top-1-routed mixture of experts (ops/moe.py) — expert weights carry
+    an expert-major leading axis, shardable over an 'expert' mesh axis."""
     d_ff = d_ff or 4 * d_model
     s_emb = d_model ** -0.5
 
@@ -41,17 +44,25 @@ def init_transformer_params(stream, vocab, d_model=64, n_heads=4,
     stream.fill_normal(pos, 0.0, s_emb)
     blocks = []
     for _ in range(n_layers):
-        blocks.append({
+        blk = {
             "attn": init_mha_params(stream, d_model, n_heads, dtype),
             "ln1": {"g": numpy.ones(d_model, dtype),
                     "b": numpy.zeros(d_model, dtype)},
             "ln2": {"g": numpy.ones(d_model, dtype),
                     "b": numpy.zeros(d_model, dtype)},
-            "w1": dense(d_model, d_ff),
-            "b1": numpy.zeros(d_ff, dtype),
-            "w2": dense(d_ff, d_model),
-            "b2": numpy.zeros(d_model, dtype),
-        })
+        }
+        if n_experts > 0:
+            from veles_tpu.ops.moe import init_moe_params
+            blk["moe"] = init_moe_params(stream, d_model, d_ff, n_experts,
+                                         dtype)
+        else:
+            blk.update({
+                "w1": dense(d_model, d_ff),
+                "b1": numpy.zeros(d_ff, dtype),
+                "w2": dense(d_ff, d_model),
+                "b2": numpy.zeros(d_model, dtype),
+            })
+        blocks.append(blk)
     return {"embed": embed, "pos": pos, "blocks": blocks,
             "ln_f": {"g": numpy.ones(d_model, dtype),
                      "b": numpy.zeros(d_model, dtype)}}
@@ -67,7 +78,8 @@ def _layernorm(x, g, b, eps=1e-5):
 def block_forward(blk, h, n_heads, block_size=None, attn_fn=None):
     """One decoder block (pre-LN attention + FFN with residuals) — shared
     by the sequential forward and the pipeline-parallel stage runner
-    (veles_tpu.parallel.pipeline)."""
+    (veles_tpu.parallel.pipeline).  A block carrying ``moe`` params uses
+    the routed expert FFN in place of the dense one."""
     import jax.numpy as jnp
     hn = _layernorm(h, blk["ln1"]["g"], blk["ln1"]["b"])
     if attn_fn is not None:
@@ -76,6 +88,9 @@ def block_forward(blk, h, n_heads, block_size=None, attn_fn=None):
         h = h + mha_forward(blk["attn"], hn, n_heads, causal=True,
                             block_size=block_size)
     hn = _layernorm(h, blk["ln2"]["g"], blk["ln2"]["b"])
+    if "moe" in blk:
+        from veles_tpu.ops.moe import moe_ffn
+        return h + moe_ffn(blk["moe"], hn)
     ff = jnp.maximum(F.matmul(hn, blk["w1"]) + blk["b1"], 0.0)
     return h + F.matmul(ff, blk["w2"]) + blk["b2"]
 
@@ -131,6 +146,7 @@ class TransformerTrainer(AcceleratedUnit):
     def __init__(self, workflow, vocab=64, d_model=64, n_heads=4,
                  n_layers=2, max_len=512, learning_rate=1e-3,
                  block_size=None, beta1=0.9, beta2=0.999, eps=1e-8,
+                 n_experts=0, pipeline_stages=0, pipeline_microbatches=4,
                  **kwargs):
         super().__init__(workflow, **kwargs)
         self.vocab = vocab
@@ -141,27 +157,71 @@ class TransformerTrainer(AcceleratedUnit):
         self.learning_rate = learning_rate
         self.block_size = block_size
         self.beta1, self.beta2, self.eps = beta1, beta2, eps
+        #: > 0 — every block's FFN is a routed mixture of experts
+        self.n_experts = n_experts
+        #: > 0 — blocks run as a GPipe pipeline over a 'stage' mesh axis
+        #: (parallel.pipeline); n_layers must divide by the stage count
+        self.pipeline_stages = pipeline_stages
+        self.pipeline_microbatches = pipeline_microbatches
+        self._pp_mesh = None
         self.params = None
         self.opt_state = None
         self.time = 0
         self.metrics = {}
 
-    # params are a pytree, not Vectors — custom snapshot marshalling
+    # params are a pytree, not Vectors — custom snapshot marshalling.
+    # Snapshots always carry blocks in the UNSTACKED per-layer list form,
+    # so they are portable between pipelined and sequential trainers.
+    def _to_portable(self, tree):
+        from veles_tpu.parallel.pipeline import unstack_blocks
+        if self.pipeline_stages > 0 and isinstance(tree.get("blocks"), dict):
+            tree = dict(tree,
+                        blocks=unstack_blocks(tree["blocks"],
+                                              self.n_layers))
+        return tree
+
+    def _from_portable(self, tree):
+        from veles_tpu.parallel.pipeline import stack_blocks
+        if self.pipeline_stages > 0 and isinstance(tree.get("blocks"), list):
+            tree = dict(tree, blocks=stack_blocks(tree["blocks"]))
+        return tree
+
     def state_dict(self):
         import jax
-        tree = jax.tree.map(numpy.asarray, self.params) \
-            if self.params is not None else None
-        opt = jax.tree.map(numpy.asarray, self.opt_state) \
-            if self.opt_state is not None else None
-        return {"params": tree, "opt_state": opt, "time": self.time}
+
+        def marshal(tree):
+            if tree is None:
+                return None
+            return jax.tree.map(numpy.asarray, self._to_portable(tree))
+
+        return {"params": marshal(self.params),
+                "opt_state": (tuple(marshal(t) for t in self.opt_state)
+                              if self.opt_state is not None else None),
+                "time": self.time}
 
     def load_state_dict(self, d):
         import jax.numpy as jnp
         import jax
         if d.get("params") is not None:
-            self.params = jax.tree.map(jnp.asarray, d["params"])
-            self.opt_state = jax.tree.map(jnp.asarray, d["opt_state"])
+            self.params = self._from_portable(
+                jax.tree.map(jnp.asarray, d["params"]))
+            self.opt_state = tuple(
+                self._from_portable(jax.tree.map(jnp.asarray, t))
+                for t in d["opt_state"])
         self.time = d.get("time", 0)
+
+    def _loss_fn(self):
+        """(params, tokens, mask) -> loss — sequential or pipelined."""
+        if self.pipeline_stages > 0:
+            from veles_tpu.parallel.pipeline import pipeline_lm_loss
+
+            def loss(params, tokens, mask):
+                return pipeline_lm_loss(
+                    params, tokens, mask, self.n_heads, self._pp_mesh,
+                    self.pipeline_microbatches, self.block_size)
+            return loss
+        return lambda params, tokens, mask: lm_loss(
+            params, tokens, mask, self.n_heads, self.block_size)
 
     def initialize(self, device=None, **kwargs):
         import jax
@@ -171,14 +231,23 @@ class TransformerTrainer(AcceleratedUnit):
         if self.params is None:
             host = init_transformer_params(
                 prng_mod.get("init"), self.vocab, self.d_model,
-                self.n_heads, self.n_layers, max_len=self.max_len)
+                self.n_heads, self.n_layers, max_len=self.max_len,
+                n_experts=self.n_experts)
             self.params = jax.tree.map(jnp.asarray, host)
+            if self.pipeline_stages > 0:
+                from veles_tpu.parallel.pipeline import stack_blocks
+                self.params = dict(self.params,
+                                   blocks=stack_blocks(
+                                       self.params["blocks"]))
             self.opt_state = (jax.tree.map(jnp.zeros_like, self.params),
                               jax.tree.map(jnp.zeros_like, self.params))
+        if self.pipeline_stages > 0 and self._pp_mesh is None:
+            from veles_tpu.parallel.pipeline import make_pipeline_mesh
+            self._pp_mesh = make_pipeline_mesh(self.pipeline_stages)
+        loss_fn = self._loss_fn()
 
         def train_step(params, opt_state, tokens, mask, t):
-            loss, grads = jax.value_and_grad(lm_loss)(
-                params, tokens, mask, self.n_heads, self.block_size)
+            loss, grads = jax.value_and_grad(loss_fn)(params, tokens, mask)
             m, v = opt_state
             m = jax.tree.map(
                 lambda a, g: self.beta1 * a + (1 - self.beta1) * g,
@@ -197,8 +266,7 @@ class TransformerTrainer(AcceleratedUnit):
                                     "tokens": count}
 
         def eval_step(params, tokens, mask):
-            loss = lm_loss(params, tokens, mask, self.n_heads,
-                           self.block_size)
+            loss = loss_fn(params, tokens, mask)
             count = mask.sum()
             return {"loss_sum": loss * count, "tokens": count}
 
