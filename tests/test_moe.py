@@ -68,6 +68,51 @@ def test_expert_parallel_matches_single_device(n_shards):
         out_grads, ref_grads)
 
 
+def test_load_balancing_loss_semantics():
+    """1.0 at perfect balance; grows toward E as routing collapses."""
+    from veles_tpu.ops.moe import load_balancing_loss
+    e, t = 4, 400
+    balanced_onehot = jnp.eye(e)[jnp.arange(t) % e]
+    uniform_probs = jnp.full((t, e), 1.0 / e)
+    numpy.testing.assert_allclose(
+        float(load_balancing_loss(uniform_probs, balanced_onehot)), 1.0,
+        rtol=1e-6)
+    collapsed_probs = jnp.zeros((t, e)).at[:, 0].set(1.0)
+    collapsed_onehot = jnp.zeros((t, e)).at[:, 0].set(1.0)
+    numpy.testing.assert_allclose(
+        float(load_balancing_loss(collapsed_probs, collapsed_onehot)),
+        float(e), rtol=1e-6)
+
+
+def test_aux_loss_spreads_experts():
+    """Training WITH the aux loss routes tokens across more experts than
+    training without it (the collapse the loss exists to prevent)."""
+    from veles_tpu.ops.transformer import (init_transformer_params,
+                                           lm_loss)
+    rng = numpy.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, 16, (16, 24)), jnp.int32)
+    mask = jnp.ones(16, jnp.float32)
+
+    def train(coef, steps=60):
+        prng.reset()
+        prng.seed_all(2)
+        params = jax.tree.map(jnp.asarray, init_transformer_params(
+            prng.get("init"), 16, d_model=16, n_heads=2, n_layers=1,
+            max_len=32, n_experts=4))
+        grad = jax.jit(jax.grad(
+            lambda p: lm_loss(p, tokens, mask, 2, moe_aux_coef=coef)))
+        for _ in range(steps):
+            g = grad(params)
+            params = jax.tree.map(lambda a, b: a - 0.05 * b, params, g)
+        probs = router_probs(params["blocks"][0]["moe"],
+                             jnp.take(params["embed"], tokens, axis=0))
+        top = numpy.asarray(jnp.argmax(probs, axis=-1))
+        return len(numpy.unique(top))
+
+    assert train(coef=1e-2) >= train(coef=0.0)
+    assert train(coef=1e-2) >= 2  # aux keeps multiple experts live
+
+
 def test_expert_count_guard():
     from jax.sharding import Mesh
     params, x = _setup()

@@ -2,9 +2,13 @@
 
 The tunnel adds O(100ms) per dispatch, so per-op cost is measured by
 repeating the op K times INSIDE one jit (fori_loop with a scalar data
-dependency that defeats CSE), then differencing K vs 0 repetitions.
+dependency that defeats CSE), then differencing K vs 1 repetitions.
 Timing windows end in a VALUE FETCH (block_until_ready does not block
 through the tunnel — see bench.py).
+
+The round-3 patch-materializing pooling / cumsum LRN are kept here as
+local copies so the current native implementations can always be
+re-compared against them (the r3→r4 rewrite rationale: docs/PERF.md).
 """
 import time
 
@@ -47,6 +51,28 @@ def bench_op(name, op, x, n_timed=3):
     return per_op
 
 
+# ---- round-3 implementations, kept for A/B comparison -----------------
+def _r3_patch_maxpool(x, window=(3, 3), stride=(2, 2)):
+    """The replaced patch-materializing max pooling (kh*kw HBM blowup)."""
+    lowest = float(jnp.finfo(x.dtype).min) / 2
+    patches, _, _ = F._pool_patches(x, window, stride, lowest)
+    idx = jnp.argmax(patches, axis=3, keepdims=True)
+    return jnp.take_along_axis(patches, idx, axis=3)[:, :, :, 0, :]
+
+
+def _r3_cumsum_lrn(x, alpha=1e-4, beta=0.75, n=5, k=2.0):
+    """The replaced cumsum-based LRN (prefix-scan lowering)."""
+    c = x.shape[-1]
+    sq = x * x
+    half = n // 2
+    padded = jnp.pad(sq, [(0, 0)] * (x.ndim - 1) + [(half, half)])
+    csum = jnp.cumsum(padded, axis=-1)
+    csum = jnp.pad(csum, [(0, 0)] * (x.ndim - 1) + [(1, 0)])
+    window_sums = jax.lax.slice_in_dim(csum, n, n + c, axis=-1) - \
+        jax.lax.slice_in_dim(csum, 0, c, axis=-1)
+    return x / (k + (alpha / n) * window_sums) ** beta
+
+
 def main():
     key = jax.random.PRNGKey(0)
     B = 128
@@ -62,90 +88,42 @@ def main():
     x227 = jax.random.normal(key, (B, 227, 227, 3), jnp.float32)
     w1 = jax.random.normal(key, (11, 11, 3, 96), jnp.float32) * 0.01
     b1 = jnp.zeros((96,))
+    bench_op("conv1 fwd (current precision mode)",
+             lambda x: F.conv2d_forward(x, w1, b1, (4, 4), "VALID",
+                                        "strict_relu"), x227)
 
-    def conv1_chain(x):
-        y = F.conv2d_forward(x, w1, b1, (4, 4), "VALID", "strict_relu")
-        return y
-    bench_op("conv1 fwd HIGHEST", conv1_chain, x227)
-
-    # ---- LRN at conv1 output shape
+    # ---- LRN at conv1 output shape: current slice-sum vs r3 cumsum
     y1 = jax.random.normal(key, (B, 55, 55, 96), jnp.float32)
-    bench_op("lrn fwd (cumsum impl)", F.lrn_forward, y1)
+    bench_op("lrn fwd (current slice-sum)", F.lrn_forward, y1)
+    bench_op("lrn fwd (r3 cumsum)", _r3_cumsum_lrn, y1)
 
     def lrn_vjp(x):
         _, vjp = jax.vjp(F.lrn_forward, x)
         return vjp(x)[0]
-    bench_op("lrn fwd+vjp (cumsum impl)", lrn_vjp, y1)
+    bench_op("lrn fwd+vjp (current)", lrn_vjp, y1)
 
-    def lrn_slices(x, alpha=1e-4, beta=0.75, n=5, k=2.0):
-        sq = x * x
-        half = n // 2
-        padded = jnp.pad(sq, [(0, 0)] * (x.ndim - 1) + [(half, half)])
-        c = x.shape[-1]
-        win = sum(jax.lax.slice_in_dim(padded, i, i + c, axis=-1)
-                  for i in range(n))
-        return x / (k + (alpha / n) * win) ** beta
-    bench_op("lrn fwd (5-slice impl)", lrn_slices, y1)
+    # ---- max pooling 3x3 s2: current reduce_window vs r3 patches
+    bench_op("maxpool fwd (current reduce_window)",
+             lambda x: F.max_pooling(x, (3, 3), (2, 2)), y1)
+    bench_op("maxpool fwd (r3 patches)", _r3_patch_maxpool, y1)
 
-    def lrn_slices_vjp(x):
-        _, vjp = jax.vjp(lrn_slices, x)
-        return vjp(x)[0]
-    bench_op("lrn fwd+vjp (5-slice impl)", lrn_slices_vjp, y1)
-
-    # ---- max pooling 3x3 s2 at conv1 output shape
-    def pool_patches_roundtrip(x):
-        y = F.max_pooling(x, (3, 3), (2, 2))   # (B,27,27,96)
-        return y
-    bench_op("maxpool fwd (patches impl)", pool_patches_roundtrip, y1)
-
-    def pool_patches_vjp(x):
+    def pool_vjp(x):
         y, vjp = jax.vjp(lambda a: F.max_pooling(a, (3, 3), (2, 2)), x)
         return vjp(y)[0]
-    bench_op("maxpool fwd+vjp (patches impl)", pool_patches_vjp, y1)
+    bench_op("maxpool fwd+vjp (current)", pool_vjp, y1)
 
-    def rw_maxpool(x):
-        return jax.lax.reduce_window(
-            x, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1),
-            [(0, 0), (0, 1), (0, 1), (0, 0)])
-    bench_op("maxpool fwd (reduce_window)", rw_maxpool, y1)
-
-    def rw_pool_vjp(x):
-        y, vjp = jax.vjp(rw_maxpool, x)
-        return vjp(y)[0]
-    bench_op("maxpool fwd+vjp (reduce_window)", rw_pool_vjp, y1)
-
-    # ---- conv2 5x5 pad2 96->256 fwd and fwd+vjp, HIGHEST/DEFAULT/bf16
+    # ---- conv2 5x5 pad2 96->256 under both precision modes
     x2 = jax.random.normal(key, (B, 27, 27, 96), jnp.float32)
     w2 = jax.random.normal(key, (5, 5, 96, 256), jnp.float32) * 0.01
     b2 = jnp.zeros((256,))
-
-    bench_op("conv2 fwd HIGHEST", lambda x: F.conv2d_forward(
-        x, w2, b2, (1, 1), 2, "strict_relu"), x2)
-
-    def conv2_default(x):
-        z = jax.lax.conv_general_dilated(
-            x, w2, (1, 1), [(2, 2), (2, 2)],
-            dimension_numbers=("NHWC", "HWIO", "NHWC"))
-        return jnp.maximum(z + b2, 0.0)
-    bench_op("conv2 fwd DEFAULT", conv2_default, x2)
-
-    def conv2_bf16(x):
-        z = jax.lax.conv_general_dilated(
-            x.astype(jnp.bfloat16), w2.astype(jnp.bfloat16), (1, 1),
-            [(2, 2), (2, 2)], dimension_numbers=("NHWC", "HWIO", "NHWC"))
-        return jnp.maximum(z.astype(jnp.float32) + b2, 0.0)
-    bench_op("conv2 fwd bf16-cast", conv2_bf16, x2)
-
-    def conv2_vjp(x):
-        y, vjp = jax.vjp(lambda a: F.conv2d_forward(
-            a, w2, b2, (1, 1), 2, "strict_relu"), x)
-        return vjp(y)[0]
-    bench_op("conv2 fwd+vjp HIGHEST", conv2_vjp, x2)
-
-    def conv2_bf16_vjp(x):
-        y, vjp = jax.vjp(conv2_bf16, x)
-        return vjp(y)[0]
-    bench_op("conv2 fwd+vjp bf16-cast", conv2_bf16_vjp, x2)
+    for mode in ("float32", "bfloat16"):
+        F.set_matmul_precision(mode)
+        try:
+            bench_op("conv2 fwd (%s)" % mode,
+                     lambda x: F.conv2d_forward(x, w2, b2, (1, 1), 2,
+                                                "strict_relu"), x2)
+        finally:
+            F.set_matmul_precision("float32")
 
     # ---- FC trunk 9216->4096->4096->1000
     xf = jax.random.normal(key, (B, 9216), jnp.float32)
@@ -157,19 +135,14 @@ def main():
         h = jnp.maximum(F.matmul(x, wf1), 0.0)
         h = jnp.maximum(F.matmul(h, wf2), 0.0)
         return F.matmul(h, wf3)
-    bench_op("fc trunk fwd HIGHEST", fc_fwd, xf)
+    bench_op("fc trunk fwd", fc_fwd, xf)
 
     def fc_vjp(x):
         y, vjp = jax.vjp(fc_fwd, x)
         return vjp(y)[0]
-    bench_op("fc trunk fwd+vjp HIGHEST", fc_vjp, xf)
+    bench_op("fc trunk fwd+vjp", fc_vjp, xf)
 
-    # ---- dropout
-    xd = jax.random.normal(key, (B, 4096), jnp.float32)
-    bench_op("dropout (B,4096)", lambda x: F.dropout(
-        x, jax.random.PRNGKey(3), 0.5, True), xd)
-
-    # ---- big matmul sanity (roofline)
+    # ---- roofline sanity
     xm = jax.random.normal(key, (4096, 4096), jnp.float32)
     t = bench_op("matmul 4096^3 HIGHEST", lambda x: F.matmul(x, x), xm)
     print("   -> %.1f TF/s fp32-HIGHEST" % (2 * 4096**3 / t / 1e12))

@@ -56,10 +56,22 @@ def _expert_ffn(w1, b1, w2, b2, x):
     return F.matmul(h, w2) + b2
 
 
-def moe_ffn(params, x):
+def load_balancing_loss(probs, onehot):
+    """Switch-Transformer-style auxiliary loss: E * Σ_e f_e · P_e, where
+    f_e is the fraction of tokens routed to expert e and P_e the mean
+    router probability of e.  Equals 1.0 at perfect balance and grows as
+    routing collapses — without it, top-1 routing degenerates onto one
+    expert (the router gradient only flows through chosen experts)."""
+    f = onehot.mean(axis=0)          # (E,) routed fraction
+    p = probs.mean(axis=0)           # (E,) mean router prob
+    return probs.shape[-1] * jnp.sum(f * p)
+
+
+def moe_ffn(params, x, return_aux=False):
     """Top-1 routed MoE FFN, single device: every expert runs over the
     full token set, masked combine keeps only each token's chosen expert
-    (static shapes; the EP path partitions the expert loop instead)."""
+    (static shapes; the EP path partitions the expert loop instead).
+    ``return_aux=True`` also returns the load-balancing loss."""
     shape = x.shape
     flat = x.reshape(-1, shape[-1])
     probs = router_probs(params, x)                   # (T, E)
@@ -70,8 +82,11 @@ def moe_ffn(params, x):
     expert_out = jax.vmap(_expert_ffn, in_axes=(0, 0, 0, 0, None))(
         params["w1"], params["b1"], params["w2"], params["b2"], flat)
     # combine: token t takes expert top[t]'s row, scaled by its gate
-    out = jnp.einsum("etd,te->td", expert_out, onehot) * gate
-    return out.reshape(shape)
+    out = (jnp.einsum("etd,te->td", expert_out, onehot)
+           * gate).reshape(shape)
+    if return_aux:
+        return out, load_balancing_loss(probs, onehot)
+    return out
 
 
 def moe_ffn_ep(params, x, mesh, expert_axis="expert"):

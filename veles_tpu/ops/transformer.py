@@ -75,11 +75,13 @@ def _layernorm(x, g, b, eps=1e-5):
     return (x - mean) / jnp.sqrt(var + eps) * g + b
 
 
-def block_forward(blk, h, n_heads, block_size=None, attn_fn=None):
+def block_forward(blk, h, n_heads, block_size=None, attn_fn=None,
+                  with_aux=False):
     """One decoder block (pre-LN attention + FFN with residuals) — shared
     by the sequential forward and the pipeline-parallel stage runner
     (veles_tpu.parallel.pipeline).  A block carrying ``moe`` params uses
-    the routed expert FFN in place of the dense one."""
+    the routed expert FFN in place of the dense one; ``with_aux=True``
+    returns (h, moe_load_balancing_loss) (0 for dense blocks)."""
     import jax.numpy as jnp
     hn = _layernorm(h, blk["ln1"]["g"], blk["ln1"]["b"])
     if attn_fn is not None:
@@ -90,9 +92,13 @@ def block_forward(blk, h, n_heads, block_size=None, attn_fn=None):
     hn = _layernorm(h, blk["ln2"]["g"], blk["ln2"]["b"])
     if "moe" in blk:
         from veles_tpu.ops.moe import moe_ffn
+        if with_aux:
+            out, aux = moe_ffn(blk["moe"], hn, return_aux=True)
+            return h + out, aux
         return h + moe_ffn(blk["moe"], hn)
     ff = jnp.maximum(F.matmul(hn, blk["w1"]) + blk["b1"], 0.0)
-    return h + F.matmul(ff, blk["w2"]) + blk["b2"]
+    h = h + F.matmul(ff, blk["w2"]) + blk["b2"]
+    return (h, 0.0) if with_aux else h
 
 
 def embed_tokens(params, tokens):
@@ -131,12 +137,26 @@ def transformer_forward(params, tokens, n_heads, block_size=None,
     return head_logits(params, h)
 
 
-def lm_loss(params, tokens, mask, n_heads, block_size=None):
-    """Mean next-token cross-entropy (masked rows excluded)."""
+def lm_loss(params, tokens, mask, n_heads, block_size=None,
+            moe_aux_coef=0.0):
+    """Mean next-token cross-entropy (masked rows excluded).
+
+    ``moe_aux_coef > 0`` adds the mean per-MoE-block load-balancing loss
+    (ops/moe.py) — required for top-1 routing not to collapse."""
     h = embed_tokens(params, tokens[:, :-1])
+    aux_total, n_moe = 0.0, 0
     for blk in params["blocks"]:
-        h = block_forward(blk, h, n_heads, block_size)
-    return nll_from_hidden(params, h, tokens[:, 1:], mask)
+        if moe_aux_coef and "moe" in blk:
+            h, aux = block_forward(blk, h, n_heads, block_size,
+                                   with_aux=True)
+            aux_total = aux_total + aux
+            n_moe += 1
+        else:
+            h = block_forward(blk, h, n_heads, block_size)
+    loss = nll_from_hidden(params, h, tokens[:, 1:], mask)
+    if n_moe:
+        loss = loss + moe_aux_coef * aux_total / n_moe
+    return loss
 
 
 class TransformerTrainer(AcceleratedUnit):
@@ -146,8 +166,8 @@ class TransformerTrainer(AcceleratedUnit):
     def __init__(self, workflow, vocab=64, d_model=64, n_heads=4,
                  n_layers=2, max_len=512, learning_rate=1e-3,
                  block_size=None, beta1=0.9, beta2=0.999, eps=1e-8,
-                 n_experts=0, pipeline_stages=0, pipeline_microbatches=4,
-                 **kwargs):
+                 n_experts=0, moe_aux_coef=1e-2, pipeline_stages=0,
+                 pipeline_microbatches=4, **kwargs):
         super().__init__(workflow, **kwargs)
         self.vocab = vocab
         self.d_model = d_model
@@ -159,6 +179,8 @@ class TransformerTrainer(AcceleratedUnit):
         self.beta1, self.beta2, self.eps = beta1, beta2, eps
         #: > 0 — every block's FFN is a routed mixture of experts
         self.n_experts = n_experts
+        #: load-balancing aux-loss weight (sequential path; see _loss_fn)
+        self.moe_aux_coef = moe_aux_coef
         #: > 0 — blocks run as a GPipe pipeline over a 'stage' mesh axis
         #: (parallel.pipeline); n_layers must divide by the stage count
         self.pipeline_stages = pipeline_stages
@@ -211,17 +233,30 @@ class TransformerTrainer(AcceleratedUnit):
         self.time = d.get("time", 0)
 
     def _loss_fn(self):
-        """(params, tokens, mask) -> loss — sequential or pipelined."""
+        """(params, tokens, mask) -> loss — sequential or pipelined.
+
+        The MoE load-balancing aux loss applies on the sequential path;
+        the pipeline's scan carry does not thread it (pipelined MoE
+        trains without aux — acceptable at demo scale, noted here)."""
         if self.pipeline_stages > 0:
             from veles_tpu.parallel.pipeline import pipeline_lm_loss
+            if self.n_experts > 0 and self.moe_aux_coef:
+                # never drop an explicit setting silently
+                self.warning(
+                    "moe_aux_coef is not applied on the pipeline path "
+                    "(the stage scan does not thread the aux term); "
+                    "pipelined MoE trains without load balancing — set "
+                    "moe_aux_coef=0 to silence this warning")
 
             def loss(params, tokens, mask):
                 return pipeline_lm_loss(
                     params, tokens, mask, self.n_heads, self._pp_mesh,
                     self.pipeline_microbatches, self.block_size)
             return loss
+        coef = self.moe_aux_coef if self.n_experts > 0 else 0.0
         return lambda params, tokens, mask: lm_loss(
-            params, tokens, mask, self.n_heads, self.block_size)
+            params, tokens, mask, self.n_heads, self.block_size,
+            moe_aux_coef=coef)
 
     def initialize(self, device=None, **kwargs):
         import jax
