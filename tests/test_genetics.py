@@ -128,6 +128,41 @@ class TestPopulationParallel:
                                 [[0.5], [0.6]], seed=1, workers=2)
 
 
+class TestOptimizeCLI:
+    def test_cli_optimize_with_workers(self, tmp_path):
+        """`--optimize g:p:w` end to end through the real CLI: config file
+        with a Tune leaf, GA across worker subprocesses, winner printed."""
+        import os
+        import subprocess
+        import sys
+        cfg = tmp_path / "tunes.py"
+        cfg.write_text(
+            "root.mnist.update({\n"
+            "    'loader': {'minibatch_size': 50, 'n_train': 150,\n"
+            "               'n_valid': 50},\n"
+            "    'decision': {'max_epochs': 1, 'fail_iterations': 5},\n"
+            "    'layers': [\n"
+            "        {'type': 'all2all_tanh', 'output_sample_shape': 8,\n"
+            "         'learning_rate': Tune(0.001, 0.0005, 0.1),\n"
+            "         'momentum': 0.9},\n"
+            "        {'type': 'softmax', 'output_sample_shape': 10,\n"
+            "         'learning_rate': 0.03, 'momentum': 0.9},\n"
+            "    ],\n"
+            "})\n")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        proc = subprocess.run(
+            [sys.executable, "-m", "veles_tpu", "veles_tpu.samples.mnist",
+             str(cfg), "-d", "cpu", "--random-seed", "1",
+             "--optimize", "1:2:2"],
+            capture_output=True, text=True, env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            timeout=420)
+        assert proc.returncode == 0, proc.stderr[-3000:]
+        assert "best fitness:" in proc.stdout
+        assert "learning_rate" in proc.stdout
+
+
 class TestEnsemble:
     def test_members_and_combination(self):
         from veles_tpu import prng

@@ -20,7 +20,6 @@ import struct
 import numpy
 
 from veles_tpu.loader.base import Loader
-from veles_tpu.memory import Vector
 
 MAGIC = b"VTRECS1\n"
 

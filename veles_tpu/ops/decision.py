@@ -14,7 +14,7 @@ from __future__ import annotations
 
 from veles_tpu.units import Unit
 from veles_tpu.mutable import Bool
-from veles_tpu.loader.base import TRAIN, VALID, TEST, CLASS_NAME
+from veles_tpu.loader.base import TRAIN, CLASS_NAME
 
 
 class DecisionBase(Unit):

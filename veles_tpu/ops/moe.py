@@ -56,22 +56,31 @@ def _expert_ffn(w1, b1, w2, b2, x):
     return F.matmul(h, w2) + b2
 
 
-def load_balancing_loss(probs, onehot):
+def load_balancing_loss(probs, onehot, token_mask=None):
     """Switch-Transformer-style auxiliary loss: E * Σ_e f_e · P_e, where
     f_e is the fraction of tokens routed to expert e and P_e the mean
     router probability of e.  Equals 1.0 at perfect balance and grows as
     routing collapses — without it, top-1 routing degenerates onto one
-    expert (the router gradient only flows through chosen experts)."""
-    f = onehot.mean(axis=0)          # (E,) routed fraction
-    p = probs.mean(axis=0)           # (E,) mean router prob
+    expert (the router gradient only flows through chosen experts).
+    ``token_mask`` (T,) restricts the statistics to live tokens (padded
+    rows must not steer the router)."""
+    if token_mask is not None:
+        m = token_mask[:, None].astype(probs.dtype)
+        denom = jnp.maximum(m.sum(), 1.0)
+        f = (onehot * m).sum(axis=0) / denom
+        p = (probs * m).sum(axis=0) / denom
+    else:
+        f = onehot.mean(axis=0)      # (E,) routed fraction
+        p = probs.mean(axis=0)       # (E,) mean router prob
     return probs.shape[-1] * jnp.sum(f * p)
 
 
-def moe_ffn(params, x, return_aux=False):
+def moe_ffn(params, x, return_aux=False, token_mask=None):
     """Top-1 routed MoE FFN, single device: every expert runs over the
     full token set, masked combine keeps only each token's chosen expert
     (static shapes; the EP path partitions the expert loop instead).
-    ``return_aux=True`` also returns the load-balancing loss."""
+    ``return_aux=True`` also returns the load-balancing loss (over live
+    tokens only when ``token_mask`` is given)."""
     shape = x.shape
     flat = x.reshape(-1, shape[-1])
     probs = router_probs(params, x)                   # (T, E)
@@ -85,7 +94,7 @@ def moe_ffn(params, x, return_aux=False):
     out = (jnp.einsum("etd,te->td", expert_out, onehot)
            * gate).reshape(shape)
     if return_aux:
-        return out, load_balancing_loss(probs, onehot)
+        return out, load_balancing_loss(probs, onehot, token_mask)
     return out
 
 

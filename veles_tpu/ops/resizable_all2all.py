@@ -9,7 +9,6 @@ from __future__ import annotations
 
 import numpy
 
-from veles_tpu import prng
 from veles_tpu.ops.nn_units import ForwardBase, register_layer_type
 
 

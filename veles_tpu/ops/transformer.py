@@ -76,12 +76,13 @@ def _layernorm(x, g, b, eps=1e-5):
 
 
 def block_forward(blk, h, n_heads, block_size=None, attn_fn=None,
-                  with_aux=False):
+                  with_aux=False, token_mask=None):
     """One decoder block (pre-LN attention + FFN with residuals) — shared
     by the sequential forward and the pipeline-parallel stage runner
     (veles_tpu.parallel.pipeline).  A block carrying ``moe`` params uses
     the routed expert FFN in place of the dense one; ``with_aux=True``
-    returns (h, moe_load_balancing_loss) (0 for dense blocks)."""
+    returns (h, moe_load_balancing_loss) (0 for dense blocks;
+    ``token_mask`` keeps padded rows out of the router statistics)."""
     import jax.numpy as jnp
     hn = _layernorm(h, blk["ln1"]["g"], blk["ln1"]["b"])
     if attn_fn is not None:
@@ -93,7 +94,8 @@ def block_forward(blk, h, n_heads, block_size=None, attn_fn=None,
     if "moe" in blk:
         from veles_tpu.ops.moe import moe_ffn
         if with_aux:
-            out, aux = moe_ffn(blk["moe"], hn, return_aux=True)
+            out, aux = moe_ffn(blk["moe"], hn, return_aux=True,
+                               token_mask=token_mask)
             return h + out, aux
         return h + moe_ffn(blk["moe"], hn)
     ff = jnp.maximum(F.matmul(hn, blk["w1"]) + blk["b1"], 0.0)
@@ -142,13 +144,17 @@ def lm_loss(params, tokens, mask, n_heads, block_size=None,
     """Mean next-token cross-entropy (masked rows excluded).
 
     ``moe_aux_coef > 0`` adds the mean per-MoE-block load-balancing loss
-    (ops/moe.py) — required for top-1 routing not to collapse."""
+    (ops/moe.py) over LIVE tokens — required for top-1 routing not to
+    collapse; padded rows must not steer the router."""
+    import jax.numpy as jnp
     h = embed_tokens(params, tokens[:, :-1])
+    token_mask = jnp.broadcast_to(
+        mask[:, None], (h.shape[0], h.shape[1])).reshape(-1)
     aux_total, n_moe = 0.0, 0
     for blk in params["blocks"]:
         if moe_aux_coef and "moe" in blk:
             h, aux = block_forward(blk, h, n_heads, block_size,
-                                   with_aux=True)
+                                   with_aux=True, token_mask=token_mask)
             aux_total = aux_total + aux
             n_moe += 1
         else:
@@ -232,15 +238,16 @@ class TransformerTrainer(AcceleratedUnit):
                 for t in d["opt_state"])
         self.time = d.get("time", 0)
 
-    def _loss_fn(self):
+    def _loss_fn(self, training):
         """(params, tokens, mask) -> loss — sequential or pipelined.
 
-        The MoE load-balancing aux loss applies on the sequential path;
-        the pipeline's scan carry does not thread it (pipelined MoE
-        trains without aux — acceptable at demo scale, noted here)."""
+        The MoE load-balancing aux is a TRAINING regularizer only: eval
+        metrics stay pure NLL (comparable across coef settings).  On the
+        pipeline path the stage scan does not thread the aux term, so
+        pipelined MoE trains without it (warned below)."""
         if self.pipeline_stages > 0:
             from veles_tpu.parallel.pipeline import pipeline_lm_loss
-            if self.n_experts > 0 and self.moe_aux_coef:
+            if training and self.n_experts > 0 and self.moe_aux_coef:
                 # never drop an explicit setting silently
                 self.warning(
                     "moe_aux_coef is not applied on the pipeline path "
@@ -253,7 +260,8 @@ class TransformerTrainer(AcceleratedUnit):
                     params, tokens, mask, self.n_heads, self._pp_mesh,
                     self.pipeline_microbatches, self.block_size)
             return loss
-        coef = self.moe_aux_coef if self.n_experts > 0 else 0.0
+        coef = (self.moe_aux_coef
+                if training and self.n_experts > 0 else 0.0)
         return lambda params, tokens, mask: lm_loss(
             params, tokens, mask, self.n_heads, self.block_size,
             moe_aux_coef=coef)
@@ -279,10 +287,12 @@ class TransformerTrainer(AcceleratedUnit):
         if self.pipeline_stages > 0 and self._pp_mesh is None:
             from veles_tpu.parallel.pipeline import make_pipeline_mesh
             self._pp_mesh = make_pipeline_mesh(self.pipeline_stages)
-        loss_fn = self._loss_fn()
+        train_loss_fn = self._loss_fn(training=True)
+        eval_loss_fn = self._loss_fn(training=False)
 
         def train_step(params, opt_state, tokens, mask, t):
-            loss, grads = jax.value_and_grad(loss_fn)(params, tokens, mask)
+            loss, grads = jax.value_and_grad(train_loss_fn)(
+                params, tokens, mask)
             m, v = opt_state
             m = jax.tree.map(
                 lambda a, g: self.beta1 * a + (1 - self.beta1) * g,
@@ -301,7 +311,7 @@ class TransformerTrainer(AcceleratedUnit):
                                     "tokens": count}
 
         def eval_step(params, tokens, mask):
-            loss = loss_fn(params, tokens, mask)
+            loss = eval_loss_fn(params, tokens, mask)
             count = mask.sum()
             return {"loss_sum": loss * count, "tokens": count}
 

@@ -20,8 +20,6 @@ heterogeneous conv stacks — those scale with DP/TP instead).
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 
