@@ -77,7 +77,31 @@ class TestExportRoundTrip:
         from veles_tpu import export
         _, path = trained_and_artifact
         model = export.load_model(path)
-        assert all(not k.split("/")[1].startswith("v")
+        assert all(k.split("/")[1] in ("w", "b")
+                   for k in model.manifest["param_keys"])
+
+    def test_no_solver_accumulators_shipped(self, tmp_path):
+        """adagrad/adadelta accumulators are optimizer state, not model
+        parameters — the serving artifact must stay weights+biases only."""
+        from veles_tpu import export, prng
+        from veles_tpu.config import root
+        prng.reset(); prng.seed_all(3)
+        root.mnist.update({
+            "loader": {"minibatch_size": 50, "n_train": 200, "n_valid": 100},
+            "decision": {"max_epochs": 1, "fail_iterations": 50},
+            "layers": [
+                {"type": "all2all_tanh", "output_sample_shape": 16,
+                 "<-": {"learning_rate": 0.5, "solver": "adagrad"}},
+                {"type": "softmax", "output_sample_shape": 10,
+                 "<-": {"learning_rate": 0.5, "solver": "adagrad"}},
+            ],
+        })
+        from veles_tpu.samples import mnist
+        wf = mnist.train(fused=True)
+        path = str(tmp_path / "adagrad.veles")
+        export.export_model(wf, path)
+        model = export.load_model(path)
+        assert all(k.split("/")[1] in ("w", "b")
                    for k in model.manifest["param_keys"])
 
 

@@ -1,0 +1,213 @@
+"""Adaptive solvers (adagrad / adadelta) — the reference's ADADELTA-style
+per-unit optimizer options (ref: veles/znicz/nn_units.py::GradientDescentBase
+[H], SURVEY §2.3 row 1).
+
+Tier 1: adaptive_update math vs a numpy oracle; momentum mode must delegate
+bit-for-bit to sgd_update.  Tier 3: a per-layer-configured adadelta MNIST run
+converges, fused ≡ unit mode, and the accumulators survive a snapshot
+round-trip.
+"""
+
+import numpy
+import pytest
+
+from veles_tpu import prng
+from veles_tpu.config import root
+
+
+def _np_effective_grad(p, g, bs, wd, l1_vs_l2, clip):
+    g = g / max(bs, 1)
+    if clip:
+        g = numpy.clip(g, -clip, clip)
+    if wd:
+        g = g + wd * (l1_vs_l2 * numpy.sign(p) + (1 - l1_vs_l2) * p)
+    return g
+
+
+class TestAdaptiveUpdate:
+    def setup_method(self):
+        from veles_tpu.ops import functional as F
+        self.F = F
+        rng = numpy.random.RandomState(7)
+        self.p = rng.randn(6, 5).astype(numpy.float32)
+        self.g = rng.randn(6, 5).astype(numpy.float32) * 4
+        self.v = rng.rand(6, 5).astype(numpy.float32)
+        self.a = rng.rand(6, 5).astype(numpy.float32)
+
+    def test_momentum_delegates_to_sgd_update(self):
+        import jax.numpy as jnp
+        args = (jnp.asarray(self.p), jnp.asarray(self.v))
+        ref_p, ref_v = self.F.sgd_update(*args, jnp.asarray(self.g), 4,
+                                         0.05, 0.9, 0.01, 0.3, 0.5)
+        new_p, new_v, new_a = self.F.adaptive_update(
+            *args, None, jnp.asarray(self.g), 4, 0.05, 0.9, 0.01, 0.3, 0.5,
+            solver="momentum")
+        assert new_a is None
+        numpy.testing.assert_array_equal(numpy.array(ref_p),
+                                         numpy.array(new_p))
+        numpy.testing.assert_array_equal(numpy.array(ref_v),
+                                         numpy.array(new_v))
+
+    def test_adagrad_matches_numpy_oracle(self):
+        import jax.numpy as jnp
+        lr, eps, bs, wd, mix, clip = 0.1, 1e-6, 4, 0.01, 0.25, 1.0
+        new_p, new_v, new_a = self.F.adaptive_update(
+            jnp.asarray(self.p), jnp.asarray(self.v), jnp.asarray(self.a),
+            jnp.asarray(self.g), bs, lr, 0.0, wd, mix, clip,
+            solver="adagrad", epsilon=eps)
+        g = _np_effective_grad(self.p, self.g, bs, wd, mix, clip)
+        acc = self.a + g * g
+        exp_p = self.p - lr * g / numpy.sqrt(acc + eps)
+        numpy.testing.assert_allclose(numpy.array(new_a), acc, rtol=1e-6)
+        numpy.testing.assert_allclose(numpy.array(new_p), exp_p, rtol=1e-5)
+        # velocity slot passes through untouched
+        numpy.testing.assert_array_equal(numpy.array(new_v), self.v)
+
+    def test_adadelta_matches_numpy_oracle(self):
+        import jax.numpy as jnp
+        lr, rho, eps, bs = 1.0, 0.9, 1e-6, 2
+        new_p, new_v, new_a = self.F.adaptive_update(
+            jnp.asarray(self.p), jnp.asarray(self.v), jnp.asarray(self.a),
+            jnp.asarray(self.g), bs, lr, 0.0, 0.0, 0.0, None,
+            solver="adadelta", rho=rho, epsilon=eps)
+        g = self.g / bs
+        acc = rho * self.a + (1 - rho) * g * g
+        dx = -lr * numpy.sqrt(self.v + eps) / numpy.sqrt(acc + eps) * g
+        vel = rho * self.v + (1 - rho) * dx * dx
+        numpy.testing.assert_allclose(numpy.array(new_a), acc, rtol=1e-6)
+        numpy.testing.assert_allclose(numpy.array(new_p), self.p + dx,
+                                      rtol=1e-5)
+        numpy.testing.assert_allclose(numpy.array(new_v), vel, rtol=1e-6)
+
+    def test_adadelta_moves_without_lr_tuning(self):
+        """The point of adadelta: usable step sizes from lr=1.0 cold."""
+        import jax.numpy as jnp
+        p = jnp.zeros((4, 4))
+        v = jnp.zeros((4, 4))
+        a = jnp.zeros((4, 4))
+        g = jnp.ones((4, 4))
+        p, v, a = self.F.adaptive_update(p, v, a, g, 1, 1.0, 0.0, 0.0, 0.0,
+                                         None, solver="adadelta")
+        step = float(numpy.abs(numpy.array(p)).max())
+        assert 0 < step < 0.1   # small, bounded first step
+
+    def test_unknown_solver_raises(self):
+        with pytest.raises(ValueError):
+            self.F.adaptive_update(self.p, self.v, self.a, self.g, 1, 0.1,
+                                   0.0, 0.0, 0.0, None, solver="adamw")
+
+
+def _configure(solver, n_train=500, n_valid=200, max_epochs=3, lr=0.5):
+    root.mnist.update({
+        "loader": {"minibatch_size": 100, "n_train": n_train,
+                   "n_valid": n_valid},
+        "decision": {"max_epochs": max_epochs, "fail_iterations": 50},
+        "layers": [
+            {"type": "all2all_tanh", "output_sample_shape": 64,
+             "<-": {"learning_rate": lr, "solver": solver}},
+            {"type": "softmax", "output_sample_shape": 10,
+             "<-": {"learning_rate": lr, "solver": solver}},
+        ],
+    })
+
+
+class TestSolverWorkflows:
+    @pytest.mark.parametrize("solver", ["adagrad", "adadelta"])
+    def test_converges_fused(self, solver):
+        prng.reset(); prng.seed_all(42)
+        _configure(solver, lr=1.0 if solver == "adadelta" else 0.5)
+        from veles_tpu.samples import mnist
+        wf = mnist.train(fused=True)
+        metrics = wf.decision.epoch_metrics
+        losses = [m["validation"]["loss"] for m in metrics]
+        assert losses[-1] < losses[0]
+        assert metrics[-1]["validation"]["err_pct"] < 15.0
+
+    def test_fused_and_unit_mode_identical_adadelta(self):
+        from veles_tpu.samples import mnist
+        finals, weights = [], []
+        for fused in (True, False):
+            prng.reset(); prng.seed_all(42)
+            _configure("adadelta", max_epochs=2, lr=1.0)
+            wf = mnist.train(fused=fused)
+            finals.append(wf.decision.epoch_metrics[-1]["validation"])
+            wf.snapshot_state()
+            weights.append([numpy.array(f.weights.mem) for f in wf.forwards])
+        assert finals[0]["n_err"] == finals[1]["n_err"]
+        assert abs(finals[0]["loss"] - finals[1]["loss"]) < 1e-5
+        for wa, wb in zip(weights[0], weights[1]):
+            numpy.testing.assert_allclose(wa, wb, rtol=1e-6, atol=1e-7)
+
+    def test_accumulators_survive_snapshot_roundtrip(self):
+        from veles_tpu.samples import mnist
+        from veles_tpu import snapshotter as snap
+        prng.reset(); prng.seed_all(42)
+        _configure("adadelta", max_epochs=1, lr=1.0)
+        wf = mnist.train(fused=True)
+        state = wf.snapshot_state()
+        gd = wf.gds[0]
+        acc_before = numpy.array(gd.accum_weights.mem)
+        assert acc_before.any()   # training actually fed the accumulator
+        # a fresh workflow restored from the state carries the accumulators
+        prng.reset(); prng.seed_all(7)
+        _configure("adadelta", max_epochs=1, lr=1.0)
+        wf2 = mnist.build(fused=False)
+        wf2.initialize()
+        wf2.load_snapshot_state(state)
+        numpy.testing.assert_array_equal(
+            numpy.array(wf2.gds[0].accum_weights.mem), acc_before)
+        numpy.testing.assert_array_equal(
+            numpy.array(wf2.forwards[0].weights.mem),
+            numpy.array(wf.forwards[0].weights.mem))
+
+    def test_momentum_snapshot_resumes_under_adaptive_solver(self):
+        """Fine-tune flow: a snapshot trained with the default momentum
+        solver restores into an adadelta-configured workflow — the empty
+        snapshot accumulators must not clear the fresh zeros, and the
+        resumed run must train without tracing errors."""
+        from veles_tpu.samples import mnist
+        prng.reset(); prng.seed_all(42)
+        root.mnist.update({
+            "loader": {"minibatch_size": 100, "n_train": 300, "n_valid": 100},
+            "decision": {"max_epochs": 1, "fail_iterations": 50},
+            "layers": [
+                {"type": "all2all_tanh", "output_sample_shape": 32,
+                 "<-": {"learning_rate": 0.05, "momentum": 0.9}},
+                {"type": "softmax", "output_sample_shape": 10,
+                 "<-": {"learning_rate": 0.05, "momentum": 0.9}},
+            ],
+        })
+        wf = mnist.train(fused=True)
+        state = wf.snapshot_state()
+
+        prng.reset(); prng.seed_all(42)
+        root.mnist.update({
+            "decision": {"max_epochs": 2, "fail_iterations": 50},
+            "layers": [
+                {"type": "all2all_tanh", "output_sample_shape": 32,
+                 "<-": {"learning_rate": 1.0, "solver": "adadelta"}},
+                {"type": "softmax", "output_sample_shape": 10,
+                 "<-": {"learning_rate": 1.0, "solver": "adadelta"}},
+            ],
+        })
+        wf2 = mnist.build(fused=True)
+        wf2.initialize()
+        wf2.load_snapshot_state(state)
+        gd = wf2.gds[0]
+        assert not gd.accum_weights.is_empty        # zeros preserved
+        assert not numpy.array(gd.accum_weights.mem).any()
+        # momentum velocities are signed; adadelta must NOT inherit them
+        # as its E[dx^2] memory (sqrt of a negative entry -> NaN weights)
+        assert not numpy.array(gd.velocity_weights.mem).any()
+        # params DID carry over from the momentum run's snapshot
+        numpy.testing.assert_array_equal(
+            numpy.array(wf2.forwards[0].weights.mem),
+            numpy.array(wf.forwards[0].weights.mem))
+        wf2.run()                                   # trains, no trace error
+        wf2.snapshot_state()                        # sync fused state back
+        w = numpy.array(wf2.forwards[0].weights.mem)
+        assert numpy.isfinite(w).all()              # the NaN regression
+        assert numpy.array(gd.accum_weights.mem).any()
+        losses = [m["validation"]["loss"]
+                  for m in wf2.decision.epoch_metrics]
+        assert numpy.isfinite(losses).all()

@@ -47,31 +47,18 @@ class FusedRunner:
 
     # ----------------------------------------------------------------- state
     def _pull_state(self):
-        """Collect per-layer params/velocities from the unit Vectors
-        (weightless layers contribute an empty entry)."""
-        state = []
-        for fwd, gd in zip(self.forwards, self.gds):
-            if not fwd.has_params:
-                state.append({})
-                continue
-            entry = {"w": fwd.weights.devmem,
-                     "vw": gd.velocity_weights.devmem}
-            if fwd.include_bias:
-                entry["b"] = fwd.bias.devmem
-                entry["vb"] = gd.velocity_bias.devmem
-            state.append(entry)
-        return state
+        """Collect per-layer optimizer state from the unit Vectors
+        (weightless layers contribute an empty entry).  The GD unit owns
+        the entry layout — params + velocity, plus solver accumulators for
+        adagrad/adadelta (see GradientDescentBase.state_entry)."""
+        return [gd.state_entry() if fwd.has_params else {}
+                for fwd, gd in zip(self.forwards, self.gds)]
 
     def sync_to_units(self):
         """Write fused state back into the unit Vectors (for snapshots)."""
         for entry, fwd, gd in zip(self.state, self.forwards, self.gds):
-            if not fwd.has_params:
-                continue
-            fwd.weights.assign_device(entry["w"])
-            gd.velocity_weights.assign_device(entry["vw"])
-            if fwd.include_bias:
-                fwd.bias.assign_device(entry["b"])
-                gd.velocity_bias.assign_device(entry["vb"])
+            if fwd.has_params:
+                gd.absorb_entry(entry)
 
     # ----------------------------------------------------------------- steps
     def _layer_rng(self, rng, i):

@@ -61,8 +61,9 @@ def export_model(workflow, path, metadata=None):
     if runner is None:
         raise ValueError("export_model needs a fused workflow "
                          "(StandardWorkflow(..., fused=True))")
-    # inference does not need velocities — ship weights/biases only
-    state = [{k: v for k, v in entry.items() if not k.startswith("v")}
+    # inference does not need optimizer state (velocities, solver
+    # accumulators) — ship weights/biases only
+    state = [{k: v for k, v in entry.items() if k in ("w", "b")}
              for entry in runner.state]
     flat = _flatten_state(state)
     keys = list(flat)

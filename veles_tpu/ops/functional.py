@@ -585,6 +585,17 @@ def sgd_update(param, velocity, grad, batch_size, learning_rate, momentum,
         return fused_sgd_update(param, velocity, grad, batch_size,
                                 learning_rate, momentum, weight_decay,
                                 l1_vs_l2)
+    g = _effective_grad(param, grad, batch_size, weight_decay, l1_vs_l2,
+                        gradient_clip)
+    velocity = momentum * velocity - learning_rate * g
+    return param + velocity, velocity
+
+
+def _effective_grad(param, grad, batch_size, weight_decay, l1_vs_l2,
+                    gradient_clip):
+    """Batch-normalized gradient + mixed L1/L2 decay + optional clipping —
+    the preprocessing every solver shares (ref: veles/znicz/nn_units.py::
+    GradientDescentBase options [H])."""
     g = grad / jnp.maximum(batch_size, 1).astype(grad.dtype)
     if gradient_clip is not None and gradient_clip > 0.0:
         g = jnp.clip(g, -gradient_clip, gradient_clip)
@@ -592,5 +603,47 @@ def sgd_update(param, velocity, grad, batch_size, learning_rate, momentum,
         decay = (l1_vs_l2 * jnp.sign(param)
                  + (1.0 - l1_vs_l2) * param)
         g = g + weight_decay * decay
-    velocity = momentum * velocity - learning_rate * g
-    return param + velocity, velocity
+    return g
+
+
+def adaptive_update(param, velocity, accum, grad, batch_size, learning_rate,
+                    momentum, weight_decay, l1_vs_l2, gradient_clip,
+                    solver="momentum", rho=0.95, epsilon=1e-6):
+    """Per-parameter update with a selectable solver.
+
+    The reference's ``GradientDescentBase`` carried ADADELTA-style adaptive
+    options alongside plain momentum SGD (ref: veles/znicz/nn_units.py::
+    GradientDescentBase [H]); this is the TPU-side family, one pure function
+    so every solver traces into the fused step identically.
+
+    - ``momentum``: classic velocity SGD (delegates to :func:`sgd_update`,
+      which keeps the Pallas fast path).  ``accum`` is ignored.
+    - ``adagrad``: ``accum += g²``; ``param -= lr·g/√(accum+ε)``.
+      ``velocity`` is ignored.
+    - ``adadelta``: ``accum = ρ·accum+(1-ρ)·g²``;
+      ``Δx = -lr·√(velocity+ε)/√(accum+ε)·g``;
+      ``velocity = ρ·velocity+(1-ρ)·Δx²`` — the velocity slot doubles as
+      the E[Δx²] memory, so snapshots stay two-arrays-per-param.
+      ``lr`` is the reference-style global multiplier (1.0 = paper form).
+
+    Returns ``(param, velocity, accum)``; pass-through slots come back
+    unchanged so the fused state pytree keeps a static structure.
+    """
+    if solver == "momentum":
+        new_p, new_v = sgd_update(param, velocity, grad, batch_size,
+                                  learning_rate, momentum, weight_decay,
+                                  l1_vs_l2, gradient_clip)
+        return new_p, new_v, accum
+    g = _effective_grad(param, grad, batch_size, weight_decay, l1_vs_l2,
+                        gradient_clip)
+    if solver == "adagrad":
+        accum = accum + g * g
+        return (param - learning_rate * g / jnp.sqrt(accum + epsilon),
+                velocity, accum)
+    if solver == "adadelta":
+        accum = rho * accum + (1.0 - rho) * g * g
+        dx = -learning_rate * (jnp.sqrt(velocity + epsilon)
+                               / jnp.sqrt(accum + epsilon)) * g
+        velocity = rho * velocity + (1.0 - rho) * dx * dx
+        return param + dx, velocity, accum
+    raise ValueError("unknown solver %r" % (solver,))

@@ -300,13 +300,15 @@ class GradientDescentBase(AcceleratedUnit):
     Hyperparameters are per-unit (ref: veles/znicz/gd.py [H]).
     """
 
-    snapshot_attrs = ("velocity_weights", "velocity_bias", "time")
+    snapshot_attrs = ("velocity_weights", "velocity_bias", "time",
+                      "accum_weights", "accum_bias", "solver")
 
     def __init__(self, workflow, forward=None, learning_rate=0.01,
                  learning_rate_bias=None, momentum=0.0, weight_decay=0.0,
                  weight_decay_bias=0.0, l1_vs_l2=0.0, gradient_clip=None,
                  need_err_input=True, lr_policy=None, bias_lr_policy=None,
-                 weights_mask=None, **kwargs):
+                 weights_mask=None, solver="momentum", solver_rho=0.95,
+                 solver_epsilon=1e-6, **kwargs):
         super().__init__(workflow, **kwargs)
         self.forward = forward
         self.learning_rate = learning_rate
@@ -321,12 +323,25 @@ class GradientDescentBase(AcceleratedUnit):
         self.weight_decay_bias = weight_decay_bias
         self.l1_vs_l2 = l1_vs_l2
         self.gradient_clip = gradient_clip
+        #: update rule: "momentum" | "adagrad" | "adadelta" — the
+        #: reference's ADADELTA-style per-unit option set (ref:
+        #: veles/znicz/nn_units.py::GradientDescentBase [H]); per-layer
+        #: selectable via the layer config's "<-" dict like every other
+        #: hyperparameter
+        if solver not in ("momentum", "adagrad", "adadelta"):
+            raise ValueError("unknown solver %r" % (solver,))
+        self.solver = solver
+        self.solver_rho = solver_rho
+        self.solver_epsilon = solver_epsilon
         #: first trainable layer skips computing err_input (saves a GEMM,
         #: same as the reference's need_err_input flag)
         self.need_err_input = need_err_input
         self.err_input = Vector()
         self.velocity_weights = Vector()
         self.velocity_bias = Vector()
+        #: grad² accumulators (adagrad/adadelta only; empty otherwise)
+        self.accum_weights = Vector()
+        self.accum_bias = Vector()
         if forward is not None:
             self.link_attrs(forward, "weights", "bias", "input", "output")
         #: iteration counter for lr policies in unit mode (fused mode passes
@@ -355,6 +370,12 @@ class GradientDescentBase(AcceleratedUnit):
             if fwd.include_bias:
                 self.velocity_bias.reset(
                     numpy.zeros(fwd.bias.shape, self.dtype))
+        if self.solver != "momentum" and self.accum_weights.is_empty:
+            self.accum_weights.reset(
+                numpy.zeros(fwd.weights.shape, self.dtype))
+            if fwd.include_bias:
+                self.accum_bias.reset(
+                    numpy.zeros(fwd.bias.shape, self.dtype))
         self._bwd = self.jit("bwd", self.backward_fn)
         self._upd = self.jit("upd", self.update_fn)
         super().initialize(device=device, **kwargs)
@@ -382,14 +403,72 @@ class GradientDescentBase(AcceleratedUnit):
 
     def update_fused(self, entry, grads, batch_size, step=0):
         grad_w, grad_b = grads
-        new_w, new_b, new_vw, new_vb = self.update_fn(
+        new = self.update_fn(
             entry["w"], entry.get("b"), entry["vw"], entry.get("vb"),
-            grad_w, grad_b, batch_size, step)
+            grad_w, grad_b, batch_size, step,
+            entry.get("aw"), entry.get("ab"))
+        new_w, new_b, new_vw, new_vb, new_aw, new_ab = new
         new_entry = {"w": new_w, "vw": new_vw}
         if new_b is not None:
             new_entry["b"] = new_b
             new_entry["vb"] = new_vb
+        if new_aw is not None:
+            new_entry["aw"] = new_aw
+            if new_ab is not None:
+                new_entry["ab"] = new_ab
         return new_entry
+
+    #: optimizer-state slots that are only meaningful under the solver
+    #: that produced them (velocity is signed momentum under "momentum"
+    #: but the non-negative E[Δx²] memory under "adadelta" — restoring one
+    #: as the other would sqrt() negative values into NaN)
+    _SOLVER_SLOTS = ("velocity_weights", "velocity_bias",
+                     "accum_weights", "accum_bias")
+
+    def load_state_dict(self, d):
+        """Solver-migration guard for the fine-tune flow (train under one
+        solver, resume under another): optimizer state is solver-specific,
+        so when the snapshot's solver differs from the configured one the
+        params load but every optimizer slot keeps the fresh zeros from
+        initialize().  Same-solver restores stay bit-exact.  The snapshot
+        records its solver; pre-solver snapshots are momentum by
+        definition (the only rule that existed)."""
+        d = dict(d)
+        snap_solver = d.pop("solver", "momentum")
+        if snap_solver != self.solver:
+            d = {k: v for k, v in d.items() if k not in self._SOLVER_SLOTS}
+        super().load_state_dict(d)
+
+    def state_entry(self):
+        """Per-layer device-state dict for the fused/SPMD step.
+
+        Keys ending in "w" carry weight-shaped arrays, keys ending in "b"
+        bias-shaped ones (the TP sharding planner relies on this).
+        """
+        fwd = self.forward
+        entry = {"w": fwd.weights.devmem,
+                 "vw": self.velocity_weights.devmem}
+        if fwd.include_bias:
+            entry["b"] = fwd.bias.devmem
+            entry["vb"] = self.velocity_bias.devmem
+        if not self.accum_weights.is_empty:
+            entry["aw"] = self.accum_weights.devmem
+            if fwd.include_bias:
+                entry["ab"] = self.accum_bias.devmem
+        return entry
+
+    def absorb_entry(self, entry):
+        """Write a fused/SPMD state entry back into the unit Vectors."""
+        fwd = self.forward
+        fwd.weights.assign_device(entry["w"])
+        self.velocity_weights.assign_device(entry["vw"])
+        if fwd.include_bias:
+            fwd.bias.assign_device(entry["b"])
+            self.velocity_bias.assign_device(entry["vb"])
+        if "aw" in entry:
+            self.accum_weights.assign_device(entry["aw"])
+            if fwd.include_bias:
+                self.accum_bias.assign_device(entry["ab"])
 
     def _live_lrs(self, step):
         """(lr_weights, lr_bias) — constants, or policy curves of the traced
@@ -406,22 +485,24 @@ class GradientDescentBase(AcceleratedUnit):
         return lr_w, lr_b
 
     def update_fn(self, weights, bias, vel_w, vel_b, grad_w, grad_b,
-                  batch_size, step=0):
+                  batch_size, step=0, acc_w=None, acc_b=None):
         lr_w, lr_b = self._live_lrs(step)
-        new_w, new_vw = F.sgd_update(
-            weights, vel_w, grad_w, batch_size, lr_w,
+        new_w, new_vw, new_aw = F.adaptive_update(
+            weights, vel_w, acc_w, grad_w, batch_size, lr_w,
             self.momentum, self.weight_decay, self.l1_vs_l2,
-            self.gradient_clip)
+            self.gradient_clip, self.solver, self.solver_rho,
+            self.solver_epsilon)
         if self.weights_mask is not None:
             import jax.numpy as jnp
             new_w = new_w * jnp.asarray(self.weights_mask, new_w.dtype)
         if grad_b is None:
-            return new_w, None, new_vw, None
-        new_b, new_vb = F.sgd_update(
-            bias, vel_b, grad_b, batch_size, lr_b,
+            return new_w, None, new_vw, None, new_aw, None
+        new_b, new_vb, new_ab = F.adaptive_update(
+            bias, vel_b, acc_b, grad_b, batch_size, lr_b,
             self.momentum, self.weight_decay_bias, self.l1_vs_l2,
-            self.gradient_clip)
-        return new_w, new_b, new_vw, new_vb
+            self.gradient_clip, self.solver, self.solver_rho,
+            self.solver_epsilon)
+        return new_w, new_b, new_vw, new_vb, new_aw, new_ab
 
     def run(self):
         import jax.numpy as jnp
@@ -432,16 +513,24 @@ class GradientDescentBase(AcceleratedUnit):
             fwd.bias.devmem if fwd.include_bias else None)
         if self.need_err_input:
             self.err_input.assign_device(err_in)
-        new_w, new_b, new_vw, new_vb = self._upd(
+        adaptive = not self.accum_weights.is_empty
+        new_w, new_b, new_vw, new_vb, new_aw, new_ab = self._upd(
             self.weights.devmem,
             fwd.bias.devmem if fwd.include_bias else None,
             self.velocity_weights.devmem,
             self.velocity_bias.devmem if fwd.include_bias else None,
             grad_w, grad_b, jnp.asarray(int(self.batch_size)),
-            jnp.asarray(self.time, jnp.int32))
+            jnp.asarray(self.time, jnp.int32),
+            self.accum_weights.devmem if adaptive else None,
+            self.accum_bias.devmem
+            if adaptive and fwd.include_bias else None)
         self.time += 1
         fwd.weights.assign_device(new_w)
         self.velocity_weights.assign_device(new_vw)
         if fwd.include_bias:
             fwd.bias.assign_device(new_b)
             self.velocity_bias.assign_device(new_vb)
+        if new_aw is not None:
+            self.accum_weights.assign_device(new_aw)
+            if new_ab is not None:
+                self.accum_bias.assign_device(new_ab)

@@ -90,10 +90,11 @@ class ShardedTrainer:
                 b = NamedSharding(mesh, P("model"))
             else:
                 w = b = self._repl
-            spec = {"w": w, "vw": w}
-            if "b" in entry:
-                spec["b"] = b
-                spec["vb"] = b
+            # optimizer state shards with the array it accompanies: keys
+            # ending in "w" are weight-shaped (w, vw, aw), keys ending in
+            # "b" bias-shaped (b, vb, ab) — GradientDescentBase.state_entry
+            # guarantees the convention
+            spec = {k: (w if k.endswith("w") else b) for k in entry}
             shardings.append(spec)
         self.state_shardings = shardings
         #: global train-step counter (lr policies); see train_step
