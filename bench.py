@@ -451,24 +451,31 @@ def bench_numpy_floor(wf, min_seconds=3.0):
     return done_samples / (time.perf_counter() - begin)
 
 
-def main():
-    parser = argparse.ArgumentParser()
-    parser.add_argument("--smoke", action="store_true",
-                        help="tiny sizes on CPU for CI validation")
-    parser.add_argument("--configs",
-                        default="mnist,cifar,alexnet,sgd,records,convergence",
-                        help="comma list: mnist,cifar,alexnet,sgd,records,convergence")
-    parser.add_argument("--seconds", type=float, default=None,
-                        help="target seconds per timing window")
-    args = parser.parse_args()
-    wanted = [c.strip() for c in args.configs.split(",") if c.strip()]
-    known = ("mnist", "cifar", "alexnet", "sgd", "records",
-             "convergence")
-    unknown = [c for c in wanted if c not in known]
-    if unknown or not wanted:
-        parser.error("unknown configs %r (choose from %s)"
-                     % (unknown, ", ".join(known)))
+KNOWN_CONFIGS = ("mnist", "cifar", "alexnet", "sgd", "records",
+                 "convergence")
 
+
+def probe_device(timeout_s=None):
+    """Tiny compile+fetch under a hard deadline.  A wedged TPU-tunnel relay
+    makes any dispatch hang FOREVER (observed for hours in round 4), so
+    the probe runs on a daemon thread and the caller gives up on it."""
+    import threading
+    probe_ok = []
+
+    def _probe():
+        import jax
+        probe_ok.append(_sync(jax.jit(lambda a: a + 1)(numpy.ones(2))))
+
+    probe = threading.Thread(target=_probe, daemon=True)
+    probe.start()
+    probe.join(timeout=timeout_s if timeout_s is not None
+               else float(os.environ.get("VELES_BENCH_PROBE_S", 300)))
+    return bool(probe_ok)
+
+
+def run_configs(wanted, args):
+    """Run the wanted bench configs in THIS process; returns the results
+    dict (per-config records and/or ``<name>_error`` entries)."""
     if args.smoke:
         import jax
         jax.config.update("jax_platforms", "cpu")
@@ -482,30 +489,9 @@ def main():
         alex_kwargs = {}
         target, floor_seconds = args.seconds or 4.0, 3.0
 
-    # Device watchdog: a wedged TPU-tunnel relay makes the first dispatch
-    # hang FOREVER (round 4 observed this for hours).  Probe with a tiny
-    # fetch under a hard deadline so a dead device yields the one-line
-    # JSON record instead of a silent hang.
-    import threading
-    probe_ok = []
-
-    def _probe():
-        import jax
-        probe_ok.append(_sync(jax.jit(lambda a: a + 1)(numpy.ones(2))))
-
-    probe = threading.Thread(target=_probe, daemon=True)
-    probe.start()
-    probe.join(timeout=float(os.environ.get("VELES_BENCH_PROBE_S", 300)))
-    if not probe_ok:
-        print(json.dumps({
-            "metric": "bench_failed",
-            "value": None,
-            "unit": "",
-            "vs_baseline": None,
-            "configs": {"error": "device probe did not complete — "
-                                 "TPU tunnel unreachable"},
-        }))
-        return 1
+    if not probe_device():
+        return {"error": "device probe did not complete — "
+                         "TPU tunnel unreachable"}
 
     device_kind, peak = _peak_tflops()
     results = {}
@@ -603,6 +589,11 @@ def main():
     if "records" in wanted:
         guarded("records", _bench_recs)
 
+    return results
+
+
+def emit_summary(results):
+    """Print the ONE JSON line the driver records; returns the exit code."""
     model_results = [k for k in results
                      if isinstance(results[k], dict)
                      and "samples_per_sec" in results[k]
@@ -656,6 +647,115 @@ def main():
         }))
         return 1
     return 0
+
+
+def orchestrate(wanted, args, argv):
+    """Run each config in its own subprocess under a hard deadline.
+
+    Round-4 lesson: a tunnel that dies MID-RUN leaves the next XLA compile
+    hanging forever inside a C++ call no in-process guard can interrupt —
+    the whole bench then gets killed from outside without ever printing
+    its JSON line.  Per-config worker processes bound the damage: a hung
+    config is killed and recorded as an error, the rest still run, and the
+    one-line contract always holds.  Workers run STRICTLY sequentially
+    (the TPU tunnel admits one client at a time) and the parent never
+    imports jax (an idle client could hold the tunnel claim).
+    """
+    import subprocess
+    per_config = float(os.environ.get(
+        "VELES_BENCH_CONFIG_TIMEOUT_S", 300 if args.smoke else 1500))
+    results = {}
+    tunnel_dead = False
+    for name in wanted:
+        if tunnel_dead:
+            results[name + "_error"] = ("skipped: device unreachable "
+                                        "after an earlier config hung")
+            continue
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--worker", name] + argv
+        try:
+            proc = subprocess.run(cmd, stdout=subprocess.PIPE,
+                                  timeout=per_config)
+            line = proc.stdout.decode(errors="replace").strip().splitlines()
+            got = (json.loads(line[-1])["results"] if line
+                   else {name + "_error":
+                         "worker produced no output (rc=%s)"
+                         % proc.returncode})
+            if "error" in got:   # in-worker probe never came back
+                got = {name + "_error": got.pop("error"), **got}
+                tunnel_dead = True
+            results.update(got)
+        except subprocess.TimeoutExpired:
+            results[name + "_error"] = ("killed after %.0fs (hung device "
+                                        "dispatch/compile)" % per_config)
+            # a killed-mid-claim client can wedge the relay for a while;
+            # don't hang every remaining config behind the same wall.
+            # The probe worker's deadline is pinned via the env var so the
+            # parent's subprocess timeout is always the longer one (an
+            # operator-set VELES_BENCH_PROBE_S must not outlive it), and
+            # any probe failure mode just means "treat the tunnel as dead".
+            try:
+                env = dict(os.environ, VELES_BENCH_PROBE_S="120")
+                probe = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__),
+                     "--worker", "__probe__"] + argv,
+                    stdout=subprocess.PIPE, timeout=180, env=env,
+                    check=False)
+                out = (probe.stdout.decode(errors="replace")
+                       .strip().splitlines())
+                tunnel_dead = not (out and json.loads(out[-1]).get("ok"))
+            except Exception:
+                tunnel_dead = True
+        except Exception as exc:   # worker crash / bad output
+            results[name + "_error"] = "worker failed: %r" % (exc,)
+    return results
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--smoke", action="store_true",
+                        help="tiny sizes on CPU for CI validation")
+    parser.add_argument("--configs",
+                        default="mnist,cifar,alexnet,sgd,records,convergence",
+                        help="comma list: " + ",".join(KNOWN_CONFIGS))
+    parser.add_argument("--seconds", type=float, default=None,
+                        help="target seconds per timing window")
+    parser.add_argument("--in-process", action="store_true",
+                        help="run all configs in this process (no "
+                             "per-config watchdog subprocesses)")
+    parser.add_argument("--worker", default=None, metavar="CONFIG",
+                        help=argparse.SUPPRESS)   # internal: one config
+    args = parser.parse_args()
+
+    if args.worker == "__probe__":
+        print(json.dumps({"ok": probe_device(
+            float(os.environ.get("VELES_BENCH_PROBE_S", 120)))}))
+        return 0
+    if args.worker is not None:
+        results = run_configs([args.worker], args)
+        print(json.dumps({"worker": args.worker, "results": results}))
+        return 0
+
+    wanted = [c.strip() for c in args.configs.split(",") if c.strip()]
+    unknown = [c for c in wanted if c not in KNOWN_CONFIGS]
+    if unknown or not wanted:
+        parser.error("unknown configs %r (choose from %s)"
+                     % (unknown, ", ".join(KNOWN_CONFIGS)))
+
+    # --smoke forces CPU, where a wedged-tunnel hang cannot occur — run in
+    # process and skip paying one python+jax cold start per config
+    if args.in_process or args.smoke:
+        results = run_configs(wanted, args)
+        if set(results) == {"error"}:   # probe never came back
+            print(json.dumps({"metric": "bench_failed", "value": None,
+                              "unit": "", "vs_baseline": None,
+                              "configs": results}))
+            return 1
+    else:
+        argv = (["--smoke"] if args.smoke else []) + \
+            (["--seconds", str(args.seconds)] if args.seconds else [])
+        results = orchestrate(wanted, args, argv)
+    return emit_summary(results)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,41 @@
+"""Lines sample (generated geometric dataset, SURVEY §2.3 samples row)."""
+
+import numpy
+
+from veles_tpu import prng
+from veles_tpu.config import root
+
+
+def _configure(n_train=800, n_valid=200, max_epochs=4):
+    root.__dict__.pop("lines", None)
+    from veles_tpu.samples.lines import default_config
+    default_config()
+    root.lines.update({
+        "loader": {"minibatch_size": 100, "n_train": n_train,
+                   "n_valid": n_valid},
+        "decision": {"max_epochs": max_epochs, "fail_iterations": 20},
+    })
+
+
+def test_draw_lines_shapes_and_classes():
+    from veles_tpu.samples.lines import draw_lines, N_CLASSES
+    stream = prng.get("t_lines", pinned=True)
+    data, labels = draw_lines(stream, 64, hw=16)
+    assert data.shape == (64, 16, 16, 1)
+    assert data.dtype == numpy.float32
+    assert data.min() >= -1.0 and data.max() <= 1.0
+    assert set(labels.tolist()) == set(range(N_CLASSES))
+    # horizontal-class images vary along y much more than along x
+    h = data[labels == 0, :, :, 0]
+    assert h.mean(axis=(0, 2)).std() > h.mean(axis=(0, 1)).std()
+
+
+def test_lines_converges_fused():
+    prng.reset(); prng.seed_all(5)
+    _configure()
+    from veles_tpu.samples import lines
+    wf = lines.train(fused=True)
+    metrics = wf.decision.epoch_metrics
+    errs = [m["validation"]["err_pct"] for m in metrics]
+    assert errs[-1] < 15.0, errs          # orientation is nearly separable
+    assert errs[-1] < errs[0]

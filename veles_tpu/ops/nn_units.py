@@ -333,6 +333,10 @@ class GradientDescentBase(AcceleratedUnit):
         self.solver = solver
         self.solver_rho = solver_rho
         self.solver_epsilon = solver_epsilon
+        if solver != "momentum" and momentum:
+            # never drop an explicit setting silently
+            self.warning("momentum=%g is inert under solver=%r",
+                         momentum, solver)
         #: first trainable layer skips computing err_input (saves a GEMM,
         #: same as the reference's need_err_input flag)
         self.need_err_input = need_err_input
