@@ -1,0 +1,36 @@
+"""VideoAE sample (synthetic footage autoencoder, SURVEY §2.3 samples)."""
+
+import numpy
+
+from veles_tpu import prng
+from veles_tpu.config import root
+
+
+def test_synth_video_temporal_structure():
+    from veles_tpu.samples.video_ae import synth_video
+    stream = prng.get("t_video", pinned=True)
+    frames = synth_video(stream, n_sequences=4, frames_per_seq=6, hw=20)
+    assert frames.shape == (24, 20, 20, 1)
+    assert frames.dtype == numpy.float32
+    # adjacent frames of one sequence are closer than frames of
+    # different sequences (the blob moves smoothly within a sequence)
+    seq = frames[:6, :, :, 0]
+    adjacent = numpy.abs(seq[1:] - seq[:-1]).mean()
+    across = numpy.abs(frames[0, :, :, 0] - frames[6, :, :, 0]).mean()
+    assert adjacent < across
+
+
+def test_video_ae_reconstruction_improves():
+    prng.reset(); prng.seed_all(9)
+    root.__dict__.pop("video_ae", None)
+    from veles_tpu.samples import video_ae
+    video_ae.default_config()
+    root.video_ae.update({
+        "loader": {"minibatch_size": 50, "n_train": 400, "n_valid": 96},
+        "decision": {"max_epochs": 4, "fail_iterations": 20},
+    })
+    wf = video_ae.train(fused=True)
+    losses = [m["validation"]["loss"]
+              for m in wf.decision.epoch_metrics]
+    assert losses[-1] < losses[0]          # reconstruction MSE decreases
+    assert numpy.isfinite(losses).all()
