@@ -1,13 +1,21 @@
-"""Worker process for the multi-host SPMD test (tests/test_multihost.py).
+"""Worker process for the multi-host SPMD tests (tests/test_multihost.py).
 
 Each of N processes owns 4 virtual CPU devices; together they form one
 8-device global mesh — the process-level analogue of the reference's
 loopback master/slave tests (SURVEY §4 test_client_server.py).  Every
 process builds the identical workflow (same seed, pinned data stream),
-shards the loader by its process index, feeds its LOCAL batch rows, and
-runs lock-step SPMD train steps whose gradient averaging is the GSPMD
-all-reduce.  Per-step metrics are printed as JSON for the parent test to
-compare across processes and against a single-process reference run.
+shards the loader by its mesh-derived data block, feeds its LOCAL batch
+rows, and runs lock-step SPMD train steps whose gradient averaging is
+the GSPMD all-reduce.  Per-step metrics are printed as JSON for the
+parent test to compare across processes and against a single-process
+reference run.
+
+Modes (argv[4], default "dp"):
+- ``dp``  — blocked mesh (data, 1): pure data parallelism by process.
+- ``tp``  — interleaved mesh (4, 2) whose MODEL axis spans the two
+  processes (megatron-style cross-host TP): layer 0 is output-sharded,
+  every process loads the full batch (spmd_loader_shard returns one
+  block), and parameter shards are cut per-device from the local copy.
 """
 
 import json
@@ -15,7 +23,23 @@ import os
 import sys
 
 
-def main(coordinator, num_processes, process_id, steps=3):
+def build_mesh(mode, n_procs):
+    import jax
+    import numpy
+    from jax.sharding import Mesh
+    devices = jax.devices()
+    if mode == "dp":
+        from veles_tpu.parallel import make_mesh
+        return make_mesh(len(devices), devices=devices)
+    # tp: model axis across processes — column c of every row lives on
+    # process c (devices are enumerated process-major)
+    per = len(devices) // n_procs
+    grid = numpy.array([[devices[p * per + r] for p in range(n_procs)]
+                        for r in range(per)])
+    return Mesh(grid, ("data", "model"))
+
+
+def main(coordinator, num_processes, process_id, mode="dp", steps=3):
     os.environ["XLA_FLAGS"] = (
         os.environ.get("XLA_FLAGS", "")
         + " --xla_force_host_platform_device_count=4").strip()
@@ -30,7 +54,7 @@ def main(coordinator, num_processes, process_id, steps=3):
     import numpy
     from veles_tpu import prng
     from veles_tpu.config import root
-    from veles_tpu.parallel import make_mesh, ShardedTrainer
+    from veles_tpu.parallel import ShardedTrainer, spmd_loader_shard
 
     prng.reset()
     prng.seed_all(1)
@@ -46,17 +70,30 @@ def main(coordinator, num_processes, process_id, steps=3):
     })
     from veles_tpu.samples import mnist
     wf = mnist.build(fused=True)
-    # SPMD loader sharding — every process plans the same global minibatch
-    # sequence and yields its contiguous local rows (SURVEY §5.8: the
-    # reference's index shipping, collapsed into deterministic sharding)
-    wf.loader.shard_spmd(jax.process_index(), jax.process_count())
+    mesh = build_mesh(mode, num_processes)
+    # SPMD loader sharding from the mesh layout — every process plans the
+    # same global minibatch sequence and yields the rows its
+    # data-coordinates cover (SURVEY §5.8: the reference's index
+    # shipping, collapsed into deterministic sharding).  Under "tp" the
+    # model axis spans processes, so there is ONE data block and every
+    # process loads the full batch.
+    shard_idx, shard_cnt = spmd_loader_shard(mesh)
+    wf.loader.shard_spmd(shard_idx, shard_cnt)
     wf.initialize()
     loader = wf.loader
-    assert loader.local_minibatch_size == 32 // num_processes
+    assert loader.local_minibatch_size == 32 // shard_cnt
+    if mode == "tp":
+        assert shard_cnt == 1    # full batch everywhere
 
-    mesh = make_mesh(4 * num_processes, devices=jax.devices())
-    trainer = ShardedTrainer(wf._fused_runner, mesh)
+    trainer = ShardedTrainer(
+        wf._fused_runner, mesh,
+        model_shard_layers=[0] if mode == "tp" else ())
     assert trainer.multiprocess
+    if mode == "tp":
+        # layer 0's weights really are split over the cross-process axis
+        w = trainer.state[0]["w"]
+        assert not w.is_fully_addressable
+        assert w.addressable_data(0).shape[-1] == 16 // num_processes
 
     from veles_tpu.loader.base import TRAIN
     out = []
@@ -77,4 +114,5 @@ def main(coordinator, num_processes, process_id, steps=3):
 
 
 if __name__ == "__main__":
-    main(sys.argv[1], int(sys.argv[2]), int(sys.argv[3]))
+    main(sys.argv[1], int(sys.argv[2]), int(sys.argv[3]),
+         sys.argv[4] if len(sys.argv) > 4 else "dp")

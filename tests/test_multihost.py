@@ -5,9 +5,15 @@ in-process on localhost (SURVEY §4, test_client_server.py [M]); the
 TPU-native analogue is N jax processes joined by
 ``jax.distributed.initialize`` over 127.0.0.1, each owning 4 virtual CPU
 devices of one 8-device mesh.  Asserts (1) both processes compute
-IDENTICAL per-step metrics — the all-reduce really spans processes — and
+IDENTICAL per-step metrics — the collectives really span processes — and
 (2) those metrics equal a single-process run on the same global batches,
-i.e. multi-host changes the wiring, not the math.
+i.e. multi-host changes the wiring, not the math.  Covered layouts:
+
+- ``dp``: blocked mesh, batch split by process (the reference's only
+  strategy, rebuilt as GSPMD all-reduce);
+- ``tp``: interleaved mesh whose MODEL axis spans the two processes —
+  megatron-style cross-host tensor parallelism, with layer-0 weights
+  output-sharded across hosts and the batch replicated.
 """
 
 import json
@@ -17,6 +23,7 @@ import subprocess
 import sys
 
 import numpy
+import pytest
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
@@ -45,13 +52,13 @@ def _parse_metrics(stdout):
     raise AssertionError("no METRICS line in worker output:\n" + stdout)
 
 
-def test_two_process_spmd_matches_single_process():
+def _run_workers(mode):
     port = _free_port()
     coordinator = "127.0.0.1:%d" % port
     procs = [
         subprocess.Popen(
             [sys.executable, os.path.join(HERE, "multihost_worker.py"),
-             coordinator, "2", str(pid)],
+             coordinator, "2", str(pid), mode],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
             env=_worker_env(), cwd=REPO)
         for pid in range(2)
@@ -69,15 +76,22 @@ def test_two_process_spmd_matches_single_process():
             if p.poll() is None:
                 p.kill()
                 p.wait()
+    return outs
 
-    # (1) both processes saw the same replicated metrics each step
-    assert outs[0] == outs[1]
-    assert len(outs[0]) == 3
 
-    # (2) equal to the single-process reference on the same global batches
+import functools
+
+
+@functools.lru_cache(maxsize=1)
+def _single_process_reference(steps=3):
+    """Expected per-step metrics from a single-process run on the same
+    global batches (global plan, same PRNG → same minibatch order).
+    Cached: the reference is mode-independent, so the dp and tp
+    parametrizations share one build+compile+train."""
     from veles_tpu import prng
     from veles_tpu.config import root
     from veles_tpu.parallel import make_mesh, ShardedTrainer
+    from veles_tpu.loader.base import TRAIN
     prng.reset()
     prng.seed_all(1)
     root.mnist.update({
@@ -91,17 +105,16 @@ def test_two_process_spmd_matches_single_process():
         ],
     })
     from veles_tpu.samples import mnist
-    from veles_tpu.loader.base import TRAIN
     wf = mnist.build(fused=True)
-    wf.initialize()     # NOT sharded: global plan, same PRNG → same order
+    wf.initialize()
     import jax
     mesh = make_mesh(8, devices=jax.devices("cpu"))
     trainer = ShardedTrainer(wf._fused_runner, mesh)
     assert not trainer.multiprocess
 
     loader = wf.loader
-    step = 0
-    while step < 3:
+    expect, step = [], 0
+    while step < steps:
         loader.run()
         if loader.minibatch_class != TRAIN:
             continue
@@ -111,8 +124,37 @@ def test_two_process_spmd_matches_single_process():
             numpy.asarray(loader.minibatch_mask.mem),
             loader.minibatch_size, step=step)
         host = ShardedTrainer.fetch(metrics)
-        expect = {k: float(numpy.ravel(v)[0]) for k, v in host.items()}
+        expect.append({k: float(numpy.ravel(v)[0]) for k, v in host.items()})
+        step += 1
+    return expect
+
+
+@pytest.mark.parametrize("mode", ["dp", "tp"])
+def test_two_process_spmd_matches_single_process(mode):
+    outs = _run_workers(mode)
+
+    # (1) both processes saw the same replicated metrics each step
+    assert outs[0] == outs[1]
+    assert len(outs[0]) == 3
+
+    # (2) equal to the single-process reference on the same global batches
+    for step, expect in enumerate(_single_process_reference()):
         for key, val in expect.items():
             assert abs(outs[0][step][key] - val) <= 1e-4 * (1 + abs(val)), (
-                step, key, outs[0][step][key], val)
-        step += 1
+                mode, step, key, outs[0][step][key], val)
+
+
+def test_spmd_loader_shard_single_process_collapses():
+    """All devices in one process → one data block, full batch locally;
+    the data axis is found by NAME, not position."""
+    import jax
+    import pytest as _pytest
+    from jax.sharding import Mesh
+    from veles_tpu.parallel import spmd_loader_shard
+    devices = jax.devices("cpu")[:8]
+    blocked = Mesh(numpy.array(devices).reshape(4, 2), ("data", "model"))
+    assert spmd_loader_shard(blocked) == (0, 1)
+    swapped = Mesh(numpy.array(devices).reshape(2, 4), ("model", "data"))
+    assert spmd_loader_shard(swapped) == (0, 1)
+    with _pytest.raises(ValueError):
+        spmd_loader_shard(Mesh(numpy.array(devices[:2]), ("model",)))

@@ -67,11 +67,6 @@ class ShardedTrainer:
         #: of device_put (which requires every device to be addressable)
         self.multiprocess = len({d.process_index
                                  for d in mesh.devices.flat}) > 1
-        if self.multiprocess and model_shard_layers:
-            raise NotImplementedError(
-                "model-axis sharding across processes is not supported: "
-                "keep the model axis within a host (the standard TPU "
-                "layout) and span hosts with the data axis only")
         self._repl = NamedSharding(mesh, P())
         self._batch = NamedSharding(mesh, P("data"))
         shardings = []
@@ -110,26 +105,40 @@ class ShardedTrainer:
         self._eval = jax.jit(runner._eval_step)
 
     def _put(self, arr, sharding):
+        """Place PARAMETER/OPTIMIZER state.  Multi-process: every process
+        builds the identical full host value (same seed, pinned streams),
+        so each device's shard is cut from the local full copy by global
+        index — which supports ANY sharding, including a model axis that
+        spans processes (megatron-style TP across hosts rides the same
+        path as within-host TP)."""
         import jax
         if arr is None:
             return None
         if self.multiprocess:
-            return jax.make_array_from_process_local_data(
-                sharding, numpy.asarray(arr))
+            host = numpy.asarray(arr)
+            return jax.make_array_from_callback(
+                host.shape, sharding, lambda idx: host[idx])
         return jax.device_put(arr, sharding)
 
     def put_batch(self, x, labels, mask):
         """Shard one (padded, static-shape) minibatch over the data axis.
 
         Single-process: the arrays are GLOBAL and device_put splits them.
-        Multi-process: each process passes its LOCAL rows — its contiguous
-        slice of the global batch in process order, exactly what
-        ``Loader.shard_spmd`` yields — and the global array is assembled
-        with ``jax.make_array_from_process_local_data``.
+        Multi-process: each process passes its LOCAL rows — the slice of
+        the global batch its data-coordinates cover, exactly what
+        ``Loader.shard_spmd`` yields when driven by
+        :func:`spmd_loader_shard` (processes that share data-coordinates,
+        i.e. a cross-process model axis, pass identical rows) — and the
+        global array is assembled with
+        ``jax.make_array_from_process_local_data``.
         """
-        return (self._put(x, self._batch),
-                self._put(labels, self._batch),
-                self._put(mask, self._batch))
+        import jax
+        if self.multiprocess:
+            put = (lambda a: jax.make_array_from_process_local_data(
+                self._batch, numpy.asarray(a)))
+        else:
+            put = lambda a: jax.device_put(a, self._batch)
+        return put(x), put(labels), put(mask)
 
     def train_step(self, x, labels, mask, batch_size, rng=None, step=None):
         """One SPMD train step; ``step`` defaults to an internal counter so
@@ -170,6 +179,46 @@ class ShardedTrainer:
         self.runner.state = jax.tree.map(jax.numpy.asarray,
                                          self.fetch(self.state))
         self.runner.sync_to_units()
+
+
+def spmd_loader_shard(mesh):
+    """(shard_index, shard_count) for ``Loader.shard_spmd`` derived from
+    the mesh layout, generalizing "shard by process" to meshes whose
+    ``model`` axis spans processes.
+
+    The batch is sharded over the ``data`` axis only, so the rows a
+    process must load are determined by which data-coordinates its
+    devices cover: processes covering the same block of data-coordinates
+    (they sit on different ``model`` columns of the same rows) must load
+    IDENTICAL rows — the input replication a cross-host tensor-parallel
+    layout requires.  Falls back to the familiar (process_index,
+    process_count) on the standard blocked layout, where each process
+    owns its own data block.
+    """
+    import jax
+    if "data" not in mesh.axis_names:
+        raise ValueError("mesh has no 'data' axis (axes: %r)"
+                         % (mesh.axis_names,))
+    # blocks are computed over the DATA axis wherever it sits in the
+    # grid (put_batch shards by axis name, so position must not matter)
+    grid = numpy.moveaxis(mesh.devices,
+                          mesh.axis_names.index("data"), 0)
+    grid = grid.reshape(grid.shape[0], -1)
+    rows_of = {}
+    for p in {d.process_index for d in grid.flat}:
+        rows = tuple(sorted({r for r in range(grid.shape[0])
+                             if any(d.process_index == p
+                                    for d in grid[r].flat)}))
+        rows_of[p] = rows
+    blocks = sorted(set(rows_of.values()), key=lambda t: t[0])
+    flat = [r for b in blocks for r in b]
+    if flat != list(range(grid.shape[0])) or \
+            len({len(b) for b in blocks}) != 1:
+        raise ValueError(
+            "mesh data-axis layout is not a contiguous equal partition "
+            "across processes (blocks: %r) — deterministic loader "
+            "sharding needs one; reorder the device grid" % (blocks,))
+    return blocks.index(rows_of[jax.process_index()]), len(blocks)
 
 
 def initialize_multihost(coordinator_address=None, num_processes=None,
