@@ -22,6 +22,8 @@ import socket
 import subprocess
 import sys
 
+import functools
+
 import numpy
 import pytest
 
@@ -77,9 +79,6 @@ def _run_workers(mode):
                 p.kill()
                 p.wait()
     return outs
-
-
-import functools
 
 
 @functools.lru_cache(maxsize=1)
@@ -148,7 +147,6 @@ def test_spmd_loader_shard_single_process_collapses():
     """All devices in one process → one data block, full batch locally;
     the data axis is found by NAME, not position."""
     import jax
-    import pytest as _pytest
     from jax.sharding import Mesh
     from veles_tpu.parallel import spmd_loader_shard
     devices = jax.devices("cpu")[:8]
@@ -156,5 +154,5 @@ def test_spmd_loader_shard_single_process_collapses():
     assert spmd_loader_shard(blocked) == (0, 1)
     swapped = Mesh(numpy.array(devices).reshape(2, 4), ("model", "data"))
     assert spmd_loader_shard(swapped) == (0, 1)
-    with _pytest.raises(ValueError):
+    with pytest.raises(ValueError):
         spmd_loader_shard(Mesh(numpy.array(devices[:2]), ("model",)))

@@ -219,3 +219,76 @@ def test_loader_host_sharding_composes_with_mesh():
         for cls, chunk, actual in wf.loader._order:
             seen.update(chunk[:actual].tolist())
     assert seen == set(range(160))
+
+
+def test_sharded_epoch_scan_matches_per_step_spmd():
+    """ShardedTrainer.train_epoch (one dispatch per epoch, plan matrices
+    sharded over the data axis) equals the per-minibatch SPMD path and
+    works with a TP layer in the same plan."""
+    from veles_tpu.loader.base import TRAIN
+
+    def plan(loader):
+        loader._plan_epoch()
+        idx = numpy.stack([c for cls, c, a in loader._order
+                           if cls == TRAIN])
+        mask = numpy.stack([
+            (numpy.arange(len(c)) < a).astype(numpy.float32)
+            for cls, c, a in loader._order if cls == TRAIN])
+        return idx, mask
+
+    # per-minibatch SPMD trajectory
+    prng.reset(); prng.seed_all(17)
+    wf_a = _build(mb=64)
+    runner_a = wf_a._fused_runner
+    mesh = make_mesh(8, model_parallel=2)
+    trainer_a = ShardedTrainer(runner_a, mesh, model_shard_layers=(0,))
+    data = numpy.asarray(wf_a.loader.original_data.mem)
+    labels = numpy.asarray(wf_a.loader.original_labels.mem)
+    idx, mask = plan(wf_a.loader)
+    for i in range(idx.shape[0]):
+        trainer_a.train_step(data[idx[i]], labels[idx[i]], mask[i],
+                             int(mask[i].sum()), step=i)
+
+    # epoch-scan SPMD trajectory from the same init and plan
+    prng.reset(); prng.seed_all(17)
+    wf_b = _build(mb=64)
+    runner_b = wf_b._fused_runner
+    trainer_b = ShardedTrainer(runner_b, mesh, model_shard_layers=(0,))
+    idx_b, mask_b = plan(wf_b.loader)
+    numpy.testing.assert_array_equal(idx, idx_b)   # same PRNG -> same plan
+    trainer_b.place_dataset(data, labels)
+    totals = trainer_b.train_epoch(idx_b, mask_b, step0=0)
+    assert trainer_b.step_count == idx.shape[0]
+
+    for ea, eb in zip(trainer_a.state, trainer_b.state):
+        for key in ea:
+            numpy.testing.assert_allclose(
+                numpy.asarray(ea[key]), numpy.asarray(eb[key]),
+                rtol=2e-5, atol=2e-6)
+    # TP layer stayed sharded through the scan (out_shardings pinned)
+    assert not trainer_b.state[0]["w"].sharding.is_fully_replicated
+
+    # eval_epoch totals match summing per-step eval metrics
+    totals_eval = trainer_b.eval_epoch(idx_b, mask_b)
+    per = None
+    for i in range(idx.shape[0]):
+        m = trainer_b.eval_step(data[idx[i]], labels[idx[i]], mask[i])
+        host = ShardedTrainer.fetch(m)
+        per = (host if per is None else
+               {k: per[k] + host[k] for k in per})
+    host_tot = ShardedTrainer.fetch(totals_eval)
+    for k in host_tot:
+        numpy.testing.assert_allclose(numpy.ravel(host_tot[k]),
+                                      numpy.ravel(per[k]), rtol=1e-5)
+
+
+def test_epoch_scan_requires_divisible_minibatch():
+    prng.reset(); prng.seed_all(17)
+    wf = _build(mb=64)
+    trainer = ShardedTrainer(wf._fused_runner, make_mesh(8))
+    trainer.place_dataset(numpy.asarray(wf.loader.original_data.mem),
+                          numpy.asarray(wf.loader.original_labels.mem))
+    bad_idx = numpy.zeros((2, 13), numpy.int32)   # 13 % 8 != 0
+    bad_mask = numpy.ones((2, 13), numpy.float32)
+    with pytest.raises(ValueError):
+        trainer.train_epoch(bad_idx, bad_mask)

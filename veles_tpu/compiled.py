@@ -180,6 +180,14 @@ class FusedRunner:
         _, stacked = jax.lax.scan(body, state, (idx, mask))
         return jax.tree.map(lambda m: m.sum(axis=0), stacked)
 
+    def require_epoch_rng(self, rng):
+        """Stochastic layers (dropout) need an explicit epoch rng — shared
+        guard for the single-chip and SPMD epoch-scan entry points."""
+        if self._has_stochastic and rng is None:
+            raise ValueError(
+                "this network has stochastic layers (dropout): "
+                "pass rng=jax.random.PRNGKey(...) to train_epoch")
+
     def epoch_fns(self):
         """Jitted (train_epoch, eval_epoch): args (state, data, labels,
         idx (B,mb) int32, mask (B,mb) f32[, rng]); train donates state.
@@ -192,10 +200,7 @@ class FusedRunner:
             def train_epoch(state, data, labels, idx, mask, rng=None,
                             step0=0):
                 import jax.numpy as jnp
-                if self._has_stochastic and rng is None:
-                    raise ValueError(
-                        "this network has stochastic layers (dropout): "
-                        "pass rng=jax.random.PRNGKey(...) to train_epoch")
+                self.require_epoch_rng(rng)
                 # int32 device scalar: a bare python int would retrace the
                 # epoch program once per distinct value
                 return inner(state, data, labels, idx, mask, rng,

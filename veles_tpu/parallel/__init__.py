@@ -69,6 +69,11 @@ class ShardedTrainer:
                                  for d in mesh.devices.flat}) > 1
         self._repl = NamedSharding(mesh, P())
         self._batch = NamedSharding(mesh, P("data"))
+        #: epoch-scan placement: (B, mb) plan matrices sharded over the
+        #: data axis along the minibatch dimension; dataset replicated
+        self._mb_shard = NamedSharding(mesh, P(None, "data"))
+        self._data = None
+        self._labels = None
         shardings = []
         for i, entry in enumerate(runner.state):
             if not entry:      # weightless layer (pooling, dropout, crop…)
@@ -160,6 +165,85 @@ class ShardedTrainer:
     def eval_step(self, x, labels, mask):
         x, labels, mask = self.put_batch(x, labels, mask)
         return self._eval(self.state, x, labels, mask)
+
+    # ------------------------------------------------- epoch-scan (SPMD)
+    # GLOBAL-plan API: every process passes the SAME full dataset and the
+    # SAME (B, mb) epoch plan — unlike the per-minibatch path, which
+    # consumes each process's shard_spmd-local rows.  Multi-process
+    # callers therefore plan from an UNsharded loader (the plan is
+    # deterministic from the shared PRNG seed); train_epoch cross-checks
+    # the plan across processes to fail loudly instead of silently
+    # training on mismatched batches.
+    def place_dataset(self, data, labels=None):
+        """Put the full GLOBAL dataset in HBM, replicated over the mesh,
+        for the one-dispatch-per-epoch path (labels None for AE
+        targets).  Every process must pass identical arrays."""
+        self._data = self._put(data, self._repl)
+        self._labels = (self._put(labels, self._repl)
+                        if labels is not None else None)
+
+    def _check_plan(self, idx, mask):
+        if idx.shape[1] % self.mesh.shape["data"]:
+            raise ValueError(
+                "minibatch size %d not divisible by data-axis size %d"
+                % (idx.shape[1], self.mesh.shape["data"]))
+        if self.multiprocess:
+            from jax.experimental import multihost_utils
+            multihost_utils.assert_equal(
+                (numpy.asarray(idx), numpy.asarray(mask)),
+                "epoch-scan plan differs across processes — build it "
+                "from an UNsharded loader (global plan), not shard_spmd")
+
+    def train_epoch(self, idx, mask, rng=None, step0=None):
+        """One device dispatch per EPOCH, data-parallel inside the scan.
+
+        The single-chip fast path (FusedRunner._epoch_train: lax.scan over
+        the minibatch index matrix, SURVEY §3.1 rebuild) runs unchanged
+        under the mesh — the ONLY distribution work is placement: the
+        dataset is replicated, and ``idx``/``mask`` (B, mb) are sharded
+        over the data axis along the minibatch dimension, so each scan
+        step's gather yields a batch-sharded ``x`` and GSPMD propagates
+        DP (and any model-axis sharding of the params) through the whole
+        epoch, inserting one gradient all-reduce per step.  Zero host
+        work between minibatches, N-chip parallel.
+        """
+        import jax.numpy as jnp
+        runner = self.runner
+        runner.require_epoch_rng(rng)
+        if self._data is None:
+            raise ValueError("call place_dataset(data, labels) first")
+        self._check_plan(idx, mask)
+        if step0 is None:
+            step0 = self.step_count
+        self._ensure_epoch_jits()
+        idx_g = self._put(numpy.asarray(idx, numpy.int32), self._mb_shard)
+        mask_g = self._put(numpy.asarray(mask, numpy.float32),
+                           self._mb_shard)
+        self.state, totals = self._epoch_train_jit(
+            self.state, self._data, self._labels, idx_g, mask_g, rng,
+            jnp.asarray(step0, jnp.int32))
+        self.step_count = int(step0) + idx.shape[0]
+        return totals
+
+    def _ensure_epoch_jits(self):
+        import jax
+        if not hasattr(self, "_epoch_train_jit"):
+            self._epoch_train_jit = jax.jit(
+                self.runner._epoch_train, donate_argnums=(0,),
+                out_shardings=(self.state_shardings, None))
+            self._epoch_eval_jit = jax.jit(self.runner._epoch_eval)
+
+    def eval_epoch(self, idx, mask):
+        """Whole-set evaluation in one dispatch (see train_epoch)."""
+        if self._data is None:
+            raise ValueError("call place_dataset(data, labels) first")
+        self._check_plan(idx, mask)
+        self._ensure_epoch_jits()
+        idx_g = self._put(numpy.asarray(idx, numpy.int32), self._mb_shard)
+        mask_g = self._put(numpy.asarray(mask, numpy.float32),
+                           self._mb_shard)
+        return self._epoch_eval_jit(self.state, self._data, self._labels,
+                                    idx_g, mask_g)
 
     @staticmethod
     def fetch(tree):
