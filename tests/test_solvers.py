@@ -91,10 +91,40 @@ class TestAdaptiveUpdate:
         step = float(numpy.abs(numpy.array(p)).max())
         assert 0 < step < 0.1   # small, bounded first step
 
+    def test_adam_matches_numpy_oracle(self):
+        import jax.numpy as jnp
+        lr, b1, b2, eps, bs, t = 0.001, 0.9, 0.999, 1e-8, 2, 7
+        new_p, new_v, new_a = self.F.adaptive_update(
+            jnp.asarray(self.p), jnp.asarray(self.v), jnp.asarray(self.a),
+            jnp.asarray(self.g), bs, lr, b1, 0.0, 0.0, None,
+            solver="adam", rho=b2, epsilon=eps, step=t)
+        g = self.g / bs
+        vel = b1 * self.v + (1 - b1) * g
+        acc = b2 * self.a + (1 - b2) * g * g
+        m_hat = vel / (1 - b1 ** (t + 1))
+        v_hat = acc / (1 - b2 ** (t + 1))
+        exp_p = self.p - lr * m_hat / (numpy.sqrt(v_hat) + eps)
+        numpy.testing.assert_allclose(numpy.array(new_v), vel, rtol=1e-6)
+        numpy.testing.assert_allclose(numpy.array(new_a), acc, rtol=1e-6)
+        numpy.testing.assert_allclose(numpy.array(new_p), exp_p, rtol=1e-5)
+
+    def test_adam_default_beta1_when_momentum_unset(self):
+        """momentum=0 means the standard β1=0.9, not zero momentum."""
+        import jax.numpy as jnp
+        args = (jnp.asarray(self.p), jnp.asarray(self.v),
+                jnp.asarray(self.a), jnp.asarray(self.g), 1, 0.01)
+        explicit = self.F.adaptive_update(*args, 0.9, 0.0, 0.0, None,
+                                          solver="adam", step=0)
+        default = self.F.adaptive_update(*args, 0.0, 0.0, 0.0, None,
+                                         solver="adam", step=0)
+        for e, d in zip(explicit, default):
+            numpy.testing.assert_array_equal(numpy.array(e),
+                                             numpy.array(d))
+
     def test_unknown_solver_raises(self):
         with pytest.raises(ValueError):
             self.F.adaptive_update(self.p, self.v, self.a, self.g, 1, 0.1,
-                                   0.0, 0.0, 0.0, None, solver="adamw")
+                                   0.0, 0.0, 0.0, None, solver="rmsprop")
 
 
 def _configure(solver, n_train=500, n_valid=200, max_epochs=3, lr=0.5):
@@ -112,10 +142,11 @@ def _configure(solver, n_train=500, n_valid=200, max_epochs=3, lr=0.5):
 
 
 class TestSolverWorkflows:
-    @pytest.mark.parametrize("solver", ["adagrad", "adadelta"])
+    @pytest.mark.parametrize("solver", ["adagrad", "adadelta", "adam"])
     def test_converges_fused(self, solver):
         prng.reset(); prng.seed_all(42)
-        _configure(solver, lr=1.0 if solver == "adadelta" else 0.5)
+        lr = {"adagrad": 0.5, "adadelta": 1.0, "adam": 0.005}[solver]
+        _configure(solver, lr=lr)
         from veles_tpu.samples import mnist
         wf = mnist.train(fused=True)
         metrics = wf.decision.epoch_metrics

@@ -608,7 +608,7 @@ def _effective_grad(param, grad, batch_size, weight_decay, l1_vs_l2,
 
 def adaptive_update(param, velocity, accum, grad, batch_size, learning_rate,
                     momentum, weight_decay, l1_vs_l2, gradient_clip,
-                    solver="momentum", rho=0.95, epsilon=1e-6):
+                    solver="momentum", rho=0.95, epsilon=1e-6, step=0):
     """Per-parameter update with a selectable solver.
 
     The reference's ``GradientDescentBase`` carried ADADELTA-style adaptive
@@ -625,6 +625,11 @@ def adaptive_update(param, velocity, accum, grad, batch_size, learning_rate,
       ``velocity = ρ·velocity+(1-ρ)·Δx²`` — the velocity slot doubles as
       the E[Δx²] memory, so snapshots stay two-arrays-per-param.
       ``lr`` is the reference-style global multiplier (1.0 = paper form).
+    - ``adam`` (beyond parity): first/second-moment estimates in the
+      velocity/accum slots with bias correction from the traced global
+      ``step``; β1 = ``momentum`` (0 means the standard 0.9), β2 =
+      ``rho`` (set ``solver_rho=0.999`` for the paper constants), ε =
+      ``epsilon``.
 
     Returns ``(param, velocity, accum)``; pass-through slots come back
     unchanged so the fused state pytree keeps a static structure.
@@ -646,4 +651,14 @@ def adaptive_update(param, velocity, accum, grad, batch_size, learning_rate,
                                / jnp.sqrt(accum + epsilon)) * g
         velocity = rho * velocity + (1.0 - rho) * dx * dx
         return param + dx, velocity, accum
+    if solver == "adam":
+        beta1 = momentum if momentum else 0.9
+        t = jnp.asarray(step, param.dtype) + 1.0
+        velocity = beta1 * velocity + (1.0 - beta1) * g
+        accum = rho * accum + (1.0 - rho) * g * g
+        m_hat = velocity / (1.0 - beta1 ** t)
+        v_hat = accum / (1.0 - jnp.asarray(rho, param.dtype) ** t)
+        return (param - learning_rate * m_hat
+                / (jnp.sqrt(v_hat) + epsilon),
+                velocity, accum)
     raise ValueError("unknown solver %r" % (solver,))

@@ -323,18 +323,20 @@ class GradientDescentBase(AcceleratedUnit):
         self.weight_decay_bias = weight_decay_bias
         self.l1_vs_l2 = l1_vs_l2
         self.gradient_clip = gradient_clip
-        #: update rule: "momentum" | "adagrad" | "adadelta" — the
-        #: reference's ADADELTA-style per-unit option set (ref:
-        #: veles/znicz/nn_units.py::GradientDescentBase [H]); per-layer
-        #: selectable via the layer config's "<-" dict like every other
-        #: hyperparameter
-        if solver not in ("momentum", "adagrad", "adadelta"):
+        #: update rule: "momentum" | "adagrad" | "adadelta" | "adam" —
+        #: the reference's ADADELTA-style per-unit option set (ref:
+        #: veles/znicz/nn_units.py::GradientDescentBase [H]) plus adam
+        #: (beyond parity; momentum doubles as β1, solver_rho as β2);
+        #: per-layer selectable via the layer config's "<-" dict like
+        #: every other hyperparameter
+        if solver not in ("momentum", "adagrad", "adadelta", "adam"):
             raise ValueError("unknown solver %r" % (solver,))
         self.solver = solver
         self.solver_rho = solver_rho
         self.solver_epsilon = solver_epsilon
-        if solver != "momentum" and momentum:
-            # never drop an explicit setting silently
+        if solver in ("adagrad", "adadelta") and momentum:
+            # never drop an explicit setting silently (under adam,
+            # momentum IS beta1 and stays meaningful)
             self.warning("momentum=%g is inert under solver=%r",
                          momentum, solver)
         #: first trainable layer skips computing err_input (saves a GEMM,
@@ -343,7 +345,7 @@ class GradientDescentBase(AcceleratedUnit):
         self.err_input = Vector()
         self.velocity_weights = Vector()
         self.velocity_bias = Vector()
-        #: grad² accumulators (adagrad/adadelta only; empty otherwise)
+        #: grad² accumulators (adaptive solvers only; empty under momentum)
         self.accum_weights = Vector()
         self.accum_bias = Vector()
         if forward is not None:
@@ -495,7 +497,7 @@ class GradientDescentBase(AcceleratedUnit):
             weights, vel_w, acc_w, grad_w, batch_size, lr_w,
             self.momentum, self.weight_decay, self.l1_vs_l2,
             self.gradient_clip, self.solver, self.solver_rho,
-            self.solver_epsilon)
+            self.solver_epsilon, step)
         if self.weights_mask is not None:
             import jax.numpy as jnp
             new_w = new_w * jnp.asarray(self.weights_mask, new_w.dtype)
@@ -505,7 +507,7 @@ class GradientDescentBase(AcceleratedUnit):
             bias, vel_b, acc_b, grad_b, batch_size, lr_b,
             self.momentum, self.weight_decay_bias, self.l1_vs_l2,
             self.gradient_clip, self.solver, self.solver_rho,
-            self.solver_epsilon)
+            self.solver_epsilon, step)
         return new_w, new_b, new_vw, new_vb, new_aw, new_ab
 
     def run(self):

@@ -177,22 +177,50 @@ class ShardedTrainer:
     def place_dataset(self, data, labels=None):
         """Put the full GLOBAL dataset in HBM, replicated over the mesh,
         for the one-dispatch-per-epoch path (labels None for AE
-        targets).  Every process must pass identical arrays."""
+        targets).  Every process must pass identical arrays —
+        cross-checked by digest, so a process feeding different data
+        fails here instead of silently diverging."""
+        if self.multiprocess:
+            import zlib
+            from jax.experimental import multihost_utils
+            digest = [zlib.crc32(numpy.ascontiguousarray(data).tobytes())]
+            if labels is not None:
+                digest.append(zlib.crc32(
+                    numpy.ascontiguousarray(labels).tobytes()))
+            multihost_utils.assert_equal(
+                numpy.asarray(digest, numpy.uint32),
+                "place_dataset arrays differ across processes — the "
+                "epoch-scan path needs the identical GLOBAL dataset "
+                "everywhere")
         self._data = self._put(data, self._repl)
         self._labels = (self._put(labels, self._repl)
                         if labels is not None else None)
 
-    def _check_plan(self, idx, mask):
+    def _place_plan(self, idx, mask, rng=None):
+        """Shared guard + placement for train_epoch/eval_epoch: validates
+        the plan, cross-checks it (and the rng key, whose divergence
+        would silently desynchronize dropout masks across hosts) in
+        multi-process mode, and uploads the plan matrices data-sharded."""
+        if self._data is None:
+            raise ValueError("call place_dataset(data, labels) first")
         if idx.shape[1] % self.mesh.shape["data"]:
             raise ValueError(
                 "minibatch size %d not divisible by data-axis size %d"
                 % (idx.shape[1], self.mesh.shape["data"]))
         if self.multiprocess:
             from jax.experimental import multihost_utils
+            tree = (numpy.asarray(idx), numpy.asarray(mask))
+            if rng is not None:
+                tree += (numpy.asarray(rng),)
             multihost_utils.assert_equal(
-                (numpy.asarray(idx), numpy.asarray(mask)),
-                "epoch-scan plan differs across processes — build it "
-                "from an UNsharded loader (global plan), not shard_spmd")
+                tree,
+                "epoch-scan plan/rng differs across processes — build "
+                "the plan from an UNsharded loader (global plan, not "
+                "shard_spmd) and derive the rng from the shared seed")
+        self._ensure_epoch_jits()
+        return (self._put(numpy.asarray(idx, numpy.int32), self._mb_shard),
+                self._put(numpy.asarray(mask, numpy.float32),
+                          self._mb_shard))
 
     def train_epoch(self, idx, mask, rng=None, step0=None):
         """One device dispatch per EPOCH, data-parallel inside the scan.
@@ -208,17 +236,10 @@ class ShardedTrainer:
         work between minibatches, N-chip parallel.
         """
         import jax.numpy as jnp
-        runner = self.runner
-        runner.require_epoch_rng(rng)
-        if self._data is None:
-            raise ValueError("call place_dataset(data, labels) first")
-        self._check_plan(idx, mask)
+        self.runner.require_epoch_rng(rng)
+        idx_g, mask_g = self._place_plan(idx, mask, rng)
         if step0 is None:
             step0 = self.step_count
-        self._ensure_epoch_jits()
-        idx_g = self._put(numpy.asarray(idx, numpy.int32), self._mb_shard)
-        mask_g = self._put(numpy.asarray(mask, numpy.float32),
-                           self._mb_shard)
         self.state, totals = self._epoch_train_jit(
             self.state, self._data, self._labels, idx_g, mask_g, rng,
             jnp.asarray(step0, jnp.int32))
@@ -235,13 +256,7 @@ class ShardedTrainer:
 
     def eval_epoch(self, idx, mask):
         """Whole-set evaluation in one dispatch (see train_epoch)."""
-        if self._data is None:
-            raise ValueError("call place_dataset(data, labels) first")
-        self._check_plan(idx, mask)
-        self._ensure_epoch_jits()
-        idx_g = self._put(numpy.asarray(idx, numpy.int32), self._mb_shard)
-        mask_g = self._put(numpy.asarray(mask, numpy.float32),
-                           self._mb_shard)
+        idx_g, mask_g = self._place_plan(idx, mask)
         return self._epoch_eval_jit(self.state, self._data, self._labels,
                                     idx_g, mask_g)
 
