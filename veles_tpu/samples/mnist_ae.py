@@ -32,11 +32,11 @@ def default_config():
         "decision": {"max_epochs": 10, "fail_iterations": 20},
         "layers": [
             {"type": "conv_tanh", "n_kernels": 16, "kx": 5, "ky": 5,
-             "padding": "SAME", "learning_rate": 0.0005, "momentum": 0.9},
+             "padding": "SAME", "learning_rate": 0.0001, "momentum": 0.9},
             {"type": "avg_pooling", "kx": 2, "ky": 2},
             {"type": "depooling", "kx": 2, "ky": 2},
             {"type": "deconv", "n_kernels": 1, "kx": 5, "ky": 5,
-             "padding": "SAME", "learning_rate": 0.0005, "momentum": 0.9},
+             "padding": "SAME", "learning_rate": 0.0001, "momentum": 0.9},
         ],
     })
     return root.mnist_ae
