@@ -468,6 +468,19 @@ def bench_numpy_floor(wf, min_seconds=3.0):
 
 KNOWN_CONFIGS = ("mnist", "cifar", "alexnet", "sgd", "records",
                  "convergence")
+#: "convergence" expands to one watchdog worker per sub-bench, so a hang
+#: in one (e.g. a tunnel death mid-compile) cannot discard the others
+CONVERGENCE_SUBS = ("kohonen", "mnist_fc", "cifar_conv", "mnist_ae")
+
+
+def expand_configs(wanted):
+    out = []
+    for c in wanted:
+        if c == "convergence":
+            out.extend("convergence:" + s for s in CONVERGENCE_SUBS)
+        else:
+            out.append(c)
+    return out
 
 
 def probe_device(timeout_s=None):
@@ -564,7 +577,13 @@ def run_configs(wanted, args):
     if "alexnet" in wanted:
         guarded("alexnet", _bench_alexnet)
 
-    if "convergence" in wanted:
+    conv_sel = set()
+    for c in wanted:
+        if c == "convergence":
+            conv_sel.update(CONVERGENCE_SUBS)
+        elif c.startswith("convergence:"):
+            conv_sel.add(c.split(":", 1)[1])
+    if conv_sel:
         # small-but-real convergence runs (val-acc is the OTHER half of the
         # BASELINE acceptance); sizes keep the wall time in minutes on TPU
         # (and seconds in --smoke: fp32-HIGHEST convs on CPU are SLOW)
@@ -599,10 +618,45 @@ def run_configs(wanted, args):
             wf.initialize()
             return wf
 
+        def _bench_kohonen():
+            """SOM quantization error to Decision-complete (row 3's
+            unsupervised half).  Non-SGD graph path — the trainer
+            dispatches per minibatch, so sizes stay small."""
+            from veles_tpu import prng
+            from veles_tpu.config import root
+            prng.reset()
+            prng.seed_all(1)
+            root.__dict__.pop("kohonen", None)
+            from veles_tpu.samples import kohonen
+            kohonen.default_config()
+            root.kohonen.update({
+                "loader": {"minibatch_size": 100,
+                           "n_train": 500 if args.smoke else 2000},
+                "decision": {"max_epochs": 4 if args.smoke else 10,
+                             "fail_iterations": 20},
+            })
+            begin = time.perf_counter()
+            wf = kohonen.train()
+            qerrs = [m["train"]["qerr"]
+                     for m in wf.decision.epoch_metrics]
+            results["convergence_kohonen"] = {
+                "first_epoch_qerr": round(qerrs[0], 4),
+                "best_qerr": round(min(qerrs), 4),
+                "epochs_run": len(qerrs),
+                "wall_s": round(time.perf_counter() - begin, 1),
+            }
+            print("convergence kohonen: %s"
+                  % results["convergence_kohonen"], file=sys.stderr)
+
+        if "kohonen" in conv_sel:
+            guarded("convergence_kohonen", _bench_kohonen)
+
         for name, build_fn in (
                 ("mnist_fc", lambda: build_mnist(*conv_sizes["mnist"])),
                 ("cifar_conv", lambda: build_cifar(*conv_sizes["cifar"])),
                 ("mnist_ae", build_ae)):
+            if name not in conv_sel:
+                continue
             def _bench_conv(name=name, build_fn=build_fn):
                 key = {"mnist_fc": "mnist", "cifar_conv": "cifar",
                        "mnist_ae": "ae"}[name]
@@ -669,17 +723,22 @@ def emit_summary(results):
     elif any(k.startswith("convergence_") and isinstance(results[k], dict)
              for k in results):   # convergence-only invocation
         keys = [k for k in ("convergence_mnist_fc", "convergence_cifar_conv",
-                            "convergence_mnist_ae")
+                            "convergence_mnist_ae", "convergence_kohonen")
                 if isinstance(results.get(k), dict)]
         keys += [k for k in results if k.startswith("convergence_")
                  and isinstance(results[k], dict) and k not in keys]
-        key = keys[0]
-        rec = results[key]
-        if "best_val_err_pct" in rec:
-            suffix, value, unit = ("best_val_err_pct",
-                                   rec["best_val_err_pct"], "percent")
-        else:
-            suffix, value, unit = "best_val_mse", rec["best_val_mse"], "mse"
+        units = {"best_val_err_pct": "percent", "best_val_mse": "mse",
+                 "best_qerr": "qe"}
+        key, suffix, value, unit = None, None, None, ""
+        for k in keys:
+            hit = next((sfx for sfx in units if sfx in results[k]), None)
+            if hit is not None:
+                key, suffix = k, hit
+                value, unit = results[k][hit], units[hit]
+                break
+        if key is None:   # convergence dicts with no known metric key
+            key, suffix = keys[0], "record"
+            value = None
         print(json.dumps({
             "metric": "%s_%s" % (key, suffix),
             "value": value,
@@ -787,10 +846,12 @@ def main():
         return 0
 
     wanted = [c.strip() for c in args.configs.split(",") if c.strip()]
-    unknown = [c for c in wanted if c not in KNOWN_CONFIGS]
+    known = set(KNOWN_CONFIGS) | {
+        "convergence:" + s for s in CONVERGENCE_SUBS}
+    unknown = [c for c in wanted if c not in known]
     if unknown or not wanted:
         parser.error("unknown configs %r (choose from %s)"
-                     % (unknown, ", ".join(KNOWN_CONFIGS)))
+                     % (unknown, ", ".join(sorted(known))))
 
     # --smoke forces CPU, where a wedged-tunnel hang cannot occur — run in
     # process and skip paying one python+jax cold start per config
@@ -798,7 +859,7 @@ def main():
         results = run_configs(wanted, args)
     else:
         argv = (["--seconds", str(args.seconds)] if args.seconds else [])
-        results = orchestrate(wanted, args, argv)
+        results = orchestrate(expand_configs(wanted), args, argv)
     return emit_summary(results)
 
 
