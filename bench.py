@@ -324,6 +324,120 @@ def bench_convergence(build_fn, max_epochs=15, patience=5):
     return rec
 
 
+# -------------------------------------------------------- transformer LM
+def bench_lm(smoke=False, iters=None):
+    """Char-LM transformer training throughput (the beyond-parity
+    long-context family): tokens/sec of THE product train step
+    (transformer.make_adam_train_step — the same function
+    TransformerTrainer jits), measured by in-jit K-vs-1 repetition
+    (lax.scan) so the tunnel's per-dispatch latency cancels.  TFLOP/s
+    uses the standard 6·N·T convention (N = param count, T = tokens;
+    attention term excluded) — approximate but comparable across rounds.
+    """
+    import jax
+    import jax.numpy as jnp
+    from veles_tpu import prng
+    from veles_tpu.ops.transformer import (init_transformer_params,
+                                           lm_loss, make_adam_train_step)
+
+    if smoke:
+        vocab, d, heads, layers, seq, mb = 64, 32, 2, 2, 64, 8
+        iters = 2 if iters is None else iters
+    else:
+        vocab, d, heads, layers, seq, mb = 256, 512, 8, 8, 512, 32
+        iters = 6 if iters is None else iters
+    host = init_transformer_params(prng.get("init"), vocab, d, heads,
+                                   layers, max_len=seq + 1)
+    params = jax.tree.map(jnp.asarray, host)
+    n_params = sum(int(numpy.prod(a.shape))
+                   for a in jax.tree.leaves(params))
+    opt = (jax.tree.map(jnp.zeros_like, params),
+           jax.tree.map(jnp.zeros_like, params))
+    key = jax.random.PRNGKey(0)
+    tokens = jax.random.randint(key, (mb, seq + 1), 0, vocab, jnp.int32)
+    mask = jnp.ones((mb,), jnp.float32)
+    train_step = make_adam_train_step(
+        lambda p, toks, msk: lm_loss(p, toks, msk, heads), 1e-3)
+
+    def step(carry, _):
+        p, opt_state, t = carry
+        p, opt_state, metrics = train_step(p, opt_state, tokens, mask, t)
+        return (p, opt_state, t + 1), metrics["loss_sum"]
+
+    def chain(k):
+        def fn(p, opt):
+            carry, losses = jax.lax.scan(
+                step, (p, opt, jnp.asarray(0, jnp.int32)), None, length=k)
+            return losses[-1]
+        return jax.jit(fn)
+
+    f1, fk = chain(1), chain(1 + iters)
+    _sync(f1(params, opt)); _sync(fk(params, opt))    # compile
+    times = []
+    for fn in (f1, fk):
+        best = float("inf")
+        for _ in range(3):
+            begin = time.perf_counter()
+            _sync(fn(params, opt))
+            best = min(best, time.perf_counter() - begin)
+        times.append(best)
+    step_s = (times[1] - times[0]) / iters
+    toks = mb * seq
+    return {
+        "tokens_per_sec": round(toks / step_s, 1),
+        "step_time_ms": round(step_s * 1e3, 3),
+        "seq_len": seq, "minibatch": mb, "d_model": d,
+        "n_layers": layers, "n_params": n_params,
+        "approx_tflops": round(6.0 * n_params * toks / step_s / 1e12, 2),
+        "flops_convention": "6*N*T, attention excluded",
+    }
+
+
+# ------------------------------------------------------------ DP scaling
+def bench_scaling(smoke=False, seconds=2.0):
+    """DP scaling-efficiency hook (BASELINE config[4]): MNIST-FC
+    epoch-scan samples/sec on ONE device vs ALL local devices via
+    ShardedTrainer.  Recorded as skipped on single-device hosts (this
+    container's TPU is one chip); the measurement runs unchanged the
+    round the driver offers a multi-chip mesh.
+    """
+    import jax
+    from veles_tpu.parallel import make_mesh, ShardedTrainer
+
+    n = len(jax.devices())
+    if n < 2:
+        return {"skipped": "single device — scaling unmeasurable here"}
+    sizes = (4000, 800, 200) if smoke else (60000, 10000, 512)
+
+    def measure(n_dev):
+        wf = build_mnist(*sizes)
+        trainer = ShardedTrainer(wf._fused_runner, make_mesh(n_dev))
+        loader = wf.loader
+        trainer.place_dataset(numpy.asarray(loader.original_data.mem),
+                              numpy.asarray(loader.original_labels.mem))
+        idx, mask = epoch_plan_arrays(loader)
+        n_samples = int(mask.sum())
+        _sync(trainer.train_epoch(idx, mask))          # compile + warm
+        epochs, elapsed = 1, 0.0
+        while elapsed < seconds:
+            begin = time.perf_counter()
+            for _ in range(epochs):
+                totals = trainer.train_epoch(idx, mask)
+            _sync(totals)
+            elapsed = time.perf_counter() - begin
+            if elapsed < seconds:
+                epochs *= 2
+        return epochs * n_samples / elapsed
+
+    sps_1, sps_n = measure(1), measure(n)
+    return {
+        "devices": n,
+        "samples_per_sec_1dev": round(sps_1, 1),
+        "samples_per_sec_ndev": round(sps_n, 1),
+        "scaling_efficiency": round(sps_n / (n * sps_1), 3),
+    }
+
+
 # ------------------------------------------------- sgd backend (XLA/Pallas)
 def bench_sgd_backends(n=4 * 1024 * 1024, iters=20, smoke=False):
     """XLA-vs-Pallas fused-SGD-update comparison (SURVEY §2.4 custom-kernel
@@ -467,7 +581,7 @@ def bench_numpy_floor(wf, min_seconds=3.0):
 
 
 KNOWN_CONFIGS = ("mnist", "cifar", "alexnet", "sgd", "records",
-                 "convergence")
+                 "convergence", "lm", "scaling")
 #: "convergence" expands to one watchdog worker per sub-bench, so a hang
 #: in one (e.g. a tunnel death mid-compile) cannot discard the others
 CONVERGENCE_SUBS = ("kohonen", "mnist_fc", "cifar_conv", "mnist_ae")
@@ -668,6 +782,20 @@ def run_configs(wanted, args):
                       file=sys.stderr)
             guarded("convergence_" + name, _bench_conv)
 
+    def _bench_lm():
+        results["char_lm"] = bench_lm(smoke=args.smoke)
+        print("char_lm: %s" % results["char_lm"], file=sys.stderr)
+
+    if "lm" in wanted:
+        guarded("lm", _bench_lm)
+
+    def _bench_scaling():
+        results["dp_scaling"] = bench_scaling(smoke=args.smoke)
+        print("dp_scaling: %s" % results["dp_scaling"], file=sys.stderr)
+
+    if "scaling" in wanted:
+        guarded("scaling", _bench_scaling)
+
     def _bench_sgd():
         results["sgd_update"] = bench_sgd_backends(smoke=args.smoke)
         print("sgd_update: %s" % results["sgd_update"], file=sys.stderr)
@@ -717,6 +845,23 @@ def emit_summary(results):
             "metric": "records_pipeline_samples_per_sec",
             "value": results["records_pipeline"]["samples_per_sec"],
             "unit": "samples/sec",
+            "vs_baseline": None,
+            "configs": results,
+        }))
+    elif "char_lm" in results:
+        print(json.dumps({
+            "metric": "char_lm_train_tokens_per_sec",
+            "value": results["char_lm"]["tokens_per_sec"],
+            "unit": "tokens/sec",
+            "vs_baseline": None,
+            "configs": results,
+        }))
+    elif results.get("dp_scaling", {}).get("scaling_efficiency") \
+            is not None:
+        print(json.dumps({
+            "metric": "dp_scaling_efficiency",
+            "value": results["dp_scaling"].get("scaling_efficiency"),
+            "unit": "fraction",
             "vs_baseline": None,
             "configs": results,
         }))
@@ -825,7 +970,8 @@ def main():
     parser.add_argument("--smoke", action="store_true",
                         help="tiny sizes on CPU for CI validation")
     parser.add_argument("--configs",
-                        default="mnist,cifar,alexnet,sgd,records,convergence",
+                        default="mnist,cifar,alexnet,sgd,records,"
+                                "convergence,lm,scaling",
                         help="comma list: " + ",".join(KNOWN_CONFIGS))
     parser.add_argument("--seconds", type=float, default=None,
                         help="target seconds per timing window")

@@ -165,6 +165,36 @@ def lm_loss(params, tokens, mask, n_heads, block_size=None,
     return loss
 
 
+def make_adam_train_step(loss_fn, learning_rate, beta1=0.9, beta2=0.999,
+                         eps=1e-8):
+    """Pure adam step over a param pytree: ``(params, opt_state, tokens,
+    mask, t) -> (params, opt_state, metrics)``.
+
+    THE training step of the transformer family — TransformerTrainer jits
+    it per-minibatch and bench.py lax.scans it for throughput, so the
+    benched optimizer is the product's by construction.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def train_step(params, opt_state, tokens, mask, t):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, mask)
+        m, v = opt_state
+        m = jax.tree.map(lambda a, g: beta1 * a + (1 - beta1) * g,
+                         m, grads)
+        v = jax.tree.map(lambda a, g: beta2 * a + (1 - beta2) * g * g,
+                         v, grads)
+        tf = t.astype(jnp.float32) + 1.0
+        lr = learning_rate * jnp.sqrt(1.0 - beta2 ** tf) / (1.0 - beta1 ** tf)
+        params = jax.tree.map(
+            lambda p, m_, v_: p - lr * m_ / (jnp.sqrt(v_) + eps),
+            params, m, v)
+        count = mask.sum()
+        return params, (m, v), {"loss_sum": loss * count, "tokens": count}
+
+    return train_step
+
+
 class TransformerTrainer(AcceleratedUnit):
     """Whole-model trainer: adam update of the param pytree in one jitted
     step; gates to TRAIN minibatches; evaluation scores loss only."""
@@ -290,25 +320,9 @@ class TransformerTrainer(AcceleratedUnit):
         train_loss_fn = self._loss_fn(training=True)
         eval_loss_fn = self._loss_fn(training=False)
 
-        def train_step(params, opt_state, tokens, mask, t):
-            loss, grads = jax.value_and_grad(train_loss_fn)(
-                params, tokens, mask)
-            m, v = opt_state
-            m = jax.tree.map(
-                lambda a, g: self.beta1 * a + (1 - self.beta1) * g,
-                m, grads)
-            v = jax.tree.map(
-                lambda a, g: self.beta2 * a + (1 - self.beta2) * g * g,
-                v, grads)
-            tf = t.astype(jnp.float32) + 1.0
-            lr = self.learning_rate * jnp.sqrt(
-                1.0 - self.beta2 ** tf) / (1.0 - self.beta1 ** tf)
-            params = jax.tree.map(
-                lambda p, m_, v_: p - lr * m_ / (jnp.sqrt(v_) + self.eps),
-                params, m, v)
-            count = mask.sum()
-            return params, (m, v), {"loss_sum": loss * count,
-                                    "tokens": count}
+        train_step = make_adam_train_step(
+            train_loss_fn, self.learning_rate, self.beta1, self.beta2,
+            self.eps)
 
         def eval_step(params, tokens, mask):
             loss = eval_loss_fn(params, tokens, mask)
