@@ -21,7 +21,7 @@ def tiny_config():
         # settings would silently leak into later "dense sequential" runs
         "trainer": {"vocab": 16, "d_model": 32, "n_heads": 2, "n_layers": 1,
                     "max_len": 32, "learning_rate": 3e-3,
-                    "n_experts": 0, "pipeline_stages": 0},
+                    "n_experts": 0, "pipeline_stages": 0, "remat": False},
         "decision": {"max_epochs": 4, "fail_iterations": 10},
     })
 
@@ -239,3 +239,41 @@ class TestRingLMForward:
         numpy.testing.assert_allclose(numpy.asarray(ringed),
                                       numpy.asarray(dense),
                                       rtol=1e-3, atol=1e-4)
+
+
+class TestRemat:
+    def test_remat_loss_and_grads_identical(self):
+        """jax.checkpoint changes memory scheduling, not math: loss and
+        gradients must match the stored-activation path exactly."""
+        prng.reset(); prng.seed_all(3)
+        host = T.init_transformer_params(prng.get("init"), vocab=16,
+                                         d_model=32, n_heads=2, n_layers=3,
+                                         max_len=33)
+        params = jax.tree.map(jnp.asarray, host)
+        key = jax.random.PRNGKey(1)
+        tokens = jax.random.randint(key, (4, 33), 0, 16, jnp.int32)
+        mask = jnp.ones((4,), jnp.float32)
+
+        def loss(remat):
+            return lambda p: T.lm_loss(p, tokens, mask, n_heads=2,
+                                       remat=remat)
+        l0, g0 = jax.value_and_grad(loss(False))(params)
+        l1, g1 = jax.value_and_grad(loss(True))(params)
+        assert float(l0) == pytest.approx(float(l1), rel=1e-6)
+        for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+            numpy.testing.assert_allclose(numpy.asarray(a),
+                                          numpy.asarray(b),
+                                          rtol=1e-5, atol=1e-7)
+
+    def test_char_lm_trains_with_remat(self):
+        prng.reset(); prng.seed_all(11)
+        tiny_config()
+        root.char_lm.trainer.update({"remat": True})
+        try:
+            from veles_tpu.samples import char_lm
+            wf = char_lm.train()
+            losses = [m["validation"]["loss"]
+                      for m in wf.decision.epoch_metrics]
+            assert losses[-1] < losses[0]
+        finally:
+            root.char_lm.trainer.update({"remat": False})   # don't leak

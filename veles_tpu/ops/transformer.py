@@ -140,25 +140,39 @@ def transformer_forward(params, tokens, n_heads, block_size=None,
 
 
 def lm_loss(params, tokens, mask, n_heads, block_size=None,
-            moe_aux_coef=0.0):
+            moe_aux_coef=0.0, remat=False):
     """Mean next-token cross-entropy (masked rows excluded).
 
     ``moe_aux_coef > 0`` adds the mean per-MoE-block load-balancing loss
     (ops/moe.py) over LIVE tokens — required for top-1 routing not to
-    collapse; padded rows must not steer the router."""
+    collapse; padded rows must not steer the router.
+
+    ``remat=True`` wraps each block in ``jax.checkpoint``: activations
+    inside a block are recomputed during the backward pass instead of
+    stored, cutting peak activation memory from O(layers·seq·d) to
+    O(seq·d) + one block — the standard TPU HBM-for-FLOPs trade that
+    makes deep stacks on long sequences fit (SURVEY §7 "HBM bandwidth"
+    design note)."""
+    import jax
     import jax.numpy as jnp
     h = embed_tokens(params, tokens[:, :-1])
     token_mask = jnp.broadcast_to(
         mask[:, None], (h.shape[0], h.shape[1])).reshape(-1)
     aux_total, n_moe = 0.0, 0
+
+    def wrap(fn):
+        return jax.checkpoint(fn) if remat else fn
+
     for blk in params["blocks"]:
         if moe_aux_coef and "moe" in blk:
-            h, aux = block_forward(blk, h, n_heads, block_size,
-                                   with_aux=True, token_mask=token_mask)
+            h, aux = wrap(lambda b, x: block_forward(
+                b, x, n_heads, block_size, with_aux=True,
+                token_mask=token_mask))(blk, h)
             aux_total = aux_total + aux
             n_moe += 1
         else:
-            h = block_forward(blk, h, n_heads, block_size)
+            h = wrap(lambda b, x: block_forward(
+                b, x, n_heads, block_size))(blk, h)
     loss = nll_from_hidden(params, h, tokens[:, 1:], mask)
     if n_moe:
         loss = loss + moe_aux_coef * aux_total / n_moe
@@ -203,7 +217,7 @@ class TransformerTrainer(AcceleratedUnit):
                  n_layers=2, max_len=512, learning_rate=1e-3,
                  block_size=None, beta1=0.9, beta2=0.999, eps=1e-8,
                  n_experts=0, moe_aux_coef=1e-2, pipeline_stages=0,
-                 pipeline_microbatches=4, **kwargs):
+                 pipeline_microbatches=4, remat=False, **kwargs):
         super().__init__(workflow, **kwargs)
         self.vocab = vocab
         self.d_model = d_model
@@ -221,6 +235,10 @@ class TransformerTrainer(AcceleratedUnit):
         #: (parallel.pipeline); n_layers must divide by the stage count
         self.pipeline_stages = pipeline_stages
         self.pipeline_microbatches = pipeline_microbatches
+        #: jax.checkpoint each block (sequential path): recompute block
+        #: activations in the backward pass instead of storing them —
+        #: deep stacks on long sequences fit in HBM at ~1/3 extra FLOPs
+        self.remat = remat
         self._pp_mesh = None
         self.params = None
         self.opt_state = None
@@ -277,6 +295,10 @@ class TransformerTrainer(AcceleratedUnit):
         pipelined MoE trains without it (warned below)."""
         if self.pipeline_stages > 0:
             from veles_tpu.parallel.pipeline import pipeline_lm_loss
+            if training and self.remat:
+                self.warning("remat is not applied on the pipeline path "
+                             "(the stage scan already bounds live "
+                             "activations to one microbatch per stage)")
             if training and self.n_experts > 0 and self.moe_aux_coef:
                 # never drop an explicit setting silently
                 self.warning(
@@ -294,7 +316,7 @@ class TransformerTrainer(AcceleratedUnit):
                 if training and self.n_experts > 0 else 0.0)
         return lambda params, tokens, mask: lm_loss(
             params, tokens, mask, self.n_heads, self.block_size,
-            moe_aux_coef=coef)
+            moe_aux_coef=coef, remat=self.remat)
 
     def initialize(self, device=None, **kwargs):
         import jax
