@@ -1,0 +1,164 @@
+"""Gradient accumulation (FusedRunner.grad_accum): microbatched grads
+must reproduce the monolithic step exactly on deterministic nets, and a
+full training run must converge identically."""
+
+import numpy
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from veles_tpu import prng
+from veles_tpu.config import root
+
+
+def _configure(mb=64, n_train=256, n_valid=64, max_epochs=2):
+    root.mnist.update({
+        "loader": {"minibatch_size": mb, "n_train": n_train,
+                   "n_valid": n_valid},
+        "decision": {"max_epochs": max_epochs, "fail_iterations": 10},
+        "layers": [
+            {"type": "all2all_tanh", "output_sample_shape": 32,
+             "learning_rate": 0.05, "momentum": 0.9},
+            {"type": "softmax", "output_sample_shape": 10,
+             "learning_rate": 0.05, "momentum": 0.9},
+        ],
+    })
+
+
+def test_accum_step_matches_monolithic():
+    from veles_tpu.samples import mnist
+    rng = numpy.random.RandomState(3)
+    x = rng.randn(64, 784).astype(numpy.float32)
+    labels = rng.randint(0, 10, 64).astype(numpy.int32)
+    mask = numpy.ones(64, numpy.float32)
+
+    states, metrics = [], []
+    for accum in (1, 4):
+        prng.reset(); prng.seed_all(7)
+        _configure()
+        wf = mnist.build(fused=True, grad_accum=accum)
+        wf.initialize()
+        runner = wf._fused_runner
+        assert runner.grad_accum == accum
+        new_state, m = runner._train(
+            runner.state, x, labels, mask, jnp.asarray(64, jnp.int32),
+            None, jnp.asarray(0, jnp.int32))
+        states.append(new_state)
+        metrics.append(m)
+
+    assert int(metrics[0]["n_err"]) == int(metrics[1]["n_err"])
+    numpy.testing.assert_allclose(float(metrics[0]["loss_sum"]),
+                                  float(metrics[1]["loss_sum"]), rtol=1e-5)
+    for ea, eb in zip(states[0], states[1]):
+        for key in ea:
+            numpy.testing.assert_allclose(
+                numpy.asarray(ea[key]), numpy.asarray(eb[key]),
+                rtol=1e-5, atol=1e-6)
+
+
+def test_training_run_identical_with_accum():
+    """Whole Decision-driven runs: grad_accum=2 ≡ grad_accum=1."""
+    from veles_tpu.samples import mnist
+    finals = []
+    for accum in (1, 2):
+        prng.reset(); prng.seed_all(7)
+        _configure()
+        wf = mnist.train(fused=True, grad_accum=accum)
+        finals.append(wf.decision.epoch_metrics[-1]["validation"])
+    assert finals[0]["n_err"] == finals[1]["n_err"]
+    assert finals[0]["loss"] == pytest.approx(finals[1]["loss"], rel=1e-5)
+
+
+def test_indivisible_minibatch_raises():
+    from veles_tpu.samples import mnist
+    prng.reset(); prng.seed_all(7)
+    _configure(mb=50)
+    wf = mnist.build(fused=True, grad_accum=4)   # 50 % 4 != 0
+    wf.initialize()
+    runner = wf._fused_runner
+    x = numpy.zeros((50, 784), numpy.float32)
+    with pytest.raises(ValueError):
+        runner._train(runner.state, x,
+                      numpy.zeros(50, numpy.int32),
+                      numpy.ones(50, numpy.float32),
+                      jnp.asarray(50, jnp.int32), None,
+                      jnp.asarray(0, jnp.int32))
+
+
+def test_mse_max_metric_not_summed():
+    """rmse_max must combine with maximum across microbatches, not sum."""
+    from veles_tpu.samples import mnist_ae
+    outs = []
+    for accum in (1, 4):
+        prng.reset(); prng.seed_all(5)
+        root.__dict__.pop("mnist_ae", None)
+        mnist_ae.default_config()
+        root.mnist_ae.update({
+            "loader": {"minibatch_size": 40, "n_train": 80, "n_valid": 40},
+            "decision": {"max_epochs": 1, "fail_iterations": 10},
+        })
+        wf = mnist_ae.build(fused=True, grad_accum=accum)
+        wf.initialize()
+        runner = wf._fused_runner
+        x = numpy.asarray(wf.loader.original_data.mem[:40])
+        mask = numpy.ones(40, numpy.float32)
+        _, m = runner._train(runner.state, x, x, mask,
+                             jnp.asarray(40, jnp.int32), None,
+                             jnp.asarray(0, jnp.int32))
+        outs.append({k: float(numpy.asarray(v)) for k, v in m.items()
+                     if numpy.asarray(v).ndim == 0})
+    assert outs[0]["rmse_max"] == pytest.approx(outs[1]["rmse_max"],
+                                                rel=1e-5)
+    assert outs[0]["mse_sum"] == pytest.approx(outs[1]["mse_sum"],
+                                               rel=1e-5)
+
+
+def test_epoch_scan_honors_grad_accum():
+    """The one-dispatch-per-epoch path must run the accumulating step
+    too (never silently drop the setting)."""
+    from veles_tpu.samples import mnist
+    states = []
+    for accum in (1, 2):
+        prng.reset(); prng.seed_all(7)
+        _configure()
+        wf = mnist.build(fused=True, grad_accum=accum)
+        wf.initialize()
+        runner = wf._fused_runner
+        loader = wf.loader
+        from bench import epoch_plan_arrays
+        idx, mask = epoch_plan_arrays(loader)
+        train_epoch, _ = runner.epoch_fns()
+        state, _ = train_epoch(runner.state,
+                               loader.original_data.devmem,
+                               loader.original_labels.devmem, idx, mask)
+        states.append(state)
+    for ea, eb in zip(*states):
+        for key in ea:
+            numpy.testing.assert_allclose(
+                numpy.asarray(ea[key]), numpy.asarray(eb[key]),
+                rtol=1e-5, atol=1e-6)
+
+
+def test_sharded_trainer_honors_grad_accum():
+    """The SPMD per-minibatch path must run the accumulating step too."""
+    from veles_tpu.samples import mnist
+    from veles_tpu.parallel import make_mesh, ShardedTrainer
+    rng = numpy.random.RandomState(3)
+    x = rng.randn(64, 784).astype(numpy.float32)
+    labels = rng.randint(0, 10, 64).astype(numpy.int32)
+    mask = numpy.ones(64, numpy.float32)
+    states = []
+    for accum in (1, 4):
+        prng.reset(); prng.seed_all(7)
+        _configure()
+        wf = mnist.build(fused=True, grad_accum=accum)
+        wf.initialize()
+        trainer = ShardedTrainer(wf._fused_runner, make_mesh(8))
+        trainer.train_step(x, labels, mask, 64)
+        states.append(trainer.state)
+    for ea, eb in zip(*states):
+        for key in ea:
+            numpy.testing.assert_allclose(
+                numpy.asarray(ea[key]), numpy.asarray(eb[key]),
+                rtol=1e-5, atol=1e-6)

@@ -25,12 +25,20 @@ from veles_tpu.loader.base import TRAIN
 class FusedRunner:
     """Builds and owns the fused step functions + device parameter state."""
 
-    def __init__(self, wf):
+    def __init__(self, wf, grad_accum=1):
         import jax
         self.wf = wf
         self.forwards = list(wf.forwards)
         self.evaluator = wf.evaluator
         self.gds = list(wf.gds)
+        #: microbatches per optimizer step (>1 = gradient accumulation:
+        #: the minibatch is split, grads — batch SUMS by convention —
+        #: add across microbatches, ONE update applies; peak activation
+        #: memory shrinks by the factor, enabling effective batches that
+        #: do not fit in HBM at once)
+        self.grad_accum = int(grad_accum)
+        if self.grad_accum < 1:
+            raise ValueError("grad_accum must be >= 1")
         self.state = self._pull_state()
         # loss routing: softmax-style evaluators consume labels, MSE-style
         # consume a target (linked on the evaluator; for autoencoders it
@@ -42,7 +50,12 @@ class FusedRunner:
         # No donation in per-minibatch graph mode: the update is only
         # COMMITTED after Decision gates it (see FusedStep/FusedCommit), so
         # the previous state must stay alive.  The epoch-scan path donates.
-        self._train = jax.jit(self._train_step)
+        #: the configured per-minibatch train step (monolithic or
+        #: gradient-accumulating) — the per-step jit AND the epoch scan
+        #: both route through it, so grad_accum is never silently dropped
+        self._step_fn = (self._train_step if self.grad_accum == 1
+                         else self._train_step_accum)
+        self._train = jax.jit(self._step_fn)
         self._eval = jax.jit(self._eval_step)
 
     # ----------------------------------------------------------------- state
@@ -84,11 +97,14 @@ class FusedRunner:
         _, metrics = self._loss(acts[-1], y_ref, mask)
         return metrics
 
-    def _train_step(self, state, x, y_ref, mask, batch_size, rng=None,
-                    step=0):
+    def _grads_and_metrics(self, state, x, y_ref, mask, rng=None):
+        """Forward + loss + backward WITHOUT updates: per-layer grad sums
+        (None for weightless layers) and the metric sums.  The per-layer
+        update in _train_step and the accumulate-then-update in
+        _train_step_accum both consume this."""
         acts = self._forward_chain(state, x, rng=rng, train=True)
         err, metrics = self._loss(acts[-1], y_ref, mask)
-        new_state = list(state)
+        all_grads = [None] * len(self.forwards)
         for i in range(len(self.forwards) - 1, -1, -1):
             if err is None:
                 # the first parameterized gd skipped err_input; everything
@@ -97,11 +113,75 @@ class FusedRunner:
             gd, entry = self.gds[i], state[i]
             err_in, grads = gd.backward_fused(
                 acts[i], acts[i + 1], err, entry, self._layer_rng(rng, i))
-            if grads is not None:
-                new_state[i] = gd.update_fused(entry, grads, batch_size,
-                                               step)
+            all_grads[i] = grads
             err = err_in
-        return new_state, metrics
+        return all_grads, metrics
+
+    def _apply_updates(self, state, all_grads, batch_size, step):
+        new_state = list(state)
+        for i, grads in enumerate(all_grads):
+            if grads is not None:
+                new_state[i] = self.gds[i].update_fused(
+                    state[i], grads, batch_size, step)
+        return new_state
+
+    def _train_step(self, state, x, y_ref, mask, batch_size, rng=None,
+                    step=0):
+        all_grads, metrics = self._grads_and_metrics(state, x, y_ref, mask,
+                                                     rng)
+        return self._apply_updates(state, all_grads, batch_size,
+                                   step), metrics
+
+    def _train_step_accum(self, state, x, y_ref, mask, batch_size,
+                          rng=None, step=0):
+        """Gradient-accumulation step: the minibatch splits into
+        ``grad_accum`` microbatches scanned on device; grad sums add
+        (they are batch SUMS by convention, so accumulation is exact up
+        to fp summation order), ``*_max`` metrics combine with maximum,
+        the rest add, and ONE update applies with the full live batch
+        size.  Stochastic layers draw a distinct key per microbatch
+        (documented semantics — dropout granularity follows the
+        microbatch).  The microbatch graph is traced ONCE (zeros-init
+        carry via eval_shape) so the accum path does not double compile
+        time."""
+        import jax
+        import jax.numpy as jnp
+        k = self.grad_accum
+        if x.shape[0] % k:
+            raise ValueError("minibatch %d not divisible by grad_accum %d"
+                             % (x.shape[0], k))
+
+        def split(a):
+            return (None if a is None
+                    else a.reshape((k, a.shape[0] // k) + a.shape[1:]))
+
+        xs, ys, ms = split(x), split(y_ref), split(mask)
+
+        def micro(i):
+            r = None if rng is None else jax.random.fold_in(rng, i)
+            y_i = None if ys is None else ys[i]
+            return self._grads_and_metrics(state, xs[i], y_i, ms[i], r)
+
+        g_shapes, m_shapes = jax.eval_shape(micro, 0)
+        g0 = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), g_shapes)
+        m0 = {key: (jnp.full(s.shape, -jnp.inf, s.dtype)
+                    if key.endswith("_max")
+                    else jnp.zeros(s.shape, s.dtype))
+              for key, s in m_shapes.items()}
+
+        def body(carry, i):
+            g_acc, m_acc = carry
+            g_i, m_i = micro(i)
+            g_acc = jax.tree.map(jnp.add, g_acc, g_i)
+            m_acc = {key: (jnp.maximum(m_acc[key], m_i[key])
+                           if key.endswith("_max")
+                           else m_acc[key] + m_i[key]) for key in m_acc}
+            return (g_acc, m_acc), None
+
+        (all_grads, metrics), _ = jax.lax.scan(body, (g0, m0),
+                                               jnp.arange(k))
+        return self._apply_updates(state, all_grads, batch_size,
+                                   step), metrics
 
     def measure_device_step_time(self, iters=10):
         """Steady-state device time of one fused train step, by re-running
@@ -156,8 +236,8 @@ class FusedRunner:
             bs = mb_mask.sum().astype(jnp.int32)
             step_rng = (jax.random.fold_in(rng, step)
                         if rng is not None else None)
-            carry, metrics = self._train_step(carry, x, y, mb_mask, bs,
-                                              step_rng, step0 + step)
+            carry, metrics = self._step_fn(carry, x, y, mb_mask, bs,
+                                           step_rng, step0 + step)
             return carry, metrics
 
         steps = jnp.arange(idx.shape[0])

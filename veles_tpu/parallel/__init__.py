@@ -105,7 +105,10 @@ class ShardedTrainer:
         self.state = jax.tree.map(self._put, runner.state, shardings)
         # out_shardings pins the updated state to the plan — otherwise
         # GSPMD may re-shard it to whatever propagation preferred
-        self._train = jax.jit(runner._train_step, donate_argnums=(0,),
+        # _step_fn: the runner's configured per-minibatch step
+        # (monolithic or gradient-accumulating) — grad_accum must hold
+        # on the SPMD path exactly as it does single-chip
+        self._train = jax.jit(runner._step_fn, donate_argnums=(0,),
                               out_shardings=(shardings, None))
         self._eval = jax.jit(runner._eval_step)
 

@@ -90,11 +90,13 @@ class StandardWorkflowBase(NNWorkflow):
     def __init__(self, workflow=None, name=None, loader_factory=None,
                  loader_config=None, layers=(), decision_config=None,
                  snapshotter_config=None, loss_function="softmax", fused=True,
-                 **kwargs):
+                 grad_accum=1, **kwargs):
         super().__init__(workflow, name=name, **kwargs)
         self.layers_config = list(layers)
         self.loss_function = loss_function
         self.fused = fused
+        #: microbatches per optimizer step (fused mode; see FusedRunner)
+        self.grad_accum = grad_accum
         self.snapshotter = None
         self._build(loader_factory, dict(loader_config or {}),
                     dict(decision_config or {}), snapshotter_config)
@@ -241,7 +243,7 @@ class StandardWorkflowBase(NNWorkflow):
         super().initialize(device=device, **kwargs)
         if self.fused:
             from veles_tpu.compiled import FusedRunner
-            self._fused_runner = FusedRunner(self)
+            self._fused_runner = FusedRunner(self, grad_accum=self.grad_accum)
             self._fused_runner.install()
         return self
 
