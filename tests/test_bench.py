@@ -1,0 +1,109 @@
+"""bench.py contract tests: ONE JSON line on every path, per-config
+watchdog isolation, and the emit_summary metric selection.
+
+These run the host-side configs only (records is pure host work;
+convergence math is covered elsewhere) so the suite stays fast.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+BENCH = os.path.join(REPO, "bench.py")
+
+
+def _run(args, env_extra=None, timeout=300):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.update(env_extra or {})
+    proc = subprocess.run(
+        [sys.executable, BENCH] + args, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, env=env, cwd=REPO, timeout=timeout)
+    lines = proc.stdout.decode().strip().splitlines()
+    json_lines = [ln for ln in lines if ln.startswith("{")]
+    return proc.returncode, json_lines
+
+
+def test_orchestrated_single_json_line():
+    """The default (subprocess-orchestrated) mode emits exactly one JSON
+    line and a well-formed record."""
+    rc, lines = _run(["--configs", "records", "--seconds", "0.2",
+                      "--smoke"])
+    assert rc == 0
+    assert len(lines) == 1, lines
+    rec = json.loads(lines[0])
+    assert rec["metric"] == "records_pipeline_samples_per_sec"
+    assert rec["value"] > 0
+    assert "records_pipeline" in rec["configs"]
+
+
+def test_watchdog_records_timeout_and_still_emits():
+    """A hung/slow config is killed and recorded as an error; the JSON
+    line still appears and the exit code flags the failure."""
+    rc, lines = _run(["--configs", "records", "--seconds", "0.2"],
+                     env_extra={"VELES_BENCH_CONFIG_TIMEOUT_S": "2"})
+    assert rc == 1
+    assert len(lines) == 1, lines
+    rec = json.loads(lines[0])
+    assert rec["metric"] == "bench_failed"
+    assert "records_error" in rec["configs"]
+    assert "killed after" in rec["configs"]["records_error"]
+
+
+def test_unknown_config_rejected():
+    proc = subprocess.run(
+        [sys.executable, BENCH, "--configs", "nope"],
+        capture_output=True, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        cwd=REPO, timeout=60)
+    assert proc.returncode == 2
+    assert b"unknown configs" in proc.stderr
+
+
+def test_convergence_sub_config_addressable():
+    """convergence:<sub> tokens are valid --configs entries (the
+    expansion the orchestrator uses for per-sub watchdogs)."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location("bench_mod", BENCH)
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    assert bench.expand_configs(["convergence"]) == [
+        "convergence:" + s for s in bench.CONVERGENCE_SUBS]
+    assert bench.expand_configs(["mnist", "lm"]) == ["mnist", "lm"]
+
+
+def test_emit_summary_priority_and_fallbacks():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location("bench_mod2", BENCH)
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    import io
+    import contextlib
+
+    def emit(results):
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = bench.emit_summary(dict(results))
+        return rc, json.loads(buf.getvalue().strip())
+
+    # model result wins and computes the records pipeline ratio
+    rc, rec = emit({
+        "mnist_fc": {"samples_per_sec": 10.0, "vs_numpy_floor": 2.0},
+        "alexnet": {"samples_per_sec": 100.0},
+        "alexnet_records": {"samples_per_sec": 90.0},
+    })
+    assert rc == 0
+    assert rec["metric"].startswith("mnist_fc")
+    assert rec["configs"]["alexnet_records"][
+        "pipeline_ratio_vs_hbm"] == 0.9
+    # skipped scaling alone is a success, not a failure
+    rc, rec = emit({"dp_scaling": {"skipped": "single device"}})
+    assert rc == 0 and rec["metric"] == "dp_scaling_skipped"
+    # all-errors still yields the one line with rc=1
+    rc, rec = emit({"mnist_error": "boom"})
+    assert rc == 1 and rec["metric"] == "bench_failed"
