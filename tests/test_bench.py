@@ -45,8 +45,10 @@ def test_orchestrated_single_json_line():
 
 def test_watchdog_records_timeout_and_still_emits():
     """A hung/slow config is killed and recorded as an error; the JSON
-    line still appears and the exit code flags the failure."""
-    rc, lines = _run(["--configs", "records", "--seconds", "0.2"],
+    line still appears and the exit code flags the failure.  --seconds
+    9999 makes the worker's timing window provably longer than the 2 s
+    deadline on ANY machine (deterministic kill, not a startup race)."""
+    rc, lines = _run(["--configs", "records", "--seconds", "9999"],
                      env_extra={"VELES_BENCH_CONFIG_TIMEOUT_S": "2"})
     assert rc == 1
     assert len(lines) == 1, lines

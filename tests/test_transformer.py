@@ -277,3 +277,101 @@ class TestRemat:
             assert losses[-1] < losses[0]
         finally:
             root.char_lm.trainer.update({"remat": False})   # don't leak
+
+
+class TestGenerate:
+    def _params(self, n_experts=0):
+        prng.reset(); prng.seed_all(13)
+        host = T.init_transformer_params(prng.get("init"), vocab=16,
+                                         d_model=32, n_heads=2,
+                                         n_layers=2, max_len=24,
+                                         n_experts=n_experts)
+        return jax.tree.map(jnp.asarray, host)
+
+    def test_kv_cached_decode_matches_full_forward(self):
+        """Teacher-forced: stepping each position through the KV-cached
+        decode path must reproduce the full forward's logits exactly."""
+        params = self._params()
+        key = jax.random.PRNGKey(2)
+        tokens = jax.random.randint(key, (3, 10), 0, 16, jnp.int32)
+        full = T.transformer_forward(params, tokens, n_heads=2)
+
+        s0 = 4                       # prefill 4, decode the rest
+        h, caches = T.prefill(params, tokens[:, :s0], 2, max_len=10)
+        got = [T.head_logits(params, h)]           # positions 0..3
+        for p in range(s0, 10):
+            x = (jnp.take(params["embed"], tokens[:, p], axis=0)[:, None]
+                 + params["pos"][p][None, None])
+            new = []
+            for blk, (kc, vc) in zip(params["blocks"], caches):
+                x, kc, vc = T.block_decode_step(blk, x, kc, vc, p, 2)
+                new.append((kc, vc))
+            caches = new
+            got.append(T.head_logits(params, x))
+        stepped = jnp.concatenate(got, axis=1)
+        numpy.testing.assert_allclose(numpy.asarray(full),
+                                      numpy.asarray(stepped),
+                                      rtol=2e-5, atol=2e-5)
+
+    def test_generate_greedy_deterministic(self):
+        params = self._params()
+        prompt = jnp.asarray([[1, 2, 3], [4, 5, 6]], jnp.int32)
+        out1 = T.generate(params, prompt, n_new=8, n_heads=2,
+                          temperature=0)
+        out2 = T.generate(params, prompt, n_new=8, n_heads=2,
+                          temperature=0)
+        assert out1.shape == (2, 11)
+        numpy.testing.assert_array_equal(numpy.asarray(out1),
+                                         numpy.asarray(out2))
+        numpy.testing.assert_array_equal(numpy.asarray(out1[:, :3]),
+                                         numpy.asarray(prompt))
+        assert int(out1.max()) < 16 and int(out1.min()) >= 0
+
+    def test_generate_greedy_matches_full_forward_argmax(self):
+        """Greedy decode must pick exactly the argmax the full forward
+        assigns at every step (the KV cache changes nothing)."""
+        params = self._params()
+        prompt = jnp.asarray([[7, 3]], jnp.int32)
+        out = numpy.asarray(T.generate(params, prompt, n_new=5,
+                                       n_heads=2, temperature=0))[0]
+        seq = list(map(int, prompt[0]))
+        for step in range(5):
+            logits = T.transformer_forward(
+                params, jnp.asarray([seq], jnp.int32), n_heads=2)
+            nxt = int(jnp.argmax(logits[0, -1]))
+            assert nxt == int(out[len(seq)]), (step, seq)
+            seq.append(nxt)
+
+    def test_generate_sampling_and_moe(self):
+        params = self._params(n_experts=2)
+        prompt = jnp.asarray([[1, 2]], jnp.int32)
+        out = T.generate(params, prompt, n_new=6, n_heads=2,
+                         rng=jax.random.PRNGKey(5), temperature=0.8)
+        assert out.shape == (1, 8)
+        assert int(out.max()) < 16
+        with pytest.raises(ValueError):
+            T.generate(params, prompt, n_new=6, n_heads=2)  # no rng
+        with pytest.raises(ValueError):
+            T.generate(params, prompt, n_new=99, n_heads=2,
+                       temperature=0)   # exceeds positional table
+
+
+def test_char_lm_generates_the_grammar():
+    """End-to-end: a char-LM trained on the cyclic grammar must greedily
+    CONTINUE the pattern t[i+1] = (t[i] + step) % vocab from a prompt."""
+    prng.reset(); prng.seed_all(4)
+    root.char_lm.update({
+        "loader": {"minibatch_size": 64, "n_train": 512, "n_valid": 128,
+                   "seq_len": 48, "vocab": 16},
+        "trainer": {"vocab": 16, "d_model": 64, "n_heads": 4,
+                    "n_layers": 2, "max_len": 48, "learning_rate": 3e-3,
+                    "n_experts": 0, "pipeline_stages": 0, "remat": False},
+        "decision": {"max_epochs": 14, "fail_iterations": 30},
+    })
+    from veles_tpu.samples import char_lm
+    wf = char_lm.train()
+    # prompt follows the grammar with step 3: 1, 4, 7, 10, ...
+    prompt = [(1 + 3 * i) % 16 for i in range(8)]
+    out = char_lm.sample_tokens(wf, [prompt], n_new=12, temperature=0.0)
+    expect = [(1 + 3 * i) % 16 for i in range(20)]
+    assert out[0].tolist() == expect, (out[0].tolist(), expect)

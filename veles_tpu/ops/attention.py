@@ -112,8 +112,14 @@ def init_mha_params(stream, d_model, n_heads, dtype="float32"):
     return {"wq": mk(), "wk": mk(), "wv": mk(), "wo": mk()}
 
 
-def mha_forward(params, x, n_heads, causal=True, block_size=None):
-    """Multi-head attention over (batch, seq, d_model)."""
+def mha_forward(params, x, n_heads, causal=True, block_size=None,
+                return_kv=False):
+    """Multi-head attention over (batch, seq, d_model).
+
+    ``return_kv=True`` additionally returns the projected (k, v) heads
+    — the prefill half of KV-cached decoding (autoregressive serving
+    writes them into the cache once instead of recomputing per token).
+    """
     b, s, d = x.shape
     dh = d // n_heads
 
@@ -126,4 +132,37 @@ def mha_forward(params, x, n_heads, causal=True, block_size=None):
     else:
         o = attention(q, k, v, causal=causal)
     o = o.transpose(0, 2, 1, 3).reshape(b, s, d)
-    return matmul(o, params["wo"])
+    out = matmul(o, params["wo"])
+    return (out, k, v) if return_kv else out
+
+
+def mha_decode_step(params, x, k_cache, v_cache, pos, n_heads):
+    """One autoregressive decode step with a KV cache.
+
+    x: (batch, 1, d_model) — the current position's activations;
+    k_cache/v_cache: (batch, heads, max_len, head_dim) with positions
+    [0, pos) filled; ``pos`` is a traced scalar.  Returns
+    (out (batch, 1, d_model), k_cache, v_cache) with position ``pos``
+    written.  The O(seq) attention against the cache replaces the
+    O(seq²) full recompute per generated token — the standard serving
+    path on TPU (static cache shape, dynamic_update_slice, no growing
+    arrays under jit).
+    """
+    b, _, d = x.shape
+    dh = d // n_heads
+
+    def split(w):
+        return matmul(x, w).reshape(b, 1, n_heads, dh).transpose(0, 2, 1, 3)
+
+    q = split(params["wq"])                     # (b, h, 1, dh)
+    k_cache = jax.lax.dynamic_update_slice(
+        k_cache, split(params["wk"]), (0, 0, pos, 0))
+    v_cache = jax.lax.dynamic_update_slice(
+        v_cache, split(params["wv"]), (0, 0, pos, 0))
+    scores = matmul(q, jnp.swapaxes(k_cache, -1, -2)) / jnp.sqrt(
+        jnp.asarray(dh, q.dtype))               # (b, h, 1, max_len)
+    live = jnp.arange(k_cache.shape[2]) <= pos
+    scores = jnp.where(live[None, None, None, :], scores, NEG_INF)
+    o = matmul(jax.nn.softmax(scores, axis=-1), v_cache)
+    o = o.transpose(0, 2, 1, 3).reshape(b, 1, d)
+    return matmul(o, params["wo"]), k_cache, v_cache

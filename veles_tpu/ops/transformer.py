@@ -83,7 +83,6 @@ def block_forward(blk, h, n_heads, block_size=None, attn_fn=None,
     the routed expert FFN in place of the dense one; ``with_aux=True``
     returns (h, moe_load_balancing_loss) (0 for dense blocks;
     ``token_mask`` keeps padded rows out of the router statistics)."""
-    import jax.numpy as jnp
     hn = _layernorm(h, blk["ln1"]["g"], blk["ln1"]["b"])
     if attn_fn is not None:
         h = h + attn_fn(blk["attn"], hn)
@@ -91,16 +90,24 @@ def block_forward(blk, h, n_heads, block_size=None, attn_fn=None,
         h = h + mha_forward(blk["attn"], hn, n_heads, causal=True,
                             block_size=block_size)
     hn = _layernorm(h, blk["ln2"]["g"], blk["ln2"]["b"])
+    if "moe" in blk and with_aux:
+        from veles_tpu.ops.moe import moe_ffn
+        out, aux = moe_ffn(blk["moe"], hn, return_aux=True,
+                           token_mask=token_mask)
+        return h + out, aux
+    h = h + _block_ffn(blk, hn)
+    return (h, 0.0) if with_aux else h
+
+
+def _block_ffn(blk, hn):
+    """The FFN half of a block (dense or routed-MoE), shared by the
+    training forward and the KV-cached decode step."""
+    import jax.numpy as jnp
     if "moe" in blk:
         from veles_tpu.ops.moe import moe_ffn
-        if with_aux:
-            out, aux = moe_ffn(blk["moe"], hn, return_aux=True,
-                               token_mask=token_mask)
-            return h + out, aux
-        return h + moe_ffn(blk["moe"], hn)
+        return moe_ffn(blk["moe"], hn)
     ff = jnp.maximum(F.matmul(hn, blk["w1"]) + blk["b1"], 0.0)
-    h = h + F.matmul(ff, blk["w2"]) + blk["b2"]
-    return (h, 0.0) if with_aux else h
+    return F.matmul(ff, blk["w2"]) + blk["b2"]
 
 
 def embed_tokens(params, tokens):
@@ -177,6 +184,129 @@ def lm_loss(params, tokens, mask, n_heads, block_size=None,
     if n_moe:
         loss = loss + moe_aux_coef * aux_total / n_moe
     return loss
+
+
+# ---------------------------------------------------------------- serving
+def prefill(params, tokens, n_heads, max_len):
+    """Run the prompt through the stack once, capturing each block's
+    projected K/V heads into fixed-shape caches.
+
+    Returns (h (b, s, d) block-stack activations, caches) where caches
+    is a per-block list of (k, v) arrays shaped
+    (batch, heads, max_len, head_dim) with positions [0, s) filled —
+    the state KV-cached decoding (``generate``) continues from.  Reuses
+    ``block_forward`` via a K/V-capturing ``attn_fn``, so training and
+    serving can never drift on block wiring.
+    """
+    import jax.numpy as jnp
+    h = embed_tokens(params, tokens)
+    s = h.shape[1]
+    pad = [(0, 0), (0, 0), (0, max_len - s), (0, 0)]
+    caches = []
+    for blk in params["blocks"]:
+        captured = {}
+
+        def attn_capture(p, hn, captured=captured):
+            out, k, v = mha_forward(p, hn, n_heads, causal=True,
+                                    return_kv=True)
+            captured["kv"] = (k, v)
+            return out
+
+        h = block_forward(blk, h, n_heads, attn_fn=attn_capture)
+        k, v = captured["kv"]
+        caches.append((jnp.pad(k, pad), jnp.pad(v, pad)))
+    return h, caches
+
+
+def block_decode_step(blk, h, k_cache, v_cache, pos, n_heads):
+    """One block over ONE position against its KV cache (decode path)."""
+    from veles_tpu.ops.attention import mha_decode_step
+    hn = _layernorm(h, blk["ln1"]["g"], blk["ln1"]["b"])
+    attn, k_cache, v_cache = mha_decode_step(blk["attn"], hn, k_cache,
+                                             v_cache, pos, n_heads)
+    h = h + attn
+    hn = _layernorm(h, blk["ln2"]["g"], blk["ln2"]["b"])
+    return h + _block_ffn(blk, hn), k_cache, v_cache
+
+
+def _generate_impl(params, prompt, rng, n_new, n_heads, temperature):
+    import jax
+    import jax.numpy as jnp
+    s = prompt.shape[1]
+    max_len = s + n_new
+    h, caches = prefill(params, prompt, n_heads, max_len)
+    logits = head_logits(params, h[:, -1:, :])[:, 0, :]
+
+    def sample(logits, key):
+        if not temperature:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(
+            key, logits / jnp.asarray(temperature, logits.dtype),
+            axis=-1).astype(jnp.int32)
+
+    def next_key(key):
+        return jax.random.split(key) if key is not None else (None, None)
+
+    # the final sampled token never feeds the stack again, so the scan
+    # runs n_new - 1 decode steps and the last sample happens outside
+    # (no dead block-stack pass)
+    def body(carry, i):
+        caches, logits, key = carry
+        key, sub = next_key(key)
+        tok = sample(logits, sub)
+        pos = s + i
+        x = (jnp.take(params["embed"], tok, axis=0)[:, None, :]
+             + jax.lax.dynamic_slice_in_dim(params["pos"], pos, 1,
+                                            axis=0)[None])
+        new_caches = []
+        for blk, (kc, vc) in zip(params["blocks"], caches):
+            x, kc, vc = block_decode_step(blk, x, kc, vc, pos, n_heads)
+            new_caches.append((kc, vc))
+        logits = head_logits(params, x)[:, 0, :]
+        return (new_caches, logits, key), tok
+
+    key0 = rng if temperature else None
+    (_, logits, key), toks = jax.lax.scan(body, (caches, logits, key0),
+                                          jnp.arange(n_new - 1))
+    _, sub = next_key(key)
+    last = sample(logits, sub)
+    toks = jnp.concatenate([toks.T, last[:, None]], axis=1)
+    return jnp.concatenate([prompt, toks.astype(jnp.int32)], axis=1)
+
+
+#: cached jit of _generate_impl (n_new/n_heads/temperature static) — a
+#: fresh jax.jit wrapper per call would retrace every time
+_GENERATE_JIT = None
+
+
+def generate(params, prompt, n_new, n_heads, rng=None, temperature=1.0):
+    """Autoregressive sampling with a KV cache, fully under jit.
+
+    prompt: (batch, s) int32; returns (batch, s + n_new) int32.
+    One prefill pass captures the prompt's K/V; each new token then
+    attends against the fixed-shape cache via ``dynamic_update_slice``
+    (O(seq) per token instead of O(seq²) full recompute — the TPU
+    serving shape: static shapes, ``lax.scan`` over positions, no host
+    round-trips).  ``temperature=0`` decodes greedily (argmax) and
+    needs no rng; otherwise ``rng`` seeds categorical sampling.
+    """
+    import jax
+    global _GENERATE_JIT
+    if n_new < 1:
+        raise ValueError("n_new must be >= 1")
+    if prompt.shape[1] + n_new > params["pos"].shape[0]:
+        raise ValueError("prompt + n_new = %d exceeds the positional "
+                         "table (%d)" % (prompt.shape[1] + n_new,
+                                         params["pos"].shape[0]))
+    if temperature and rng is None:
+        raise ValueError("sampling (temperature > 0) needs rng")
+    if _GENERATE_JIT is None:
+        _GENERATE_JIT = jax.jit(
+            _generate_impl,
+            static_argnames=("n_new", "n_heads", "temperature"))
+    return _GENERATE_JIT(params, prompt, rng if temperature else None,
+                         n_new=n_new, n_heads=n_heads,
+                         temperature=temperature)
 
 
 def make_adam_train_step(loss_fn, learning_rate, beta1=0.9, beta2=0.999,
