@@ -486,6 +486,35 @@ def bench_lm(smoke=False, iters=None):
     remat_s = measure(remat=True)
     rec["tokens_per_sec_remat"] = round(toks / remat_s, 1)
     rec["remat_overhead_pct"] = round(100.0 * (remat_s / step_s - 1.0), 1)
+
+    # serving side: KV-cached greedy decode throughput.  generate() is
+    # one jit call (prefill + scan); both timings PIN the same max_len
+    # (cache shape) so the n_long-vs-n_short subtraction isolates step
+    # count alone — prefill, dispatch, and cache size all cancel
+    from veles_tpu.ops.transformer import generate
+    key = jax.random.PRNGKey(3)
+    n_short, n_long = (2, 10) if smoke else (8, 64)
+    dec_mb = 1 if smoke else 8
+    dprompt = jax.random.randint(key, (dec_mb, 8), 0, vocab, jnp.int32)
+    cache_len = 8 + n_long
+
+    def decode_time(n):
+        out = generate(params, dprompt, n, heads, temperature=0,
+                       max_len=cache_len)
+        _sync(out)   # compile
+        best = float("inf")
+        for _ in range(3):
+            begin = time.perf_counter()
+            _sync(generate(params, dprompt, n, heads, temperature=0,
+                           max_len=cache_len))
+            best = min(best, time.perf_counter() - begin)
+        return best
+
+    per_tok = (decode_time(n_long) - decode_time(n_short)) \
+        / (n_long - n_short)
+    rec["decode_tokens_per_sec"] = round(dec_mb / per_tok, 1)
+    rec["decode_ms_per_token"] = round(per_tok * 1e3, 3)
+    rec["decode_batch"] = dec_mb
     return rec
 
 

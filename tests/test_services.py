@@ -52,6 +52,48 @@ class TestRESTServing:
         finally:
             api.stop()
 
+    def test_serve_lm_continuation(self):
+        """LM serving endpoint: tokens in, KV-cached continuation out."""
+        from veles_tpu import prng
+        from veles_tpu.config import root
+        from veles_tpu.restful_api import serve_lm
+        prng.reset(); prng.seed_all(4)
+        root.char_lm.update({
+            "loader": {"minibatch_size": 32, "n_train": 128, "n_valid": 64,
+                       "seq_len": 32, "vocab": 16},
+            "trainer": {"vocab": 16, "d_model": 32, "n_heads": 2,
+                        "n_layers": 1, "max_len": 32,
+                        "learning_rate": 3e-3, "n_experts": 0,
+                        "pipeline_stages": 0, "remat": False},
+            "decision": {"max_epochs": 2, "fail_iterations": 10},
+        })
+        from veles_tpu.samples import char_lm
+        wf = char_lm.train()
+        api = serve_lm(wf, port=0, max_new=8)
+        try:
+            req = urllib.request.Request(
+                "http://127.0.0.1:%d/predict" % api.port,
+                data=json.dumps({"input": [[1, 2, 3]], "n_new": 5}
+                                ).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                out = json.loads(resp.read())
+            row = out["tokens"][0]
+            assert len(row) == 8                    # 3 prompt + 5 new
+            assert row[:3] == [1, 2, 3]
+            assert all(0 <= t < 16 for t in row)
+            # n_new clamped to max_new
+            req = urllib.request.Request(
+                "http://127.0.0.1:%d/predict" % api.port,
+                data=json.dumps({"input": [[1, 2, 3]], "n_new": 999,
+                                 "temperature": 0.7, "seed": 1}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                out = json.loads(resp.read())
+            assert len(out["tokens"][0]) == 3 + 8
+        finally:
+            api.stop()
+
     def test_bad_request_is_400(self, tmp_path):
         from veles_tpu.restful_api import RESTfulAPI
         wf = _train_tiny_mnist(tmp_path)

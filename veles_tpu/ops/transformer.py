@@ -229,23 +229,26 @@ def block_decode_step(blk, h, k_cache, v_cache, pos, n_heads):
     return h + _block_ffn(blk, hn), k_cache, v_cache
 
 
-def _generate_impl(params, prompt, rng, n_new, n_heads, temperature):
+def _generate_impl(params, prompt, rng, temperature, n_new, n_heads,
+                   greedy, max_len):
     import jax
     import jax.numpy as jnp
     s = prompt.shape[1]
-    max_len = s + n_new
     h, caches = prefill(params, prompt, n_heads, max_len)
     logits = head_logits(params, h[:, -1:, :])[:, 0, :]
 
     def sample(logits, key):
-        if not temperature:
+        if greedy:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        # temperature is TRACED: every sampling temperature shares one
+        # compilation (serve_lm exposes it to clients — a static arg
+        # would let them force a recompile per distinct value)
         return jax.random.categorical(
-            key, logits / jnp.asarray(temperature, logits.dtype),
-            axis=-1).astype(jnp.int32)
+            key, logits / temperature, axis=-1).astype(jnp.int32)
 
     def next_key(key):
         return jax.random.split(key) if key is not None else (None, None)
+
 
     # the final sampled token never feeds the stack again, so the scan
     # runs n_new - 1 decode steps and the last sample happens outside
@@ -265,7 +268,7 @@ def _generate_impl(params, prompt, rng, n_new, n_heads, temperature):
         logits = head_logits(params, x)[:, 0, :]
         return (new_caches, logits, key), tok
 
-    key0 = rng if temperature else None
+    key0 = None if greedy else rng
     (_, logits, key), toks = jax.lax.scan(body, (caches, logits, key0),
                                           jnp.arange(n_new - 1))
     _, sub = next_key(key)
@@ -274,12 +277,14 @@ def _generate_impl(params, prompt, rng, n_new, n_heads, temperature):
     return jnp.concatenate([prompt, toks.astype(jnp.int32)], axis=1)
 
 
-#: cached jit of _generate_impl (n_new/n_heads/temperature static) — a
-#: fresh jax.jit wrapper per call would retrace every time
+#: cached jit of _generate_impl (n_new/n_heads/greedy/max_len static,
+#: temperature TRACED) — a fresh jax.jit wrapper per call would retrace
+#: every time
 _GENERATE_JIT = None
 
 
-def generate(params, prompt, n_new, n_heads, rng=None, temperature=1.0):
+def generate(params, prompt, n_new, n_heads, rng=None, temperature=1.0,
+             max_len=None):
     """Autoregressive sampling with a KV cache, fully under jit.
 
     prompt: (batch, s) int32; returns (batch, s + n_new) int32.
@@ -288,25 +293,52 @@ def generate(params, prompt, n_new, n_heads, rng=None, temperature=1.0):
     (O(seq) per token instead of O(seq²) full recompute — the TPU
     serving shape: static shapes, ``lax.scan`` over positions, no host
     round-trips).  ``temperature=0`` decodes greedily (argmax) and
-    needs no rng; otherwise ``rng`` seeds categorical sampling.
+    needs no rng; otherwise ``rng`` seeds categorical sampling (the
+    temperature value is traced — all temperatures share one compile).
+    ``max_len`` pins the cache size (default prompt + n_new) so callers
+    timing different ``n_new`` can hold the cache shape constant.
     """
     import jax
+    import jax.numpy as jnp
     global _GENERATE_JIT
     if n_new < 1:
         raise ValueError("n_new must be >= 1")
-    if prompt.shape[1] + n_new > params["pos"].shape[0]:
-        raise ValueError("prompt + n_new = %d exceeds the positional "
-                         "table (%d)" % (prompt.shape[1] + n_new,
-                                         params["pos"].shape[0]))
-    if temperature and rng is None:
+    if max_len is None:
+        max_len = prompt.shape[1] + n_new
+    if prompt.shape[1] + n_new > max_len:
+        raise ValueError("prompt + n_new = %d exceeds max_len %d"
+                         % (prompt.shape[1] + n_new, max_len))
+    if max_len > params["pos"].shape[0]:
+        raise ValueError("max_len %d exceeds the positional table (%d)"
+                         % (max_len, params["pos"].shape[0]))
+    greedy = not temperature
+    if not greedy and rng is None:
         raise ValueError("sampling (temperature > 0) needs rng")
     if _GENERATE_JIT is None:
         _GENERATE_JIT = jax.jit(
             _generate_impl,
-            static_argnames=("n_new", "n_heads", "temperature"))
-    return _GENERATE_JIT(params, prompt, rng if temperature else None,
-                         n_new=n_new, n_heads=n_heads,
-                         temperature=temperature)
+            static_argnames=("n_new", "n_heads", "greedy", "max_len"))
+    return _GENERATE_JIT(params, prompt, None if greedy else rng,
+                         jnp.asarray(temperature or 1.0, jnp.float32),
+                         n_new=n_new, n_heads=n_heads, greedy=greedy,
+                         max_len=max_len)
+
+
+def trainer_sample_tokens(trainer, prompt, n_new=32, temperature=0.0,
+                          seed=0):
+    """Continue token sequences with a trained TransformerTrainer —
+    the ONE decode entry point shared by the sample helpers
+    (char_lm.sample_tokens) and HTTP serving (restful_api.serve_lm):
+    marshals params to the portable per-layer form (works on pipelined
+    trainers too) and runs the KV-cached ``generate``."""
+    import jax
+    import jax.numpy as jnp
+    params = trainer._to_portable(trainer.params)
+    rng = jax.random.PRNGKey(seed) if temperature else None
+    return numpy.asarray(generate(params,
+                                  jnp.asarray(prompt, jnp.int32),
+                                  n_new, trainer.n_heads, rng=rng,
+                                  temperature=temperature))
 
 
 def make_adam_train_step(loss_fn, learning_rate, beta1=0.9, beta2=0.999,

@@ -25,7 +25,8 @@ from veles_tpu.logger import Logger
 
 
 class RESTfulAPI(Logger):
-    def __init__(self, workflow, normalizer=None, forward=None):
+    def __init__(self, workflow, normalizer=None, forward=None,
+                 handler=None):
         self.workflow = workflow
         #: optional input normalizer (a loader's fitted normalizer) applied
         #: before the forward, so clients send raw feature scale
@@ -35,6 +36,10 @@ class RESTfulAPI(Logger):
         #: explicit forward callable (batch ndarray -> ndarray) — used by
         #: artifact serving, where there is no workflow at all
         self._forward = forward
+        #: full-request handler (payload dict -> response dict); when set
+        #: it replaces the predict flow entirely — used by serve_lm, whose
+        #: requests carry decoding knobs beyond "input"
+        self._handler = handler
 
     # ------------------------------------------------------------- inference
     def _ensure_forward(self):
@@ -84,7 +89,9 @@ class RESTfulAPI(Logger):
                 try:
                     length = int(self.headers.get("Content-Length", 0))
                     payload = json.loads(self.rfile.read(length))
-                    result = api.predict(payload["input"])
+                    result = (api._handler(payload)
+                              if api._handler is not None
+                              else api.predict(payload["input"]))
                     body = json.dumps(result).encode("utf-8")
                     self.send_response(200)
                     self.send_header("Content-Type", "application/json")
@@ -115,6 +122,28 @@ class RESTfulAPI(Logger):
             self._server.shutdown()
             self._server.server_close()
             self._server = None
+
+
+def serve_lm(workflow, host="127.0.0.1", port=8180, max_new=256):
+    """Serve a trained transformer-trainer workflow (e.g. char_lm) for
+    autoregressive continuation: POST ``{"input": [[tok, ...]],
+    "n_new": N, "temperature": T, "seed": S}`` to ``/predict`` returns
+    ``{"tokens": [[...]]}`` — prompt plus continuation per row.
+    Decoding is the KV-cached ``transformer.generate`` path, one jitted
+    dispatch per request; ``n_new`` is clamped to ``max_new``.
+    """
+    from veles_tpu.ops.transformer import trainer_sample_tokens
+    trainer = workflow.trainer
+
+    def handler(request):
+        out = trainer_sample_tokens(
+            trainer, request["input"],
+            n_new=min(int(request.get("n_new", 32)), max_new),
+            temperature=float(request.get("temperature", 0.0)),
+            seed=int(request.get("seed", 0)))
+        return {"tokens": out.tolist()}
+
+    return RESTfulAPI(None, handler=handler).start(host=host, port=port)
 
 
 def serve_artifact(path, host="127.0.0.1", port=8180):

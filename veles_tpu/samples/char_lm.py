@@ -93,16 +93,10 @@ build, train, run = make_trainer_sample("char_lm", CharLMWorkflow,
 
 def sample_tokens(wf, prompt, n_new=32, temperature=0.0, seed=0):
     """Continue token sequences with the trained model — KV-cached
-    autoregressive decoding (ops.transformer.generate), greedy by
-    default.  ``prompt``: (batch, s) ints; returns (batch, s + n_new)
-    numpy int32.  Works on sequential and pipelined trainers (params
-    are marshalled to the portable per-layer form)."""
-    import jax
-    import jax.numpy as jnp
-    from veles_tpu.ops.transformer import generate
-    trainer = wf.trainer
-    params = trainer._to_portable(trainer.params)
-    rng = jax.random.PRNGKey(seed) if temperature else None
-    return numpy.asarray(generate(params, jnp.asarray(prompt, jnp.int32),
-                                  n_new, trainer.n_heads, rng=rng,
-                                  temperature=temperature))
+    autoregressive decoding, greedy by default.  ``prompt``:
+    (batch, s) ints; returns (batch, s + n_new) numpy int32.  Thin
+    wrapper over ops.transformer.trainer_sample_tokens (the shared
+    decode entry point, pipelined-trainer safe)."""
+    from veles_tpu.ops.transformer import trainer_sample_tokens
+    return trainer_sample_tokens(wf.trainer, prompt, n_new=n_new,
+                                 temperature=temperature, seed=seed)
