@@ -325,20 +325,24 @@ def generate(params, prompt, n_new, n_heads, rng=None, temperature=1.0,
 
 
 def trainer_sample_tokens(trainer, prompt, n_new=32, temperature=0.0,
-                          seed=0):
+                          seed=0, params=None, max_len=None):
     """Continue token sequences with a trained TransformerTrainer —
     the ONE decode entry point shared by the sample helpers
     (char_lm.sample_tokens) and HTTP serving (restful_api.serve_lm):
     marshals params to the portable per-layer form (works on pipelined
-    trainers too) and runs the KV-cached ``generate``."""
+    trainers too) and runs the KV-cached ``generate``.  Pass ``params``
+    to reuse an already-marshalled tree (servers marshal once, not per
+    request); ``max_len`` pins the cache shape across calls."""
     import jax
     import jax.numpy as jnp
-    params = trainer._to_portable(trainer.params)
+    if params is None:
+        params = trainer._to_portable(trainer.params)
     rng = jax.random.PRNGKey(seed) if temperature else None
     return numpy.asarray(generate(params,
                                   jnp.asarray(prompt, jnp.int32),
                                   n_new, trainer.n_heads, rng=rng,
-                                  temperature=temperature))
+                                  temperature=temperature,
+                                  max_len=max_len))
 
 
 def make_adam_train_step(loss_fn, learning_rate, beta1=0.9, beta2=0.999,

@@ -134,13 +134,29 @@ def serve_lm(workflow, host="127.0.0.1", port=8180, max_new=256):
     """
     from veles_tpu.ops.transformer import trainer_sample_tokens
     trainer = workflow.trainer
+    # marshalled ONCE (params are frozen while serving; pipelined
+    # trainers pay the block unstack here, not per request)
+    params = trainer._to_portable(trainer.params)
+    cache_len = int(trainer.max_len)
 
     def handler(request):
+        prompt = request["input"]
+        n_new = min(int(request.get("n_new", 32)), max_new)
+        # decode length and cache shape are jit-STATIC: always decode up
+        # to the clamp (truncating the reply) with the cache pinned at
+        # the positional-table size, so compiles are bounded by the set
+        # of distinct PROMPT lengths (each compiled once) — a client
+        # varying n_new per request cannot force recompiles
+        run = min(max_new, cache_len - len(prompt[0]))
+        if run < 1:
+            raise ValueError("prompt length %d leaves no room to decode "
+                             "(max_len %d)" % (len(prompt[0]), cache_len))
         out = trainer_sample_tokens(
-            trainer, request["input"],
-            n_new=min(int(request.get("n_new", 32)), max_new),
+            trainer, prompt, n_new=run,
             temperature=float(request.get("temperature", 0.0)),
-            seed=int(request.get("seed", 0)))
+            seed=int(request.get("seed", 0)), params=params,
+            max_len=cache_len)
+        out = out[:, :len(prompt[0]) + min(n_new, run)]
         return {"tokens": out.tolist()}
 
     return RESTfulAPI(None, handler=handler).start(host=host, port=port)
