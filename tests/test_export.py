@@ -80,6 +80,40 @@ class TestExportRoundTrip:
         assert all(k.split("/")[1] in ("w", "b")
                    for k in model.manifest["param_keys"])
 
+    def test_int8_quantized_artifact(self, trained_and_artifact, tmp_path):
+        """int8 export: smaller file, int8 weights + per-channel scales
+        in the bundle, near-identical predictions (int8 is storage-only;
+        load_model dequantizes once)."""
+        import os
+        from veles_tpu import export
+        wf, fp32_path = trained_and_artifact
+        q_path = str(tmp_path / "mnist_int8.veles")
+        export.export_model(wf, q_path, quantize="int8")
+
+        ref = export.load_model(fp32_path)
+        qm = export.load_model(q_path)
+        assert qm.manifest["quantize"] == "int8"
+        # stored payload is int8 (+ per-channel scales); loaded params
+        # are dequantized ONCE to f32 (no per-call dequant in the
+        # program)
+        import io as _io
+        import tarfile as _tarfile
+        with _tarfile.open(q_path, "r:gz") as tar:
+            npz = numpy.load(_io.BytesIO(
+                tar.extractfile(export.WEIGHTS).read()))
+            assert npz["0/w"].dtype == numpy.int8
+            assert npz["0/w.scale"].shape == (32,)
+        widx = qm.manifest["param_keys"].index("0/w")
+        assert qm._params[widx].dtype == numpy.float32
+
+        rng = numpy.random.RandomState(5)
+        x = rng.uniform(-1, 1, (200, 784)).astype(numpy.float32)
+        a = ref.predict(x).argmax(axis=1)
+        b = qm.predict(x).argmax(axis=1)
+        assert (a == b).mean() >= 0.98, (a == b).mean()
+        # 4x fewer weight bytes dominates the bundle for this model
+        assert os.path.getsize(q_path) < 0.6 * os.path.getsize(fp32_path)
+
     def test_no_solver_accumulators_shipped(self, tmp_path):
         """adagrad/adadelta accumulators are optimizer state, not model
         parameters — the serving artifact must stay weights+biases only."""
