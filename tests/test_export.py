@@ -93,6 +93,8 @@ class TestExportRoundTrip:
         ref = export.load_model(fp32_path)
         qm = export.load_model(q_path)
         assert qm.manifest["quantize"] == "int8"
+        # stamped format 2: pre-quantization loaders reject it cleanly
+        assert qm.manifest["format"] == export.FORMAT_QUANTIZED
         # stored payload is int8 (+ per-channel scales); loaded params
         # are dequantized ONCE to f32 (no per-call dequant in the
         # program)

@@ -162,3 +162,35 @@ def test_sharded_trainer_honors_grad_accum():
             numpy.testing.assert_allclose(
                 numpy.asarray(ea[key]), numpy.asarray(eb[key]),
                 rtol=1e-5, atol=1e-6)
+
+
+def test_grad_accum_reachable_from_config_and_cli():
+    """root.<name>.grad_accum flows through the sample scaffolding and
+    the CLI leaf-override syntax."""
+    import os
+    import subprocess
+    import sys
+    from veles_tpu.samples import mnist
+    prng.reset(); prng.seed_all(7)
+    _configure()
+    root.mnist.grad_accum = 4
+    try:
+        wf = mnist.build(fused=True)
+        wf.initialize()
+        assert wf._fused_runner.grad_accum == 4
+    finally:
+        root.mnist.__dict__.pop("grad_accum", None)
+
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = ""
+    proc = subprocess.run(
+        [sys.executable, "-m", "veles_tpu", "veles_tpu.samples.mnist",
+         "-d", "cpu", "--random-seed", "7", "--no-stats",
+         "root.mnist.grad_accum=2",
+         "root.mnist.loader.n_train=128", "root.mnist.loader.n_valid=64",
+         "root.mnist.loader.minibatch_size=64",
+         "root.mnist.decision.max_epochs=1"],
+        capture_output=True, text=True, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        timeout=600)
+    assert proc.returncode == 0, proc.stderr[-2000:]

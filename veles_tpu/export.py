@@ -32,7 +32,12 @@ import numpy
 MANIFEST = "manifest.json"
 MODEL = "model.shlo"
 WEIGHTS = "weights.npz"
+#: artifact format versions this loader understands; quantized bundles
+#: are stamped 2 so pre-quantization deployments reject them with a
+#: clear unsupported-format error instead of a dtype crash at predict
 FORMAT = 1
+FORMAT_QUANTIZED = 2
+KNOWN_FORMATS = (FORMAT, FORMAT_QUANTIZED)
 
 #: platforms every artifact is lowered for (the artifact must serve on a
 #: CPU host and on TPU alike)
@@ -119,7 +124,7 @@ def export_model(workflow, path, metadata=None, quantize=None):
     out_spec = exported.out_avals[0]
 
     manifest = {
-        "format": FORMAT,
+        "format": FORMAT_QUANTIZED if quantize else FORMAT,
         "name": workflow.name,
         "input_sample_shape": list(sample_shape),
         "input_dtype": "float32",
@@ -179,7 +184,7 @@ def load_model(path):
             return member.read()
 
         manifest = json.loads(read(MANIFEST))
-        if manifest.get("format") != FORMAT:
+        if manifest.get("format") not in KNOWN_FORMATS:
             raise ValueError("unsupported artifact format %r"
                              % manifest.get("format"))
         exported = jexport.deserialize(bytearray(read(MODEL)))

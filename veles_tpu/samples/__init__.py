@@ -62,6 +62,11 @@ def make_sample(config_name, workflow_cls, loader_cls, default_config,
         if "snapshotter" in cfg:
             kwargs["snapshotter_config"] = {
                 k: get(v, v) for k, v in cfg.snapshotter.items()}
+        if "grad_accum" in cfg:
+            # config/CLI-reachable microbatching, e.g.
+            # ``root.mnist.grad_accum=4`` (see FusedRunner.grad_accum)
+            kwargs["grad_accum"] = int(get(cfg.grad_accum,
+                                           cfg.grad_accum))
         return kwargs
 
     def build(fused=True, **overrides):

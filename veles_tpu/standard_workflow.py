@@ -97,6 +97,11 @@ class StandardWorkflowBase(NNWorkflow):
         self.fused = fused
         #: microbatches per optimizer step (fused mode; see FusedRunner)
         self.grad_accum = grad_accum
+        if grad_accum != 1 and not fused:
+            # never drop an explicit setting silently
+            self.warning("grad_accum=%s is inert in unit (non-fused) "
+                         "mode — the per-unit path dispatches whole "
+                         "minibatches", grad_accum)
         self.snapshotter = None
         self._build(loader_factory, dict(loader_config or {}),
                     dict(decision_config or {}), snapshotter_config)
