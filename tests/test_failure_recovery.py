@@ -16,7 +16,6 @@ import subprocess
 import sys
 import time
 
-import pytest
 
 WORKER = os.path.join(os.path.dirname(__file__), "resume_worker.py")
 

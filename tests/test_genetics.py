@@ -3,7 +3,7 @@
 import numpy
 
 from veles_tpu.config import Config, Tune, root
-from veles_tpu.genetics import find_tunes, optimize, Population, set_leaf
+from veles_tpu.genetics import find_tunes, optimize, set_leaf
 
 
 class TestTuneDiscovery:

@@ -5,7 +5,6 @@ full training run must converge identically."""
 import numpy
 import pytest
 
-import jax
 import jax.numpy as jnp
 
 from veles_tpu import prng

@@ -2,7 +2,7 @@
 
 import numpy
 
-from veles_tpu.loader.base import Loader, TEST, VALID, TRAIN
+from veles_tpu.loader.base import TEST, VALID, TRAIN
 from veles_tpu.loader.fullbatch import FullBatchLoader
 from veles_tpu.workflow import Workflow
 

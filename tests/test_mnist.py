@@ -6,7 +6,6 @@ budget, assert bounded validation error, plus fused/unit-mode equivalence
 """
 
 import numpy
-import pytest
 
 from veles_tpu import prng
 from veles_tpu.config import root

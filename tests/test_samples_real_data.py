@@ -7,7 +7,6 @@
 """
 
 import gzip
-import os
 import struct
 
 import numpy

@@ -7,7 +7,6 @@ import subprocess
 import sys
 
 import numpy
-import pytest
 
 
 def _mnist_config(max_epochs=3, n_train=192, n_valid=64, mb=64):

@@ -171,7 +171,6 @@ class TestSolverWorkflows:
 
     def test_accumulators_survive_snapshot_roundtrip(self):
         from veles_tpu.samples import mnist
-        from veles_tpu import snapshotter as snap
         prng.reset(); prng.seed_all(42)
         _configure("adadelta", max_epochs=1, lr=1.0)
         wf = mnist.train(fused=True)

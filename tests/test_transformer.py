@@ -208,7 +208,6 @@ class TestRingLMForward:
         if len(devices) < 8:
             pytest.skip("needs 8 virtual devices")
         from veles_tpu.parallel.ring import make_seq_mesh, ring_attention
-        from veles_tpu.ops.attention import mha_forward
         from veles_tpu.ops.functional import matmul
         mesh = make_seq_mesh(8, data_parallel=1, devices=devices[:8])
         prng.reset()
