@@ -337,3 +337,49 @@ def test_lmdb_to_records_roundtrip(tmp_path, monkeypatch):
     numpy.testing.assert_array_equal(
         numpy.asarray(data), samples.transpose(0, 2, 3, 1))
     numpy.testing.assert_array_equal(numpy.asarray(got_labels), labels)
+
+
+class TestRecordsPrefetch:
+    def _make(self, tmp_path, prefetch):
+        from veles_tpu import prng
+        from veles_tpu.loader.records import write_records, RecordsLoader
+        rng = numpy.random.RandomState(2)
+        data = rng.randint(0, 256, (90, 6, 6, 3), numpy.uint8)
+        labels = (numpy.arange(90) % 7).astype(numpy.int32)
+        path = write_records(str(tmp_path / "p.rec"), data, labels,
+                             [0, 20, 70])
+        prng.reset(); prng.seed_all(11)
+        loader = RecordsLoader(None, path=path, minibatch_size=16,
+                               prefetch=prefetch, name="loader")
+        loader.initialize()
+        return loader
+
+    def test_prefetch_stream_identical(self, tmp_path):
+        """Double-buffered delivery must be byte-identical to the
+        synchronous path across epochs (same PRNG -> same plan)."""
+        streams = []
+        for prefetch in (False, True):
+            loader = self._make(tmp_path, prefetch)
+            got = []
+            for _ in range(2):              # two epochs incl. reshuffle
+                while True:
+                    loader.run()
+                    got.append((loader.minibatch_class,
+                                numpy.array(loader.minibatch_data.mem),
+                                numpy.array(loader.minibatch_labels.mem),
+                                int(loader.minibatch_size)))
+                    if loader.last_minibatch:
+                        break
+            loader.stop()
+            streams.append(got)
+        assert len(streams[0]) == len(streams[1])
+        for (ca, da, la, sa), (cb, db, lb, sb) in zip(*streams):
+            assert ca == cb and sa == sb
+            numpy.testing.assert_array_equal(da, db)
+            numpy.testing.assert_array_equal(la, lb)
+
+    def test_stop_idempotent(self, tmp_path):
+        loader = self._make(tmp_path, prefetch=True)
+        loader.run()
+        loader.stop()
+        loader.stop()                        # no double-shutdown crash

@@ -79,10 +79,19 @@ class RecordsLoader(Loader):
     same way).  ``scale`` optionally rescales uint8 pixels to [-1, 1].
     """
 
-    def __init__(self, workflow, path=None, scale_uint8=True, **kwargs):
+    def __init__(self, workflow, path=None, scale_uint8=True,
+                 prefetch=False, **kwargs):
         super().__init__(workflow, **kwargs)
         self.path = path
         self.scale_uint8 = scale_uint8
+        #: double-buffering: a staging thread gathers minibatch k+1 from
+        #: the mapped file while the device trains on k (the C++ gather
+        #: releases the GIL, so the overlap is real).  The epoch plan
+        #: makes the next indices known ahead of time; the last batch of
+        #: an epoch stages nothing (the next plan is reshuffled later).
+        self.prefetch = prefetch
+        self._pending = None          # (indices bytes, Future)
+        self._pool = None
         self._data = None
         self._labels = None
         self.has_labels = True
@@ -102,7 +111,7 @@ class RecordsLoader(Loader):
         if self.has_labels:
             self.minibatch_labels.reset(numpy.zeros(mb, numpy.int32))
 
-    def fill_minibatch(self, indices, actual_size):
+    def _gather(self, indices):
         # fused gather+convert straight out of the mapped pages — the native
         # (C++, threaded) hot path when libdataio is built, numpy otherwise
         from veles_tpu import native
@@ -111,7 +120,46 @@ class RecordsLoader(Loader):
                                           scale=1.0 / 127.5, offset=-1.0)
         else:
             batch = native.gather_convert(self._data, indices)
+        labels = (native.gather_labels(numpy.asarray(self._labels),
+                                       indices)
+                  if self.has_labels else None)
+        return batch, labels
+
+    def fill_minibatch(self, indices, actual_size):
+        batch = labels = None
+        if self.prefetch:
+            if self._pool is None:
+                import concurrent.futures
+                self._pool = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix=self.name)
+            if self._pending is not None:
+                key, fut = self._pending
+                self._pending = None
+                if key == indices.tobytes():
+                    batch, labels = fut.result()
+                else:
+                    # plan changed under us — discard; a stale gather's
+                    # failure must not sink the fresh synchronous one
+                    fut.cancel()
+                    if not fut.cancelled():
+                        fut.exception()
+        if batch is None:
+            batch, labels = self._gather(indices)
         self.minibatch_data.reset(batch)
         if self.has_labels:
-            self.minibatch_labels.reset(
-                native.gather_labels(numpy.asarray(self._labels), indices))
+            self.minibatch_labels.reset(labels)
+        if self.prefetch and self._position < len(self._order):
+            # stage the NEXT minibatch while the device computes this one
+            # (run() already advanced _position past the current entry)
+            nxt = self._order[self._position][1]
+            self._pending = (nxt.tobytes(),
+                             self._pool.submit(self._gather, nxt))
+
+    def stop(self):
+        if self._pool is not None:
+            if self._pending is not None:
+                self._pending[1].cancel()
+                self._pending = None
+            self._pool.shutdown(wait=True)
+            self._pool = None
+        super().stop()
