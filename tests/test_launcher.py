@@ -9,6 +9,8 @@ time in print_stats.
 import glob
 import os
 
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
 
 def _build_tiny_mnist():
     from veles_tpu import prng
@@ -48,3 +50,63 @@ class TestLauncherProfile:
         step_time = wf._fused_runner.measure_device_step_time(iters=3)
         assert step_time is not None and 0.0 < step_time < 60.0
         wf.print_stats()  # must not raise with the device-time line
+
+
+def test_cli_serve_after_training(tmp_path):
+    """--serve PORT: train, then serve the trained workflow over HTTP
+    until interrupted (the reference's snapshot-to-serving ergonomics
+    in one command)."""
+    import json
+    import signal
+    import subprocess
+    import sys
+    import time
+    import urllib.request
+
+    import numpy
+
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = ""
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "veles_tpu", "veles_tpu.samples.mnist",
+         "-d", "cpu", "--random-seed", "7", "--no-stats", "--serve", "0",
+         "root.mnist.loader.n_train=128", "root.mnist.loader.n_valid=64",
+         "root.mnist.loader.minibatch_size=64",
+         "root.mnist.decision.max_epochs=1"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        env=env, cwd=REPO)
+    try:
+        import queue
+        import threading
+        lines = queue.Queue()
+        reader = threading.Thread(
+            target=lambda: [lines.put(ln) for ln in proc.stdout],
+            daemon=True)
+        reader.start()
+        port, deadline = None, time.time() + 300
+        while time.time() < deadline:
+            try:
+                line = lines.get(timeout=5)
+            except queue.Empty:
+                assert proc.poll() is None, "CLI exited before serving"
+                continue
+            if line.startswith("SERVING "):
+                port = int(line.split(":")[2].split("/")[0])
+                break
+        assert port, "server never announced itself within the deadline"
+        x = numpy.zeros((2, 784), numpy.float32).tolist()
+        req = urllib.request.Request(
+            "http://127.0.0.1:%d/predict" % port,
+            data=json.dumps({"input": x}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            out = json.loads(resp.read())
+        assert len(out["output"]) == 2 and len(out["output"][0]) == 10
+    finally:
+        proc.send_signal(signal.SIGINT)
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+    assert proc.returncode in (0, -signal.SIGINT)

@@ -79,6 +79,13 @@ def build_argparser():
                              "'<generations>:<population>'")
     parser.add_argument("--list-units", action="store_true",
                         help="list registered unit classes and exit")
+    parser.add_argument("--serve", type=int, default=None, metavar="PORT",
+                        help="after the run completes, serve the trained "
+                             "workflow over HTTP (REST /predict; 0 = "
+                             "ephemeral port) until interrupted — the "
+                             "reference's snapshot-to-serving flow in one "
+                             "command (train or --snapshot restore, then "
+                             "serve)")
     return parser
 
 
@@ -190,6 +197,26 @@ def main(argv=None):
     if launcher is not None and args.result_file:
         with open(args.result_file, "w", encoding="utf-8") as f:
             json.dump(launcher.result_summary(), f, indent=2, default=str)
+    if launcher is not None and args.serve is not None:
+        import threading
+        import jax
+        from veles_tpu.restful_api import RESTfulAPI
+        if jax.process_index() != 0:
+            # multi-host runs: exactly one serving endpoint (the same
+            # single-writer rule the snapshotter follows)
+            return 0
+        api = RESTfulAPI(
+            launcher.workflow,
+            normalizer=getattr(launcher.workflow.loader, "normalizer",
+                               None)).start(port=args.serve)
+        # parseable by wrappers/tests; flushed before blocking
+        print("SERVING http://127.0.0.1:%d/predict" % api.port, flush=True)
+        try:
+            threading.Event().wait()        # until SIGINT/SIGTERM
+        except KeyboardInterrupt:
+            pass
+        finally:
+            api.stop()
     return 0
 
 
