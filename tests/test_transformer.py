@@ -374,3 +374,41 @@ def test_char_lm_generates_the_grammar():
     out = char_lm.sample_tokens(wf, [prompt], n_new=12, temperature=0.0)
     expect = [(1 + 3 * i) % 16 for i in range(20)]
     assert out[0].tolist() == expect, (out[0].tolist(), expect)
+
+
+class TestTopK:
+    def _params(self):
+        prng.reset(); prng.seed_all(13)
+        host = T.init_transformer_params(prng.get("init"), vocab=16,
+                                         d_model=32, n_heads=2,
+                                         n_layers=1, max_len=16)
+        return jax.tree.map(jnp.asarray, host)
+
+    def test_top_k_1_equals_greedy(self):
+        params = self._params()
+        prompt = jnp.asarray([[3, 1, 4]], jnp.int32)
+        greedy = T.generate(params, prompt, 6, 2, temperature=0)
+        k1 = T.generate(params, prompt, 6, 2, rng=jax.random.PRNGKey(0),
+                        temperature=0.7, top_k=1)
+        numpy.testing.assert_array_equal(numpy.asarray(greedy),
+                                         numpy.asarray(k1))
+
+    def test_top_k_vocab_equals_unrestricted(self):
+        params = self._params()
+        prompt = jnp.asarray([[3, 1, 4]], jnp.int32)
+        key = jax.random.PRNGKey(2)
+        full = T.generate(params, prompt, 6, 2, rng=key, temperature=0.9)
+        k16 = T.generate(params, prompt, 6, 2, rng=key, temperature=0.9,
+                         top_k=16)
+        numpy.testing.assert_array_equal(numpy.asarray(full),
+                                         numpy.asarray(k16))
+
+    def test_top_k_out_of_range(self):
+        params = self._params()
+        prompt = jnp.asarray([[3]], jnp.int32)
+        with pytest.raises(ValueError):
+            T.generate(params, prompt, 2, 2, rng=jax.random.PRNGKey(0),
+                       top_k=0)
+        with pytest.raises(ValueError):
+            T.generate(params, prompt, 2, 2, rng=jax.random.PRNGKey(0),
+                       top_k=99)

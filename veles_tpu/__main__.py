@@ -205,10 +205,19 @@ def main(argv=None):
             # multi-host runs: exactly one serving endpoint (the same
             # single-writer rule the snapshotter follows)
             return 0
-        api = RESTfulAPI(
-            launcher.workflow,
-            normalizer=getattr(launcher.workflow.loader, "normalizer",
-                               None)).start(port=args.serve)
+        wf = launcher.workflow
+        if getattr(wf, "trainer", None) is not None and \
+                hasattr(wf.trainer, "n_heads"):
+            # transformer-trainer workflows serve token continuation
+            from veles_tpu.restful_api import serve_lm
+            api = serve_lm(wf, port=args.serve)
+        elif not getattr(wf, "forwards", None):
+            parser.error("--serve: workflow %r has no forward chain or "
+                         "LM trainer to serve" % wf.name)
+        else:
+            api = RESTfulAPI(
+                wf, normalizer=getattr(wf.loader, "normalizer",
+                                       None)).start(port=args.serve)
         # parseable by wrappers/tests; flushed before blocking
         print("SERVING http://127.0.0.1:%d/predict" % api.port, flush=True)
         try:

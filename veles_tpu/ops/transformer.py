@@ -230,7 +230,7 @@ def block_decode_step(blk, h, k_cache, v_cache, pos, n_heads):
 
 
 def _generate_impl(params, prompt, rng, temperature, n_new, n_heads,
-                   greedy, max_len):
+                   greedy, max_len, top_k):
     import jax
     import jax.numpy as jnp
     s = prompt.shape[1]
@@ -240,6 +240,12 @@ def _generate_impl(params, prompt, rng, temperature, n_new, n_heads,
     def sample(logits, key):
         if greedy:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        if top_k is not None:
+            # keep only the k most likely tokens (nucleus-style quality
+            # control); ties at the cutoff stay eligible
+            vals = jax.lax.top_k(logits, top_k)[0]
+            logits = jnp.where(logits >= vals[..., -1:], logits,
+                               NEG_INF_LOGIT)
         # temperature is TRACED: every sampling temperature shares one
         # compilation (serve_lm exposes it to clients — a static arg
         # would let them force a recompile per distinct value)
@@ -283,8 +289,11 @@ def _generate_impl(params, prompt, rng, temperature, n_new, n_heads,
 _GENERATE_JIT = None
 
 
+NEG_INF_LOGIT = -1e30
+
+
 def generate(params, prompt, n_new, n_heads, rng=None, temperature=1.0,
-             max_len=None):
+             max_len=None, top_k=None):
     """Autoregressive sampling with a KV cache, fully under jit.
 
     prompt: (batch, s) int32; returns (batch, s + n_new) int32.
@@ -297,6 +306,7 @@ def generate(params, prompt, n_new, n_heads, rng=None, temperature=1.0,
     temperature value is traced — all temperatures share one compile).
     ``max_len`` pins the cache size (default prompt + n_new) so callers
     timing different ``n_new`` can hold the cache shape constant.
+    ``top_k`` restricts sampling to the k most likely tokens.
     """
     import jax
     import jax.numpy as jnp
@@ -314,18 +324,25 @@ def generate(params, prompt, n_new, n_heads, rng=None, temperature=1.0,
     greedy = not temperature
     if not greedy and rng is None:
         raise ValueError("sampling (temperature > 0) needs rng")
+    if top_k is not None and not 1 <= top_k <= params["embed"].shape[0]:
+        raise ValueError("top_k %r out of range (vocab %d)"
+                         % (top_k, params["embed"].shape[0]))
     if _GENERATE_JIT is None:
         _GENERATE_JIT = jax.jit(
             _generate_impl,
-            static_argnames=("n_new", "n_heads", "greedy", "max_len"))
+            static_argnames=("n_new", "n_heads", "greedy", "max_len",
+                             "top_k"))
     return _GENERATE_JIT(params, prompt, None if greedy else rng,
                          jnp.asarray(temperature or 1.0, jnp.float32),
                          n_new=n_new, n_heads=n_heads, greedy=greedy,
-                         max_len=max_len)
+                         max_len=max_len,
+                         # greedy never reads top_k — null it so distinct
+                         # values cannot fork identical compiles
+                         top_k=None if greedy else top_k)
 
 
 def trainer_sample_tokens(trainer, prompt, n_new=32, temperature=0.0,
-                          seed=0, params=None, max_len=None):
+                          seed=0, params=None, max_len=None, top_k=None):
     """Continue token sequences with a trained TransformerTrainer —
     the ONE decode entry point shared by the sample helpers
     (char_lm.sample_tokens) and HTTP serving (restful_api.serve_lm):
@@ -342,7 +359,7 @@ def trainer_sample_tokens(trainer, prompt, n_new=32, temperature=0.0,
                                   jnp.asarray(prompt, jnp.int32),
                                   n_new, trainer.n_heads, rng=rng,
                                   temperature=temperature,
-                                  max_len=max_len))
+                                  max_len=max_len, top_k=top_k))
 
 
 def make_adam_train_step(loss_fn, learning_rate, beta1=0.9, beta2=0.999,

@@ -127,10 +127,12 @@ class RESTfulAPI(Logger):
 def serve_lm(workflow, host="127.0.0.1", port=8180, max_new=256):
     """Serve a trained transformer-trainer workflow (e.g. char_lm) for
     autoregressive continuation: POST ``{"input": [[tok, ...]],
-    "n_new": N, "temperature": T, "seed": S}`` to ``/predict`` returns
-    ``{"tokens": [[...]]}`` — prompt plus continuation per row.
-    Decoding is the KV-cached ``transformer.generate`` path, one jitted
-    dispatch per request; ``n_new`` is clamped to ``max_new``.
+    "n_new": N, "temperature": T, "top_k": K, "seed": S}`` to
+    ``/predict`` returns ``{"tokens": [[...]]}`` — prompt plus
+    continuation per row.  Decoding is the KV-cached
+    ``transformer.generate`` path, one jitted dispatch per request;
+    ``n_new`` is clamped to ``max_new``.  top_k is jit-static but
+    vocab-bounded, so client-driven compiles stay finite.
     """
     from veles_tpu.ops.transformer import trainer_sample_tokens
     trainer = workflow.trainer
@@ -151,11 +153,13 @@ def serve_lm(workflow, host="127.0.0.1", port=8180, max_new=256):
         if run < 1:
             raise ValueError("prompt length %d leaves no room to decode "
                              "(max_len %d)" % (len(prompt[0]), cache_len))
+        top_k = request.get("top_k")
         out = trainer_sample_tokens(
             trainer, prompt, n_new=run,
             temperature=float(request.get("temperature", 0.0)),
             seed=int(request.get("seed", 0)), params=params,
-            max_len=cache_len)
+            max_len=cache_len,
+            top_k=int(top_k) if top_k is not None else None)
         out = out[:, :len(prompt[0]) + min(n_new, run)]
         return {"tokens": out.tolist()}
 
