@@ -79,6 +79,9 @@ def build_argparser():
                              "'<generations>:<population>'")
     parser.add_argument("--list-units", action="store_true",
                         help="list registered unit classes and exit")
+    import veles_tpu
+    parser.add_argument("--version", action="version",
+                        version="veles_tpu %s" % veles_tpu.__version__)
     parser.add_argument("--serve", type=int, default=None, metavar="PORT",
                         help="after the run completes, serve the trained "
                              "workflow over HTTP (REST /predict; 0 = "

@@ -28,9 +28,11 @@ def main():
     from veles_tpu.samples import run_sample
     wf = run_sample(module, seed=spec["seed"],
                     build_kwargs=spec.get("build_kwargs"))
+    import veles_tpu
     from veles_tpu import snapshotter
     payload = {
         "format": snapshotter.FORMAT,
+        "framework_version": veles_tpu.__version__,
         "workflow_name": wf.name,
         "epoch": int(wf.loader.epoch_number),
         "best_metric": wf.decision.best_metric,

@@ -123,8 +123,10 @@ def export_model(workflow, path, metadata=None, quantize=None):
                               platforms=list(PLATFORMS))(*arg_specs)
     out_spec = exported.out_avals[0]
 
+    import veles_tpu
     manifest = {
         "format": FORMAT_QUANTIZED if quantize else FORMAT,
+        "framework_version": veles_tpu.__version__,
         "name": workflow.name,
         "input_sample_shape": list(sample_shape),
         "input_dtype": "float32",

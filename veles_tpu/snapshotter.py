@@ -129,8 +129,10 @@ class SnapshotterBase(Unit):
     def payload(self):
         wf = self.workflow
         from veles_tpu.config import root
+        import veles_tpu
         return {
             "format": FORMAT,
+            "framework_version": veles_tpu.__version__,
             "workflow_class": "%s.%s" % (type(wf).__module__,
                                          type(wf).__name__),
             "workflow_name": wf.name,
