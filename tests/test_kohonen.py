@@ -71,3 +71,28 @@ class TestKohonenSample:
         # forward ran at completion and distributed wins over many neurons
         assert wf.forward.hits.sum() > 0
         assert (wf.forward.hits > 0).sum() >= 4
+
+
+def test_eval_only_freezes_codebook():
+    """wf.eval_only (Launcher --evaluate) must stop the SOM trainer from
+    updating weights even on TRAIN minibatches — the shared
+    Unit.is_train_minibatch gate covers gradient-free trainers too."""
+    from veles_tpu import prng
+    from veles_tpu.config import root
+    prng.reset(); prng.seed_all(3)
+    root.__dict__.pop("kohonen", None)
+    from veles_tpu.samples import kohonen as sample
+    sample.default_config()
+    root.kohonen.update({
+        "loader": {"minibatch_size": 50, "n_train": 100},
+        "decision": {"max_epochs": 1, "fail_iterations": 5},
+    })
+    wf = sample.build()
+    wf.initialize()
+    wf.eval_only = True
+    w_before = numpy.array(wf.trainer.weights.mem)
+    wf.loader.run()                     # a TRAIN minibatch (train-only set)
+    assert wf.loader.minibatch_class == 2
+    wf.trainer.run()
+    numpy.testing.assert_array_equal(w_before,
+                                     numpy.array(wf.trainer.weights.mem))

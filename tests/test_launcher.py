@@ -110,3 +110,56 @@ def test_cli_serve_after_training(tmp_path):
             proc.kill()
             proc.wait()
     assert proc.returncode in (0, -signal.SIGINT)
+
+
+def test_cli_evaluate_only(tmp_path):
+    """--evaluate --snapshot: one scoring pass, weights untouched
+    (SURVEY §3.3 resume/EVALUATE from snapshot)."""
+    import json
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = ""
+    common = [sys.executable, "-m", "veles_tpu", "veles_tpu.samples.mnist",
+              "-d", "cpu", "--random-seed", "7", "--no-stats"]
+    overrides = ["root.mnist.loader.n_train=128",
+                 "root.mnist.loader.n_valid=64",
+                 "root.mnist.loader.minibatch_size=64",
+                 "root.mnist.decision.max_epochs=1"]
+    # train 1 epoch, snapshot
+    proc = subprocess.run(
+        common + ["--snapshot-dir", str(tmp_path),
+                  "--result-file", str(tmp_path / "train.json")] + overrides,
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=600)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    train = json.loads((tmp_path / "train.json").read_text())
+    snap = train["snapshot"]
+
+    # evaluate-only from the snapshot: same val metrics, no training
+    proc = subprocess.run(
+        common + ["--snapshot", snap, "--evaluate",
+                  "--result-file", str(tmp_path / "eval.json")] + overrides,
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=600)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    ev = json.loads((tmp_path / "eval.json").read_text())
+    # the epoch plan scores validation BEFORE the epoch's training
+    # updates, so scoring the FINAL snapshot must do at least as well
+    # as the training run's last validation pass
+    assert (ev["last_epoch_metrics"]["validation"]["n_err"]
+            <= train["last_epoch_metrics"]["validation"]["n_err"])
+
+    # evaluation is pure: a second scoring pass reproduces it exactly
+    proc = subprocess.run(
+        common + ["--snapshot", snap, "--evaluate",
+                  "--result-file", str(tmp_path / "eval2.json")] + overrides,
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=600)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    ev2 = json.loads((tmp_path / "eval2.json").read_text())
+    assert (ev2["last_epoch_metrics"]["validation"]
+            == ev["last_epoch_metrics"]["validation"])
+    # scoring never rewrites the training run's best-* bookkeeping
+    assert ev["best_metric"] == train["best_metric"]
+    assert ev["best_epoch"] == train["best_epoch"]
+    # and never writes snapshots (no lineage pollution)
+    assert "snapshot" not in ev or ev["snapshot"] == train["snapshot"]

@@ -79,9 +79,21 @@ def build_argparser():
                              "'<generations>:<population>'")
     parser.add_argument("--list-units", action="store_true",
                         help="list registered unit classes and exit")
-    import veles_tpu
-    parser.add_argument("--version", action="version",
-                        version="veles_tpu %s" % veles_tpu.__version__)
+    class _Version(argparse.Action):
+        """Lazy: importing veles_tpu pulls in jax, and the platform env
+        handling in main() must run before the first jax import."""
+        def __call__(self, parser, *unused_a, **unused_k):
+            import veles_tpu
+            print("veles_tpu %s" % veles_tpu.__version__)
+            parser.exit()
+
+    parser.add_argument("--version", action=_Version, nargs=0,
+                        help="print the framework version and exit")
+    parser.add_argument("--evaluate", action="store_true",
+                        help="evaluation-only: one pass over every "
+                             "dataset split with weight updates gated "
+                             "off (pair with --snapshot to score a "
+                             "trained model)")
     parser.add_argument("--serve", type=int, default=None, metavar="PORT",
                         help="after the run completes, serve the trained "
                              "workflow over HTTP (REST /predict; 0 = "
@@ -159,6 +171,9 @@ def main(argv=None):
         raise SystemExit("workflow module %r has no run(load, main)"
                          % args.workflow)
 
+    if args.optimize and (args.evaluate or args.serve is not None):
+        parser.error("--optimize cannot be combined with --evaluate or "
+                     "--serve (the GA drives its own training runs)")
     if args.optimize:
         try:
             from veles_tpu.genetics import optimize_cli
@@ -190,7 +205,8 @@ def main(argv=None):
             wf, snapshot=args.snapshot, distributed=args.distributed,
             coordinator_address=args.coordinator_address,
             num_processes=args.num_processes, process_id=args.process_id,
-            stats=not args.no_stats, profile=args.profile)
+            stats=not args.no_stats, profile=args.profile,
+            evaluate=args.evaluate)
         holder["launcher"] = launcher
         launcher.boot()
 

@@ -348,7 +348,8 @@ class FusedStep(Unit):
             y_ref = runner.evaluator.target.devmem
         else:
             y_ref = labels
-        if loader.minibatch_class == TRAIN:
+        if (loader.minibatch_class == TRAIN
+                and not getattr(runner.wf, "eval_only", False)):
             if runner._has_stochastic:
                 from veles_tpu import prng
                 rng = prng.get("dropout").key()

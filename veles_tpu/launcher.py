@@ -35,9 +35,14 @@ class Launcher(Logger):
 
     def __init__(self, workflow, snapshot=None, distributed=False,
                  coordinator_address=None, num_processes=None,
-                 process_id=None, stats=True, profile=None):
+                 process_id=None, stats=True, profile=None,
+                 evaluate=False):
         self.workflow = workflow
         self.snapshot = snapshot
+        #: evaluation-only run (SURVEY §3.3 "resume/EVALUATE from
+        #: snapshot"): one pass over every dataset split with ALL weight
+        #: updates gated off — metrics come out, parameters don't move
+        self.evaluate = evaluate
         self.distributed = distributed
         self.coordinator_address = coordinator_address
         self.num_processes = num_processes
@@ -83,6 +88,32 @@ class Launcher(Logger):
             self.restored_payload = snapshotter.restore(wf, snapshot)
             self.info("resumed from %s (epoch %s)", snapshot,
                       self.restored_payload.get("epoch"))
+        if self.evaluate:
+            from veles_tpu.mutable import Bool
+            always = Bool(True)
+            #: units and the fused step consult this flag: every
+            #: minibatch takes the EVAL path (no dropout, no backward,
+            #: no PRNG draws) regardless of its dataset split
+            wf.eval_only = True
+            for gd in getattr(wf, "gds", []):
+                gd.gate_skip = always
+            commit = getattr(wf, "fused_commit", None)
+            if commit is not None:
+                commit.gate_skip = always       # belt-and-braces
+            snap = getattr(wf, "snapshotter", None)
+            if snap is not None:
+                snap.skip.set(True)   # scoring must not touch lineage
+            dec = getattr(wf, "decision", None)
+            if dec is None:
+                raise ValueError("--evaluate needs a Decision-driven "
+                                 "workflow")
+            # exactly one more pass over the epoch plan, however many
+            # epochs the (restored) run already saw; best_* bookkeeping
+            # stays whatever training left it at
+            dec.max_epochs = int(wf.loader.epoch_number) + 1
+            dec.fail_iterations = None
+            dec.freeze_best = True
+            dec.complete.set(False)
         begin = time.perf_counter()
         if self.profile:
             import jax.profiler

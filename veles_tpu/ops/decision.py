@@ -29,6 +29,10 @@ class DecisionBase(Unit):
         self.max_epochs = max_epochs
         #: stop after this many epochs without validation improvement
         self.fail_iterations = fail_iterations
+        #: evaluation-only runs: report metrics but never update
+        #: best_metric/best_epoch/improved (a scoring pass must not
+        #: rewrite the training run's bookkeeping)
+        self.freeze_best = False
         self.complete = Bool(False)
         self.improved = Bool(False)
         #: True while the current minibatch must not update weights
@@ -115,7 +119,7 @@ class DecisionBase(Unit):
         metric = (self.epoch_metric(key_metrics)
                   if key_metrics.get("count", 0) > 0 else None)
         self.improved.set(
-            metric is not None and
+            not self.freeze_best and metric is not None and
             (self.best_metric is None or metric < self.best_metric))
         if bool(self.improved):
             self.best_metric = metric

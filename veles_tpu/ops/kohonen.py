@@ -88,11 +88,9 @@ class KohonenTrainer(AcceleratedUnit):
         super().initialize(device=device, **kwargs)
 
     def _is_train_minibatch(self):
-        """Update only on TRAIN minibatches: evaluation sets must not leak
-        into the codebook (link minibatch_class from the loader; absent ⇒
-        train-only loader)."""
-        from veles_tpu.loader.base import TRAIN
-        return getattr(self, "minibatch_class", TRAIN) == TRAIN
+        """Update only on TRAIN minibatches (and never in eval-only
+        runs): evaluation sets must not leak into the codebook."""
+        return self.is_train_minibatch()
 
     def schedules(self):
         t = self.time / max(self.decay_steps, 1)

@@ -210,7 +210,10 @@ class TransformUnit(AcceleratedUnit):
         return self.transform(x)
 
     def _in_training_minibatch(self):
-        """Unit-mode train/eval detection via the loader's current class."""
+        """Unit-mode train/eval detection (shared gate: loader class +
+        workflow eval_only)."""
+        if getattr(self.workflow, "eval_only", False):
+            return False
         from veles_tpu.loader.base import TRAIN
         loader = getattr(self.workflow, "loader", None)
         return loader is None or loader.minibatch_class == TRAIN

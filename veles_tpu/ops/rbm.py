@@ -82,10 +82,9 @@ class RBMTrainer(AcceleratedUnit):
         super().initialize(device=device, **kwargs)
 
     def _is_train_minibatch(self):
-        """CD updates only on TRAIN minibatches — held-out sets are scored
-        by reconstruction without touching the parameters."""
-        from veles_tpu.loader.base import TRAIN
-        return getattr(self, "minibatch_class", TRAIN) == TRAIN
+        """CD updates only on TRAIN minibatches (never in eval-only
+        runs) — held-out sets are scored without touching parameters."""
+        return self.is_train_minibatch()
 
     def run(self):
         if not self._is_train_minibatch():

@@ -539,8 +539,7 @@ class TransformerTrainer(AcceleratedUnit):
         super().initialize(device=device, **kwargs)
 
     def _is_train_minibatch(self):
-        from veles_tpu.loader.base import TRAIN
-        return getattr(self, "minibatch_class", TRAIN) == TRAIN
+        return self.is_train_minibatch()
 
     def run(self):
         import jax.numpy as jnp

@@ -112,7 +112,8 @@ class SnapshotterBase(Unit):
     def stop(self):
         # final snapshot on workflow completion, like the reference's
         # end-of-run write (skipped if this epoch was already written)
-        if (self.is_initialized and self._is_writer_process()
+        if (self.is_initialized and not bool(self.skip)
+                and self._is_writer_process()
                 and bool(getattr(self, "complete", False))
                 and self._last_epoch_written != int(self.epoch_number)):
             self._last_epoch_written = int(self.epoch_number)
