@@ -362,3 +362,104 @@ class TestShell:
         finally:
             sys.stdin.isatty = real_isatty
         assert seen["wf"] is wf
+
+
+class TestForgeCLI:
+    def test_pack_publish_list_fetch_roundtrip(self, tmp_path):
+        """The forge command line (reference: forge_client CLI) drives
+        the full local-store flow."""
+        import subprocess
+        import sys
+        wf = _train_tiny_mnist(tmp_path)
+        from veles_tpu.snapshotter import Snapshotter
+        snap = Snapshotter(wf, directory=str(tmp_path / "s"),
+                           name="snapcli").export()
+
+        def cli(*args):
+            env = dict(os.environ)
+            env["JAX_PLATFORMS"] = "cpu"
+            proc = subprocess.run(
+                [sys.executable, "-m", "veles_tpu.forge_cli"] + list(args),
+                capture_output=True, text=True, env=env,
+                cwd=os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__))), timeout=300)
+            assert proc.returncode == 0, proc.stderr[-2000:]
+            return proc.stdout
+
+        pkg = str(tmp_path / "m.forge.tar.gz")
+        cli("pack", snap, pkg, "--name", "cli-model",
+            "--metric", "n_err=3", "--description", "from the CLI")
+        cli("publish", pkg, str(tmp_path / "store"))
+        entries = json.loads(cli("list", str(tmp_path / "store")))
+        # list_store yields (filename, manifest) pairs
+        assert any(m["name"] == "cli-model" for _, m in entries)
+        out = json.loads(cli("fetch", str(tmp_path / "store"),
+                             "cli-model", str(tmp_path / "got")))
+        assert out["manifest"]["name"] == "cli-model"
+        assert out["manifest"]["metrics"]["n_err"] == 3
+        assert os.path.exists(out["snapshot"])
+
+    def test_cli_url_store_flow(self, tmp_path):
+        """serve + upload/publish/list/fetch through the CLI's http
+        branches (the _is_url dispatch)."""
+        import signal
+        import subprocess
+        import sys
+        import time
+        wf = _train_tiny_mnist(tmp_path)
+        from veles_tpu.snapshotter import Snapshotter
+        snap = Snapshotter(wf, directory=str(tmp_path / "s2"),
+                           name="snapurl").export()
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+
+        def cli(*args, timeout=300):
+            proc = subprocess.run(
+                [sys.executable, "-m", "veles_tpu.forge_cli"] + list(args),
+                capture_output=True, text=True, env=env, cwd=repo,
+                timeout=timeout)
+            assert proc.returncode == 0, proc.stderr[-2000:]
+            return proc.stdout
+
+        pkg = str(tmp_path / "u.forge.tar.gz")
+        cli("pack", snap, pkg, "--name", "url-model")
+        server = subprocess.Popen(
+            [sys.executable, "-m", "veles_tpu.forge_cli", "serve",
+             str(tmp_path / "rstore"), "--port", "0"],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+            env=env, cwd=repo)
+        try:
+            line = server.stdout.readline()
+            assert line.startswith("FORGE "), line
+            url = line.split()[1].strip()
+            cli("upload", pkg, url)
+            # publish against a URL must route to upload, not mkdir
+            cli("publish", pkg, url)
+            assert not os.path.exists(os.path.join(repo, "http:"))
+            entries = json.loads(cli("list", url))
+            assert any(m["name"] == "url-model" for _, m in entries)
+            out = json.loads(cli("fetch", url, "url-model",
+                                 str(tmp_path / "rgot")))
+            assert out["manifest"]["name"] == "url-model"
+            assert os.path.exists(out["snapshot"])
+        finally:
+            server.send_signal(signal.SIGINT)
+            try:
+                server.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                server.kill()
+                server.wait()
+
+    def test_cli_bad_metric_rejected(self, tmp_path):
+        import subprocess
+        import sys
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        proc = subprocess.run(
+            [sys.executable, "-m", "veles_tpu.forge_cli", "pack",
+             "snap", "out", "--metric", "n_err"],
+            capture_output=True, text=True,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"), cwd=repo,
+            timeout=120)
+        assert proc.returncode == 2
+        assert "KEY=VALUE" in proc.stderr
