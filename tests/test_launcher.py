@@ -12,14 +12,15 @@ import os
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _build_tiny_mnist():
+def _build_tiny_mnist(seed=1, max_epochs=2):
     from veles_tpu import prng
     from veles_tpu.config import root
     prng.reset()
-    prng.seed_all(1)
+    prng.seed_all(seed)
+    root.__dict__.pop("mnist", None)   # fresh subtree per test
     root.mnist.update({
         "loader": {"minibatch_size": 50, "n_train": 200, "n_valid": 100},
-        "decision": {"max_epochs": 2, "fail_iterations": 5},
+        "decision": {"max_epochs": max_epochs, "fail_iterations": 5},
         "layers": [
             {"type": "all2all_tanh", "output_sample_shape": 16,
              "learning_rate": 0.03, "momentum": 0.9},
@@ -163,3 +164,23 @@ def test_cli_evaluate_only(tmp_path):
     assert ev["best_epoch"] == train["best_epoch"]
     # and never writes snapshots (no lineage pollution)
     assert "snapshot" not in ev or ev["snapshot"] == train["snapshot"]
+
+
+def test_launcher_evaluate_leaves_weights_untouched(tmp_path):
+    """In-process check of the --evaluate contract on a fused GD
+    workflow: parameters identical before/after the scoring pass."""
+    import numpy
+    from veles_tpu.launcher import Launcher
+    wf = _build_tiny_mnist(seed=3, max_epochs=1)
+    launcher = Launcher(wf, stats=False, evaluate=True)
+    launcher.boot()
+    wf.snapshot_state()                  # sync fused state to Vectors
+    after = [numpy.array(f.weights.mem) for f in wf.forwards]
+    # a fresh identically-seeded init equals the "trained" weights:
+    # nothing moved during the evaluation pass
+    wf2 = _build_tiny_mnist(seed=3, max_epochs=1)
+    wf2.initialize()
+    for a, f in zip(after, wf2.forwards):
+        numpy.testing.assert_array_equal(a, numpy.array(f.weights.mem))
+    # and the scoring pass produced metrics
+    assert launcher.result_summary()["last_epoch_metrics"]["validation"]

@@ -160,3 +160,34 @@ def test_cli_dump_config_and_list_units(tmp_path):
         timeout=120)
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert "veles_tpu.units.TrivialUnit" in proc.stdout
+
+
+def test_snapshotter_skip_gates_stop_write(tmp_path):
+    """skip=True must suppress BOTH the periodic write and the final
+    stop() write — an evaluation-only run touches no lineage."""
+    from veles_tpu import prng
+    from veles_tpu.config import root
+    prng.reset(); prng.seed_all(1)
+    root.__dict__.pop("mnist", None)   # fresh subtree: the snapshotter
+    root.mnist.update({               # config must not leak to later tests
+        "loader": {"minibatch_size": 50, "n_train": 100, "n_valid": 50},
+        "decision": {"max_epochs": 1, "fail_iterations": 5},
+        "layers": [{"type": "softmax", "output_sample_shape": 10,
+                    "learning_rate": 0.03}],
+        "snapshotter": {"directory": str(tmp_path), "interval": 1},
+    })
+    from veles_tpu.samples import mnist
+    wf = mnist.build(fused=True)
+    try:
+        _run_and_check(wf, tmp_path)
+    finally:
+        root.__dict__.pop("mnist", None)
+
+
+def _run_and_check(wf, tmp_path):
+    wf.initialize()
+    wf.snapshotter.skip.set(True)
+    wf.run()
+    assert bool(wf.decision.complete)
+    assert wf.snapshotter.destination is None
+    assert not list(tmp_path.glob("*.pickle*"))
