@@ -174,7 +174,7 @@ def test_launcher_evaluate_leaves_weights_untouched(tmp_path):
     wf = _build_tiny_mnist(seed=3, max_epochs=1)
     launcher = Launcher(wf, stats=False, evaluate=True)
     launcher.boot()
-    wf.snapshot_state()                  # sync fused state to Vectors
+    wf._fused_runner.sync_to_units()     # device state -> unit Vectors
     after = [numpy.array(f.weights.mem) for f in wf.forwards]
     # a fresh identically-seeded init equals the "trained" weights:
     # nothing moved during the evaluation pass

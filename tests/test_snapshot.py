@@ -9,10 +9,11 @@ import sys
 import numpy
 
 
-def _mnist_config(max_epochs=3, n_train=192, n_valid=64, mb=64):
+def _mnist_config(max_epochs=3, n_train=192, n_valid=64, mb=64,
+                  snapshotter=None):
     from veles_tpu.config import root
     root.__dict__.pop("mnist", None)   # fresh subtree per test
-    root.mnist.update({
+    cfg = {
         "loader": {"minibatch_size": mb, "n_train": n_train,
                    "n_valid": n_valid},
         "decision": {"max_epochs": max_epochs, "fail_iterations": 50},
@@ -22,7 +23,10 @@ def _mnist_config(max_epochs=3, n_train=192, n_valid=64, mb=64):
             {"type": "softmax", "output_sample_shape": 10,
              "learning_rate": 0.05, "momentum": 0.9},
         ],
-    })
+    }
+    if snapshotter is not None:
+        cfg["snapshotter"] = snapshotter
+    root.mnist.update(cfg)
 
 
 def _weights(wf):
@@ -168,26 +172,18 @@ def test_snapshotter_skip_gates_stop_write(tmp_path):
     from veles_tpu import prng
     from veles_tpu.config import root
     prng.reset(); prng.seed_all(1)
-    root.__dict__.pop("mnist", None)   # fresh subtree: the snapshotter
-    root.mnist.update({               # config must not leak to later tests
-        "loader": {"minibatch_size": 50, "n_train": 100, "n_valid": 50},
-        "decision": {"max_epochs": 1, "fail_iterations": 5},
-        "layers": [{"type": "softmax", "output_sample_shape": 10,
-                    "learning_rate": 0.03}],
-        "snapshotter": {"directory": str(tmp_path), "interval": 1},
-    })
+    _mnist_config(max_epochs=1, n_train=100, n_valid=50, mb=50,
+                  snapshotter={"directory": str(tmp_path), "interval": 1})
     from veles_tpu.samples import mnist
     wf = mnist.build(fused=True)
     try:
-        _run_and_check(wf, tmp_path)
+        wf.initialize()
+        wf.snapshotter.skip.set(True)
+        wf.run()
+        assert bool(wf.decision.complete)
+        assert wf.snapshotter.destination is None
+        assert not list(tmp_path.glob("*.pickle*"))
     finally:
+        # the snapshotter config must not leak into later tests that
+        # share the process-global root
         root.__dict__.pop("mnist", None)
-
-
-def _run_and_check(wf, tmp_path):
-    wf.initialize()
-    wf.snapshotter.skip.set(True)
-    wf.run()
-    assert bool(wf.decision.complete)
-    assert wf.snapshotter.destination is None
-    assert not list(tmp_path.glob("*.pickle*"))

