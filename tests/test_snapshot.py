@@ -187,3 +187,31 @@ def test_snapshotter_skip_gates_stop_write(tmp_path):
         # the snapshotter config must not leak into later tests that
         # share the process-global root
         root.__dict__.pop("mnist", None)
+
+
+def test_snapshotter_keep_last_prunes(tmp_path):
+    """keep_last retains only the newest N epoch files; the *_current
+    pointer survives so --snapshot auto still resumes."""
+    from veles_tpu import prng
+    from veles_tpu.config import root
+    prng.reset(); prng.seed_all(1)
+    _mnist_config(max_epochs=5, n_train=100, n_valid=50, mb=50,
+                  snapshotter={"directory": str(tmp_path), "interval": 1,
+                               "keep_last": 2})
+    from veles_tpu.samples import mnist
+    try:
+        wf = mnist.train(fused=True)
+        suffix = wf.snapshotter._suffix()
+        prefix = wf.snapshotter.prefix
+        epoch_files = [p for p in tmp_path.iterdir()
+                       if p.name.endswith(suffix)
+                       and not p.name.startswith(prefix + "_current")]
+        assert len(epoch_files) == 2, sorted(p.name
+                                             for p in tmp_path.iterdir())
+        current = [p for p in tmp_path.iterdir()
+                   if p.name.startswith(prefix + "_current")]
+        assert current, "the resume pointer must never be pruned"
+        from veles_tpu import snapshotter
+        assert snapshotter.find_current(str(tmp_path)) is not None
+    finally:
+        root.__dict__.pop("mnist", None)

@@ -62,6 +62,10 @@ def build_argparser():
     parser.add_argument("--snapshot-interval", type=int, default=1)
     parser.add_argument("--snapshot-compression", default="gz",
                         choices=("", "gz", "bz2", "xz"))
+    parser.add_argument("--snapshot-keep-last", type=int, default=0,
+                        help="retain only the newest N epoch snapshots "
+                             "(0 keeps all; the *_current resume pointer "
+                             "always survives)")
     parser.add_argument("--result-file", default=None,
                         help="write a JSON run summary here")
     parser.add_argument("--dump-config", action="store_true",
@@ -187,11 +191,18 @@ def main(argv=None):
         if args.snapshot_dir:
             # CLI flags outrank any snapshotter section in the config file,
             # same precedence as root.a.b=value overrides
-            kwargs["snapshotter_config"] = {
+            # MERGE over any config-file snapshotter settings (e.g.
+            # root.<name>.snapshotter.keep_last) instead of replacing —
+            # flags win only for the keys they actually set
+            cfg_snap = dict(kwargs.get("snapshotter_config") or {})
+            cfg_snap.update({
                 "directory": args.snapshot_dir,
                 "interval": args.snapshot_interval,
                 "compression": args.snapshot_compression,
-            }
+            })
+            if args.snapshot_keep_last:
+                cfg_snap["keep_last"] = args.snapshot_keep_last
+            kwargs["snapshotter_config"] = cfg_snap
         kwargs.setdefault("fused", not args.no_fused)
         wf = workflow_cls(None, **kwargs)
         holder["workflow"] = wf

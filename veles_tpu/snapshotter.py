@@ -157,9 +157,13 @@ class SnapshotterToFile(SnapshotterBase):
     that always points at the latest write.
     """
 
-    def __init__(self, workflow, directory=".", **kwargs):
+    def __init__(self, workflow, directory=".", keep_last=0, **kwargs):
         super().__init__(workflow, **kwargs)
         self.directory = directory
+        #: > 0 — retain only the newest N epoch files (the ``*_current``
+        #: copy is never pruned, so ``--snapshot auto`` always resumes);
+        #: 0 keeps everything, the reference's behavior
+        self.keep_last = int(keep_last)
 
     def _suffix(self):
         return ".pickle" + ("." + self.compression if self.compression
@@ -185,7 +189,34 @@ class SnapshotterToFile(SnapshotterBase):
         os.replace(current + ".tmp", current)
         self.destination = path
         self.info("snapshot → %s", path)
+        self._prune()
         return path
+
+    def _prune(self):
+        """Drop the lowest-epoch files beyond ``keep_last``.  Only files
+        matching THIS snapshotter's ``<prefix>_<epoch>_...`` pattern are
+        candidates (a sibling run's ``wf_big_*`` files, and every
+        ``*_current`` pointer, are untouchable), and ordering uses the
+        epoch number from the filename — mtime ties on coarse
+        filesystems must not rank the newest file oldest."""
+        if self.keep_last <= 0:
+            return
+        import re
+        pattern = re.compile(re.escape(self.prefix) + r"_(\d+)_")
+        epochs = []
+        for fname in os.listdir(self.directory):
+            m = pattern.match(fname)
+            if m is None or not fname.endswith(self._suffix()):
+                continue
+            epochs.append((int(m.group(1)),
+                           os.path.join(self.directory, fname)))
+        epochs.sort()
+        for _, path in epochs[:max(0, len(epochs) - self.keep_last)]:
+            try:
+                os.remove(path)
+                self.debug("pruned old snapshot %s", path)
+            except OSError:       # concurrent reader/cleaner — not fatal
+                pass
 
 
 #: reference-parity alias (veles imported the file flavor as `Snapshotter`)
