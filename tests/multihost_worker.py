@@ -16,6 +16,9 @@ Modes (argv[4], default "dp"):
   processes (megatron-style cross-host TP): layer 0 is output-sharded,
   every process loads the full batch (spmd_loader_shard returns one
   block), and parameter shards are cut per-device from the local copy.
+- ``diverge`` — NEGATIVE test of the init-state digest guard: process 1
+  perturbs one weight before constructing ShardedTrainer, which must
+  refuse to assemble shards from divergent local copies (ADVICE r4).
 """
 
 import json
@@ -84,6 +87,18 @@ def main(coordinator, num_processes, process_id, mode="dp", steps=3):
     assert loader.local_minibatch_size == 32 // shard_cnt
     if mode == "tp":
         assert shard_cnt == 1    # full batch everywhere
+
+    if mode == "diverge":
+        if process_id == 1:
+            entry = wf._fused_runner.state[0]
+            entry["w"] = numpy.asarray(entry["w"]) + 1e-3
+        try:
+            ShardedTrainer(wf._fused_runner, mesh)
+        except Exception as exc:   # noqa: BLE001 — the guard must fire
+            assert "initial runner state differs" in str(exc), exc
+            print("DIVERGE-CAUGHT")
+            return
+        raise AssertionError("divergent init was NOT detected")
 
     trainer = ShardedTrainer(
         wf._fused_runner, mesh,

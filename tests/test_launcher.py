@@ -184,3 +184,28 @@ def test_launcher_evaluate_leaves_weights_untouched(tmp_path):
         numpy.testing.assert_array_equal(a, numpy.array(f.weights.mem))
     # and the scoring pass produced metrics
     assert launcher.result_summary()["last_epoch_metrics"]["validation"]
+
+
+def test_cli_serve_unservable_fails_before_training():
+    """--serve on a workflow with no forward chain / LM trainer must
+    error out BEFORE launcher.boot(), not after the training run
+    completes (ADVICE r4): a misconfiguration knowable up front must
+    not discard the session."""
+    import subprocess
+    import sys
+    import time
+
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = ""
+    start = time.time()
+    proc = subprocess.run(
+        [sys.executable, "-m", "veles_tpu", "veles_tpu.samples.kohonen",
+         "-d", "cpu", "--random-seed", "7", "--no-stats", "--serve", "0",
+         # LARGE epoch budget: if the check ran post-training this would
+         # take minutes — the early error must ignore it entirely
+         "root.kohonen.decision.max_epochs=100000"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env, cwd=REPO, timeout=120)
+    assert proc.returncode == 2, proc.stderr
+    assert "--serve" in proc.stderr and "no forward chain" in proc.stderr
+    assert time.time() - start < 120

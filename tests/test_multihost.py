@@ -143,6 +143,35 @@ def test_two_process_spmd_matches_single_process(mode):
                 mode, step, key, outs[0][step][key], val)
 
 
+def test_two_process_divergent_init_detected():
+    """ShardedTrainer assembles device shards from process-LOCAL host
+    copies, so divergent init across processes must fail loudly at
+    construction (digest cross-check, ADVICE r4) — not silently train a
+    Frankenstein tensor."""
+    port = _free_port()
+    coordinator = "127.0.0.1:%d" % port
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.join(HERE, "multihost_worker.py"),
+             coordinator, "2", str(pid), "diverge"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=_worker_env(), cwd=REPO)
+        for pid in range(2)
+    ]
+    try:
+        for p in procs:
+            stdout, stderr = p.communicate(timeout=300)
+            assert p.returncode == 0, (
+                "worker failed rc=%d\nstdout:\n%s\nstderr:\n%s"
+                % (p.returncode, stdout, stderr[-4000:]))
+            assert "DIVERGE-CAUGHT" in stdout, stdout
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+
+
 def test_spmd_loader_shard_single_process_collapses():
     """All devices in one process → one data block, full batch locally;
     the data axis is found by NAME, not position."""

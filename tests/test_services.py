@@ -91,6 +91,18 @@ class TestRESTServing:
             with urllib.request.urlopen(req, timeout=30) as resp:
                 out = json.loads(resp.read())
             assert len(out["tokens"][0]) == 3 + 8
+            # n_new=1 is honored exactly (quantized decode TIER, reply
+            # truncated to the request — ADVICE r4) and a longer prompt
+            # in the same bucket still round-trips correctly
+            req = urllib.request.Request(
+                "http://127.0.0.1:%d/predict" % api.port,
+                data=json.dumps({"input": [[2, 4, 6, 8, 10]],
+                                 "n_new": 1}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                out = json.loads(resp.read())
+            assert len(out["tokens"][0]) == 6
+            assert out["tokens"][0][:5] == [2, 4, 6, 8, 10]
         finally:
             api.stop()
 

@@ -109,17 +109,25 @@ class TestAdaptiveUpdate:
         numpy.testing.assert_allclose(numpy.array(new_p), exp_p, rtol=1e-5)
 
     def test_adam_default_beta1_when_momentum_unset(self):
-        """momentum=0 means the standard β1=0.9, not zero momentum."""
+        """momentum=None (unset) means the standard β1=0.9, while an
+        EXPLICIT momentum=0.0 is honored as β1=0 (first-moment smoothing
+        off) — a truthiness test would silently promote it to 0.9
+        (ADVICE r4)."""
         import jax.numpy as jnp
         args = (jnp.asarray(self.p), jnp.asarray(self.v),
                 jnp.asarray(self.a), jnp.asarray(self.g), 1, 0.01)
         explicit = self.F.adaptive_update(*args, 0.9, 0.0, 0.0, None,
                                           solver="adam", step=0)
-        default = self.F.adaptive_update(*args, 0.0, 0.0, 0.0, None,
+        default = self.F.adaptive_update(*args, None, 0.0, 0.0, None,
                                          solver="adam", step=0)
         for e, d in zip(explicit, default):
             numpy.testing.assert_array_equal(numpy.array(e),
                                              numpy.array(d))
+        # explicit 0.0 must DIFFER from the default (m_hat becomes g)
+        zero = self.F.adaptive_update(*args, 0.0, 0.0, 0.0, None,
+                                      solver="adam", step=0)
+        assert not numpy.allclose(numpy.array(zero[0]),
+                                  numpy.array(default[0]))
 
     def test_unknown_solver_raises(self):
         with pytest.raises(ValueError):

@@ -341,6 +341,35 @@ class TestGenerate:
             assert nxt == int(out[len(seq)]), (step, seq)
             seq.append(nxt)
 
+    def test_generate_bucketed_prompt_bit_exact(self):
+        """Serving buckets right-pad the prompt and decode with a traced
+        true_len — the continuation must be BIT-IDENTICAL to the
+        unpadded decode (mha_decode_step masks cache positions > pos, so
+        pad garbage can never leak in)."""
+        params = self._params()
+        prompt = jnp.asarray([[1, 2, 3, 4, 5], [9, 8, 7, 6, 5]],
+                             jnp.int32)
+        plain = numpy.asarray(T.generate(params, prompt, n_new=6,
+                                         n_heads=2, temperature=0,
+                                         max_len=16))
+        padded = jnp.pad(prompt, ((0, 0), (0, 3)))      # bucket width 8
+        bucketed = numpy.asarray(T.generate(params, padded, n_new=6,
+                                            n_heads=2, temperature=0,
+                                            max_len=16, true_len=5))
+        numpy.testing.assert_array_equal(plain[:, 5:], bucketed[:, 8:])
+        # sampling path too: same rng => same tokens
+        key = jax.random.PRNGKey(3)
+        plain_s = numpy.asarray(T.generate(
+            params, prompt, n_new=6, n_heads=2, rng=key,
+            temperature=0.8, max_len=16))
+        bucket_s = numpy.asarray(T.generate(
+            params, padded, n_new=6, n_heads=2, rng=key,
+            temperature=0.8, max_len=16, true_len=5))
+        numpy.testing.assert_array_equal(plain_s[:, 5:], bucket_s[:, 8:])
+        with pytest.raises(ValueError):
+            T.generate(params, padded, n_new=2, n_heads=2, temperature=0,
+                       max_len=16, true_len=9)   # exceeds prompt width
+
     def test_generate_sampling_and_moe(self):
         params = self._params(n_experts=2)
         prompt = jnp.asarray([[1, 2]], jnp.int32)

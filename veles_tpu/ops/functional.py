@@ -57,6 +57,25 @@ def set_matmul_precision(mode):
     jax.clear_caches()
 
 
+def matmul_precision(mode):
+    """Context manager: run a block under another precision mode and
+    restore the PRIOR mode (not a hardcoded default) on exit — the one
+    shared implementation for bench/tests/tools that flip to bf16
+    temporarily."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def _cm():
+        prior = ("float32" if _PRECISION == jax.lax.Precision.HIGHEST
+                 else ("bfloat16" if _CAST_BF16 else "default"))
+        set_matmul_precision(mode)
+        try:
+            yield
+        finally:
+            set_matmul_precision(prior)
+    return _cm()
+
+
 def matmul(a, b):
     """Precision-pinned matmul every op routes its GEMMs through."""
     if _CAST_BF16:
@@ -627,7 +646,8 @@ def adaptive_update(param, velocity, accum, grad, batch_size, learning_rate,
       ``lr`` is the reference-style global multiplier (1.0 = paper form).
     - ``adam`` (beyond parity): first/second-moment estimates in the
       velocity/accum slots with bias correction from the traced global
-      ``step``; β1 = ``momentum`` (0 means the standard 0.9), β2 =
+      ``step``; β1 = ``momentum`` (None/unset means the standard 0.9;
+      an explicit 0.0 turns first-moment smoothing off), β2 =
       ``rho`` (set ``solver_rho=0.999`` for the paper constants), ε =
       ``epsilon``.
 
@@ -636,8 +656,9 @@ def adaptive_update(param, velocity, accum, grad, batch_size, learning_rate,
     """
     if solver == "momentum":
         new_p, new_v = sgd_update(param, velocity, grad, batch_size,
-                                  learning_rate, momentum, weight_decay,
-                                  l1_vs_l2, gradient_clip)
+                                  learning_rate,
+                                  0.0 if momentum is None else momentum,
+                                  weight_decay, l1_vs_l2, gradient_clip)
         return new_p, new_v, accum
     g = _effective_grad(param, grad, batch_size, weight_decay, l1_vs_l2,
                         gradient_clip)
@@ -652,7 +673,10 @@ def adaptive_update(param, velocity, accum, grad, batch_size, learning_rate,
         velocity = rho * velocity + (1.0 - rho) * dx * dx
         return param + dx, velocity, accum
     if solver == "adam":
-        beta1 = momentum if momentum else 0.9
+        # None (unset) means the standard 0.9; an EXPLICIT momentum=0.0 is
+        # a legal value (first-moment smoothing off, RMSProp-style) — a
+        # truthiness test here would silently promote it to 0.9
+        beta1 = 0.9 if momentum is None else momentum
         t = jnp.asarray(step, param.dtype) + 1.0
         velocity = beta1 * velocity + (1.0 - beta1) * g
         accum = rho * accum + (1.0 - rho) * g * g

@@ -307,7 +307,7 @@ class GradientDescentBase(AcceleratedUnit):
                       "accum_weights", "accum_bias", "solver")
 
     def __init__(self, workflow, forward=None, learning_rate=0.01,
-                 learning_rate_bias=None, momentum=0.0, weight_decay=0.0,
+                 learning_rate_bias=None, momentum=None, weight_decay=0.0,
                  weight_decay_bias=0.0, l1_vs_l2=0.0, gradient_clip=None,
                  need_err_input=True, lr_policy=None, bias_lr_policy=None,
                  weights_mask=None, solver="momentum", solver_rho=0.95,
@@ -321,6 +321,10 @@ class GradientDescentBase(AcceleratedUnit):
         #: optional 0/1 sparse-connectivity mask multiplied into the weights
         #: after every update (ref: veles/znicz/weights_zerofilling.py [M])
         self.weights_mask = weights_mask
+        #: None = unset sentinel: plain SGD under the momentum solver,
+        #: the standard β1=0.9 under adam.  An EXPLICIT 0.0 is preserved
+        #: (it means "first-moment smoothing off" under adam) — see
+        #: functional.adaptive_update.
         self.momentum = momentum
         self.weight_decay = weight_decay
         self.weight_decay_bias = weight_decay_bias

@@ -229,13 +229,19 @@ def block_decode_step(blk, h, k_cache, v_cache, pos, n_heads):
     return h + _block_ffn(blk, hn), k_cache, v_cache
 
 
-def _generate_impl(params, prompt, rng, temperature, n_new, n_heads,
-                   greedy, max_len, top_k):
+def _generate_impl(params, prompt, rng, temperature, true_len, n_new,
+                   n_heads, greedy, max_len, top_k):
     import jax
     import jax.numpy as jnp
-    s = prompt.shape[1]
     h, caches = prefill(params, prompt, n_heads, max_len)
-    logits = head_logits(params, h[:, -1:, :])[:, 0, :]
+    # ``true_len`` is TRACED: the prompt may be right-padded to a bucket
+    # length so servers compile one program per bucket, not per exact
+    # prompt length.  Under causal attention every position < true_len is
+    # computed exactly regardless of pad content, decode overwrites the
+    # cache from position true_len on, and mha_decode_step masks cache
+    # positions > pos — so bucketing is bit-exact, not approximate.
+    logits = head_logits(params, jax.lax.dynamic_slice_in_dim(
+        h, true_len - 1, 1, axis=1))[:, 0, :]
 
     def sample(logits, key):
         if greedy:
@@ -263,7 +269,7 @@ def _generate_impl(params, prompt, rng, temperature, n_new, n_heads,
         caches, logits, key = carry
         key, sub = next_key(key)
         tok = sample(logits, sub)
-        pos = s + i
+        pos = true_len + i
         x = (jnp.take(params["embed"], tok, axis=0)[:, None, :]
              + jax.lax.dynamic_slice_in_dim(params["pos"], pos, 1,
                                             axis=0)[None])
@@ -293,7 +299,7 @@ NEG_INF_LOGIT = -1e30
 
 
 def generate(params, prompt, n_new, n_heads, rng=None, temperature=1.0,
-             max_len=None, top_k=None):
+             max_len=None, top_k=None, true_len=None):
     """Autoregressive sampling with a KV cache, fully under jit.
 
     prompt: (batch, s) int32; returns (batch, s + n_new) int32.
@@ -307,17 +313,28 @@ def generate(params, prompt, n_new, n_heads, rng=None, temperature=1.0,
     ``max_len`` pins the cache size (default prompt + n_new) so callers
     timing different ``n_new`` can hold the cache shape constant.
     ``top_k`` restricts sampling to the k most likely tokens.
+    ``true_len`` (TRACED) says how many leading prompt positions are
+    real when the prompt is right-padded to a bucket width — decoding
+    continues from position ``true_len`` and the continuation lands at
+    ``out[:, prompt_width:]`` as usual (bit-exact; see _generate_impl).
     """
     import jax
     import jax.numpy as jnp
     global _GENERATE_JIT
     if n_new < 1:
         raise ValueError("n_new must be >= 1")
+    start = prompt.shape[1] if true_len is None else int(true_len)
+    if not 1 <= start <= prompt.shape[1]:
+        raise ValueError("true_len %d out of range (prompt width %d)"
+                         % (start, prompt.shape[1]))
     if max_len is None:
-        max_len = prompt.shape[1] + n_new
-    if prompt.shape[1] + n_new > max_len:
+        max_len = max(prompt.shape[1], start + n_new)
+    if prompt.shape[1] > max_len:
+        raise ValueError("padded prompt width %d exceeds max_len %d"
+                         % (prompt.shape[1], max_len))
+    if start + n_new > max_len:
         raise ValueError("prompt + n_new = %d exceeds max_len %d"
-                         % (prompt.shape[1] + n_new, max_len))
+                         % (start + n_new, max_len))
     if max_len > params["pos"].shape[0]:
         raise ValueError("max_len %d exceeds the positional table (%d)"
                          % (max_len, params["pos"].shape[0]))
@@ -334,6 +351,7 @@ def generate(params, prompt, n_new, n_heads, rng=None, temperature=1.0,
                              "top_k"))
     return _GENERATE_JIT(params, prompt, None if greedy else rng,
                          jnp.asarray(temperature or 1.0, jnp.float32),
+                         jnp.asarray(start, jnp.int32),
                          n_new=n_new, n_heads=n_heads, greedy=greedy,
                          max_len=max_len,
                          # greedy never reads top_k — null it so distinct
@@ -342,7 +360,8 @@ def generate(params, prompt, n_new, n_heads, rng=None, temperature=1.0,
 
 
 def trainer_sample_tokens(trainer, prompt, n_new=32, temperature=0.0,
-                          seed=0, params=None, max_len=None, top_k=None):
+                          seed=0, params=None, max_len=None, top_k=None,
+                          true_len=None):
     """Continue token sequences with a trained TransformerTrainer —
     the ONE decode entry point shared by the sample helpers
     (char_lm.sample_tokens) and HTTP serving (restful_api.serve_lm):
@@ -359,7 +378,8 @@ def trainer_sample_tokens(trainer, prompt, n_new=32, temperature=0.0,
                                   jnp.asarray(prompt, jnp.int32),
                                   n_new, trainer.n_heads, rng=rng,
                                   temperature=temperature,
-                                  max_len=max_len, top_k=top_k))
+                                  max_len=max_len, top_k=top_k,
+                                  true_len=true_len))
 
 
 def make_adam_train_step(loss_fn, learning_rate, beta1=0.9, beta2=0.999,

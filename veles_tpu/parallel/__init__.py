@@ -99,6 +99,23 @@ class ShardedTrainer:
         self.state_shardings = shardings
         #: global train-step counter (lr policies); see train_step
         self.step_count = 0
+        # Multi-process placement cuts every device's shard from the
+        # process-LOCAL host copy (_put), which is only correct when all
+        # processes built bit-identical initial state — divergent init
+        # (version skew, nondeterministic op order) would silently
+        # assemble a Frankenstein tensor on a cross-process model axis.
+        # Cross-check a digest first, mirroring the place_dataset guard.
+        if self.multiprocess:
+            import zlib
+            from jax.experimental import multihost_utils
+            digest = [zlib.crc32(numpy.ascontiguousarray(leaf).tobytes())
+                      for leaf in jax.tree.leaves(runner.state)]
+            multihost_utils.assert_equal(
+                numpy.asarray(digest, numpy.uint32),
+                "initial runner state differs across processes — every "
+                "process must build bit-identical params (same seed, "
+                "pinned PRNG streams) before ShardedTrainer assembles "
+                "shards from local copies")
         #: device state, placed according to the sharding plan (replicated
         #: state: every process holds the full value, so local data == the
         #: global array in the multi-process assembly)

@@ -130,9 +130,19 @@ def serve_lm(workflow, host="127.0.0.1", port=8180, max_new=256):
     "n_new": N, "temperature": T, "top_k": K, "seed": S}`` to
     ``/predict`` returns ``{"tokens": [[...]]}`` — prompt plus
     continuation per row.  Decoding is the KV-cached
-    ``transformer.generate`` path, one jitted dispatch per request;
-    ``n_new`` is clamped to ``max_new``.  top_k is jit-static but
-    vocab-bounded, so client-driven compiles stay finite.
+    ``transformer.generate`` path, one jitted dispatch per request.
+    Compile count and per-request cost are both BOUNDED against
+    adversarial or merely varied clients:
+
+    - prompt lengths are BUCKETED — the prompt is right-padded to the
+      next power of two and decoded with a traced ``true_len`` (bit-exact
+      under causal attention, see ``transformer._generate_impl``), so
+      compiles grow with log2(max_len), not with every distinct prompt
+      length;
+    - ``n_new`` is quantized into a few static TIERS (clamped to
+      ``max_new``), so an n_new=1 request pays a short tier's decode,
+      not the full ``max_new``, while per-value recompiles stay
+      impossible.  top_k remains jit-static but vocab-bounded.
     """
     from veles_tpu.ops.transformer import trainer_sample_tokens
     trainer = workflow.trainer
@@ -140,28 +150,46 @@ def serve_lm(workflow, host="127.0.0.1", port=8180, max_new=256):
     # trainers pay the block unstack here, not per request)
     params = trainer._to_portable(trainer.params)
     cache_len = int(trainer.max_len)
+    tiers = sorted({t for t in (8, 32, max_new) if t <= max_new})
 
     def handler(request):
-        prompt = request["input"]
-        n_new = min(int(request.get("n_new", 32)), max_new)
-        # decode length and cache shape are jit-STATIC: always decode up
-        # to the clamp (truncating the reply) with the cache pinned at
-        # the positional-table size, so compiles are bounded by the set
-        # of distinct PROMPT lengths (each compiled once) — a client
-        # varying n_new per request cannot force recompiles
-        run = min(max_new, cache_len - len(prompt[0]))
-        if run < 1:
+        prompt = numpy.asarray(request["input"], numpy.int32)
+        want = min(int(request.get("n_new", 32)), max_new)
+        if want < 1:        # n_new=0: echo/validation probe, no decode
+            return {"tokens": prompt.tolist()}
+        s_true = prompt.shape[1]
+        headroom = cache_len - s_true
+        if headroom < 1:
             raise ValueError("prompt length %d leaves no room to decode "
-                             "(max_len %d)" % (len(prompt[0]), cache_len))
+                             "(max_len %d)" % (s_true, cache_len))
+        # decode length: round the request UP to a tier; near the cache
+        # cap fall back to the largest tier that fits (or the exact
+        # headroom when even the smallest doesn't — rare, self-limiting)
+        run = next((t for t in tiers if t >= want), tiers[-1])
+        if run > headroom:
+            fitting = [t for t in tiers if t <= headroom]
+            run = fitting[-1] if fitting else headroom
+        # prompt bucket: right-pad to the next power of two that still
+        # fits the cache; true_len keeps decoding bit-exact
+        bucket = 16
+        while bucket < s_true:
+            bucket *= 2
+        bucket = min(bucket, cache_len - run)
+        if bucket > s_true:
+            prompt = numpy.pad(prompt, ((0, 0), (0, bucket - s_true)))
         top_k = request.get("top_k")
         out = trainer_sample_tokens(
             trainer, prompt, n_new=run,
             temperature=float(request.get("temperature", 0.0)),
             seed=int(request.get("seed", 0)), params=params,
             max_len=cache_len,
-            top_k=int(top_k) if top_k is not None else None)
-        out = out[:, :len(prompt[0]) + min(n_new, run)]
-        return {"tokens": out.tolist()}
+            top_k=int(top_k) if top_k is not None else None,
+            true_len=s_true)
+        # the continuation lands after the PADDED width; reply with the
+        # true prompt plus min(want, run) new tokens
+        new = out[:, prompt.shape[1]:prompt.shape[1] + min(want, run)]
+        return {"tokens": numpy.concatenate(
+            [out[:, :s_true], new], axis=1).tolist()}
 
     return RESTfulAPI(None, handler=handler).start(host=host, port=port)
 
