@@ -51,6 +51,27 @@ def test_cifar_default_topology_converges():
     assert errs[-1] < 10.0, errs
 
 
+def test_cifar_default_topology_converges_bf16():
+    """Convergence PARITY under bf16 operand casts (the TPU fast path):
+    the same sample-default conv stack, seed and data must reach the
+    same <10% val-err bar that the fp32-HIGHEST run does — the CPU half
+    of the evidence the bf16 conv-net recommendation rests on (the
+    hardware half is bench.py convergence:cifar_conv_bf16)."""
+    from veles_tpu.ops import functional as F
+    prng.reset(); prng.seed_all(42)
+    root.__dict__.pop("cifar", None)
+    root.cifar.update({
+        "loader": {"minibatch_size": 50, "n_train": 600, "n_valid": 200},
+        "decision": {"max_epochs": 8, "fail_iterations": 50},
+    })
+    from veles_tpu.samples import cifar
+    with F.matmul_precision("bfloat16"):
+        wf = cifar.train(fused=True)
+    errs = [m["validation"]["err_pct"] for m in wf.decision.epoch_metrics
+            if "validation" in m]
+    assert errs[-1] < 10.0, errs
+
+
 def test_cifar_fused_and_unit_mode_identical():
     from veles_tpu.samples import cifar
     finals, weights = [], []

@@ -117,13 +117,10 @@ def main():
     w2 = jax.random.normal(key, (5, 5, 96, 256), jnp.float32) * 0.01
     b2 = jnp.zeros((256,))
     for mode in ("float32", "bfloat16"):
-        F.set_matmul_precision(mode)
-        try:
+        with F.matmul_precision(mode):
             bench_op("conv2 fwd (%s)" % mode,
                      lambda x: F.conv2d_forward(x, w2, b2, (1, 1), 2,
                                                 "strict_relu"), x2)
-        finally:
-            F.set_matmul_precision("float32")
 
     # ---- FC trunk 9216->4096->4096->1000
     xf = jax.random.normal(key, (B, 9216), jnp.float32)

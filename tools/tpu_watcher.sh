@@ -1,0 +1,27 @@
+#!/bin/bash
+# TPU-tunnel recovery watcher (VERDICT r4 task 1: "keep it armed from
+# minute one").  Probes the device with bench.py's timeout-bounded probe
+# worker; the moment the tunnel answers, runs the FULL bench ladder and
+# the real-IDX convergence tool, then exits so the session is notified.
+#
+# Usage: bash tools/tpu_watcher.sh [interval_seconds]
+set -u
+cd "$(dirname "$0")/.."
+INTERVAL="${1:-600}"
+OUT=bench_r5_tpu
+echo "[watcher] started $(date -u +%FT%TZ), probing every ${INTERVAL}s"
+while true; do
+    probe=$(VELES_BENCH_PROBE_S=120 timeout 180 \
+            python bench.py --worker __probe__ 2>/dev/null | tail -1)
+    if echo "$probe" | grep -q '"ok": true'; then
+        echo "[watcher] tunnel ALIVE at $(date -u +%FT%TZ) — running bench"
+        python bench.py >"${OUT}.out" 2>"${OUT}.err"
+        echo "[watcher] bench rc=$? at $(date -u +%FT%TZ)"
+        timeout 3600 python tools/convergence.py \
+            >convergence_r5_tpu.out 2>convergence_r5_tpu.err
+        echo "[watcher] convergence rc=$? at $(date -u +%FT%TZ)"
+        exit 0
+    fi
+    echo "[watcher] tunnel dead at $(date -u +%FT%TZ)"
+    sleep "$INTERVAL"
+done

@@ -1,0 +1,122 @@
+"""Per-op breakdown of a Chrome-trace (.trace.json[.gz]) captured by
+tools/trace_step.py — the committed-artifact half of the perf loop
+(VERDICT r4 task 2): aggregate XLA-op durations by HLO identity, compute
+per-step cost, achieved TFLOP/s and HBM GB/s per op, and classify each
+as MXU-bound vs HBM-bound, so "where do the milliseconds go" is a table
+in docs/PERF.md instead of a guess.
+
+Usage::
+
+    python tools/trace_analyze.py docs/traces/X.trace.json.gz [--steps N]
+    python tools/trace_analyze.py X.trace.json.gz --markdown
+
+The outer ``while`` op (the lax.scan over training steps) is excluded
+from aggregation — its children are on the same timeline — and every
+count is divided by the number of scan iterations so the table reads
+"per training step".
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import gzip
+import json
+
+
+def load_events(path):
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rt") as f:
+        trace = json.load(f)
+    return trace["traceEvents"]
+
+
+def xla_ops(events):
+    """Complete ('X') events on every thread named 'XLA Ops'."""
+    threads = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "thread_name":
+            threads[(e["pid"], e.get("tid"))] = e["args"]["name"]
+    return [e for e in events if e.get("ph") == "X"
+            and threads.get((e["pid"], e.get("tid"))) == "XLA Ops"]
+
+
+def analyze(path, steps=None):
+    """Aggregate per-op rows.  ``steps`` = scan iterations per while-op
+    execution; inferred from the most common op count inside the while
+    when not given."""
+    ops = xla_ops(load_events(path))
+    whiles = [e for e in ops if e["args"].get("hlo_category") == "while"]
+    inner = [e for e in ops if e["args"].get("hlo_category") != "while"]
+    n_while = max(len(whiles), 1)
+
+    rows = {}
+    for e in inner:
+        a = e.get("args", {})
+        key = e["name"]
+        r = rows.setdefault(key, {
+            "op": key, "category": a.get("hlo_category", "?"),
+            "count": 0, "dur_us": 0.0, "flops": 0, "bytes": 0,
+            "shape": a.get("shape_with_layout", ""),
+        })
+        r["count"] += 1
+        r["dur_us"] += e["dur"]
+        r["flops"] += int(a.get("model_flops", 0) or 0)
+        r["bytes"] += int(a.get("bytes_accessed", 0) or 0)
+
+    if steps is None:
+        # per-step op instances repeat once per scan iteration (whatever
+        # number of while executions those iterations are spread over);
+        # the MODAL execution count of the heavy ops IS the total number
+        # of training steps in the capture
+        counts = collections.Counter(
+            r["count"] for r in rows.values() if r["dur_us"] > 1000)
+        steps = counts.most_common(1)[0][0] if counts else 1
+
+    total_us = sum(r["dur_us"] for r in rows.values())
+    out = []
+    for r in sorted(rows.values(), key=lambda r: -r["dur_us"]):
+        sec = r["dur_us"] / 1e6
+        out.append({
+            **r,
+            "ms_per_step": r["dur_us"] / 1e3 / steps,
+            "pct": 100.0 * r["dur_us"] / total_us,
+            "tflops": (r["flops"] / sec / 1e12) if sec else 0.0,
+            "gbps": (r["bytes"] / sec / 1e9) if sec else 0.0,
+        })
+    return {"rows": out, "steps": steps, "n_while": n_while,
+            "total_ms_per_step": total_us / 1e3 / steps}
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("trace")
+    p.add_argument("--steps", type=int, default=None,
+                   help="scan iterations per while execution (inferred "
+                        "from op counts when omitted)")
+    p.add_argument("--top", type=int, default=25)
+    p.add_argument("--markdown", action="store_true")
+    args = p.parse_args()
+    res = analyze(args.trace, args.steps)
+    rows = res["rows"][:args.top]
+    shown = sum(r["ms_per_step"] for r in rows)
+    print("# %d while execution(s) x %d scan steps; device total "
+          "%.2f ms/step (top %d ops below: %.2f ms)"
+          % (res["n_while"], res["steps"], res["total_ms_per_step"],
+             args.top, shown))
+    if args.markdown:
+        print("| op | category | ms/step | % | TF/s | GB/s |")
+        print("|---|---|---|---|---|---|")
+        for r in rows:
+            print("| %s | %s | %.3f | %.1f | %.1f | %.0f |"
+                  % (r["op"], r["category"], r["ms_per_step"],
+                     r["pct"], r["tflops"], r["gbps"]))
+    else:
+        for r in rows:
+            print("%8.3f ms/step %5.1f%% %7.1f TF/s %6.0f GB/s  %-28s %s"
+                  % (r["ms_per_step"], r["pct"], r["tflops"], r["gbps"],
+                     r["op"], r["category"]))
+
+
+if __name__ == "__main__":
+    main()
