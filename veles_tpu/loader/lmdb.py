@@ -1,10 +1,12 @@
-"""Caffe-LMDB dataset loader (gated on the optional ``lmdb`` package).
+"""Caffe-LMDB dataset loader.
 
 Ref: veles/znicz/loader/loader_lmdb.py [M] (SURVEY §2.2): ImageNet-scale
 datasets prepared for Caffe live in LMDB env files of serialized Datum
-records.  This loader reads them directly when ``lmdb`` is importable; the
-supported in-tree path for large datasets is ``records.py`` (convert once
-with ``lmdb_to_records``, then memmap).
+records.  Reading prefers the ``lmdb`` package when importable and
+otherwise falls back to the vendored pure-Python reader of the stable
+MDB on-disk format (``veles_tpu.loader.mdb``) — real env bytes either
+way, no fake modules.  The supported in-tree path for LARGE datasets is
+``records.py`` (convert once with ``lmdb_to_records``, then memmap).
 """
 
 from __future__ import annotations
@@ -16,24 +18,63 @@ import numpy
 from veles_tpu.loader.base import Loader
 
 
-def _require_lmdb():
+def _open_env(path):
+    """Open ``path`` read-only; returns an object with ``stat()`` and
+    ``items()`` (key/value bytes in key order)."""
     try:
         import lmdb
-    except ImportError as e:
-        raise ImportError(
-            "LMDBLoader needs the 'lmdb' package, which is not installed in "
-            "this environment; convert the dataset once with "
-            "veles_tpu.loader.lmdb.lmdb_to_records(...) on a machine that "
-            "has it, or use RecordsLoader / image loaders") from e
-    return lmdb
+    except ImportError:
+        from veles_tpu.loader import mdb
+        return mdb.open_env(path)
+
+    class _PkgEnv:
+        def __init__(self, path):
+            self._env = lmdb.open(path, readonly=True, lock=False)
+
+        def stat(self):
+            return self._env.stat()
+
+        def items(self):
+            with self._env.begin() as txn:
+                yield from txn.cursor()
+    return _PkgEnv(path)
 
 
 def _iter_datums(env):
-    """Yield (key, uint8 CHW array, label) from a Caffe LMDB environment."""
-    with env.begin() as txn:
-        for key, raw in txn.cursor():
-            arr, label = _parse_datum(raw)
-            yield key, arr, label
+    """Yield (key, uint8 CHW array, label) from an opened environment."""
+    for key, raw in env.items():
+        arr, label = _parse_datum(raw)
+        yield key, arr, label
+
+
+def _varint(v):
+    if v < 0:
+        # protobuf encodes negatives as 10-byte two's complement; Datum
+        # fields are all non-negative, so reject instead of hanging
+        raise ValueError("negative varint %d (Datum fields are "
+                         "non-negative)" % v)
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        out.append(b | (0x80 if v else 0))
+        if not v:
+            return bytes(out)
+
+
+def serialize_datum(chw, label=0):
+    """Serialize a uint8 CHW array to Caffe Datum protobuf wire bytes —
+    the inverse of :func:`_parse_datum` (fixture/export use: author real
+    Caffe-layout LMDBs with ``mdb.write_env``)."""
+    chw = numpy.ascontiguousarray(chw, numpy.uint8)
+    c, h, w = chw.shape
+    out = b""
+    for field, val in ((1, c), (2, h), (3, w)):
+        out += _varint(field << 3) + _varint(val)
+    data = chw.tobytes()
+    out += _varint((4 << 3) | 2) + _varint(len(data)) + data
+    out += _varint(5 << 3) + _varint(int(label))
+    return out
 
 
 def _parse_datum(raw):
@@ -83,8 +124,7 @@ def lmdb_to_records(lmdb_path, out_path, class_lengths=None):
     import json
     import struct
     from veles_tpu.loader.records import MAGIC
-    lmdb = _require_lmdb()
-    env = lmdb.open(lmdb_path, readonly=True, lock=False)
+    env = _open_env(lmdb_path)
     n = env.stat()["entries"]
     if class_lengths is None:
         class_lengths = [0, 0, n]
@@ -150,8 +190,7 @@ class LMDBLoader(Loader):
     def _load_split(self, path):
         """uint8 HWC arrays — float conversion happens per minibatch (a
         float32 copy of an ImageNet split would 4x the resident set)."""
-        lmdb = _require_lmdb()
-        env = lmdb.open(path, readonly=True, lock=False)
+        env = _open_env(path)
         xs, ys = [], []
         for _, chw, label in _iter_datums(env):
             xs.append(chw.transpose(1, 2, 0))
@@ -159,7 +198,6 @@ class LMDBLoader(Loader):
         return numpy.stack(xs), numpy.asarray(ys, numpy.int32)
 
     def load_data(self):
-        _require_lmdb()
         valid = ((self._load_split(self.validation_path))
                  if self.validation_path else
                  (numpy.zeros((0, 1, 1, 1), numpy.uint8),
