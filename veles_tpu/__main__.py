@@ -107,6 +107,20 @@ def build_argparser():
                              "dataset split with weight updates gated "
                              "off (pair with --snapshot to score a "
                              "trained model)")
+    parser.add_argument("--web-status", type=int, default=None,
+                        metavar="PORT",
+                        help="serve the live dashboard (0 = ephemeral "
+                             "port; prints WEBSTATUS <url>): per-process "
+                             "rows, per-epoch metrics, workflow graph "
+                             "view at /graph/<row>.svg")
+    parser.add_argument("--web-status-url", default=None, metavar="URL",
+                        help="report this process's rows to ANOTHER "
+                             "dashboard instead of serving one (worker "
+                             "processes of a multi-host run)")
+    parser.add_argument("--web-status-host", default="127.0.0.1",
+                        metavar="HOST",
+                        help="interface --web-status binds (use 0.0.0.0 "
+                             "so other hosts' workers can POST /report)")
     parser.add_argument("--serve", type=int, default=None, metavar="PORT",
                         help="after the run completes, serve the trained "
                              "workflow over HTTP (REST /predict; 0 = "
@@ -239,6 +253,15 @@ def main(argv=None):
             # discard the session on a misconfiguration knowable up front
             parser.error("--serve: workflow %r has no forward chain or "
                          "LM trainer to serve" % wf.name)
+        if args.web_status is not None or args.web_status_url:
+            from veles_tpu.web_status import attach_web_status
+            status = attach_web_status(
+                wf, port=args.web_status or 0,
+                report_url=args.web_status_url,
+                host=args.web_status_host)
+            if status is not None:
+                print("WEBSTATUS http://%s:%d/"
+                      % (args.web_status_host, status.port), flush=True)
         launcher = Launcher(
             wf, snapshot=args.snapshot, distributed=args.distributed,
             coordinator_address=args.coordinator_address,

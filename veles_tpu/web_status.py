@@ -3,9 +3,21 @@
 Ref: veles/web_status.py + web/ frontend [M] (SURVEY §2.1, §5.5): the
 reference ran a tornado service showing masters/slaves, progress and the
 workflow graph.  Lite redesign: an stdlib HTTP server on a background
-thread serving ``/status.json`` (machine-readable) and ``/`` (a small
-self-refreshing HTML table).  Workflows register themselves; a
-``StatusReporter`` unit linked off the decision pushes per-epoch progress.
+thread serving
+
+- ``/status.json``        — machine-readable snapshot,
+- ``/``                   — self-refreshing HTML table (one row per
+  workflow per process — the master/slave table of the reference,
+  re-keyed by jax process index),
+- ``/graph/<name>.dot``   — the unit graph as graphviz dot text
+  (``Workflow.generate_graph``),
+- ``/graph/<name>.svg``   — the same graph rendered server-side by a
+  small built-in layered-DAG renderer (no graphviz binary in the
+  image; the reference shipped a JS viewer for the same purpose).
+
+Workflows register themselves via :class:`StatusReporter`; processes
+other than 0 in a multi-host run (or remote launchers) report into the
+process-0 dashboard over ``POST /report``.
 """
 
 from __future__ import annotations
@@ -22,8 +34,108 @@ _PAGE = """<!doctype html><html><head><meta charset="utf-8">
 <style>body{font-family:monospace} table{border-collapse:collapse}
 td,th{border:1px solid #999;padding:4px 8px}</style></head><body>
 <h2>veles_tpu — running workflows</h2><table><tr>
-<th>workflow</th><th>epoch</th><th>best</th><th>last metrics</th>
-<th>updated</th></tr>%s</table></body></html>"""
+<th>workflow</th><th>proc</th><th>epoch</th><th>best</th>
+<th>last metrics</th><th>graph</th><th>updated</th></tr>%s</table>
+</body></html>"""
+
+
+def _svg_escape(s):
+    return (str(s).replace("&", "&amp;").replace("<", "&lt;")
+            .replace(">", "&gt;").replace('"', "&quot;"))
+
+
+def render_graph_svg(nodes, edges):
+    """Layered-DAG SVG of a unit graph — the dependency-free stand-in
+    for the reference's JS graph viewer.
+
+    ``nodes``: list of labels; ``edges``: list of (src_idx, dst_idx).
+    Layering = longest path from any source, with back-edges (the
+    Repeater cycle) ignored for layout but still DRAWN (curved, dashed)
+    so the control loop stays visible.
+    """
+    n = len(nodes)
+    adj = [[] for _ in range(n)]
+    for s, d in edges:
+        if 0 <= s < n and 0 <= d < n:
+            adj[s].append(d)
+
+    # DFS from every source to find back-edges (cycle closers)
+    color = [0] * n          # 0 white, 1 on-stack, 2 done
+    back = set()
+
+    def dfs(u):
+        color[u] = 1
+        for v in adj[u]:
+            if color[v] == 1:
+                back.add((u, v))
+            elif color[v] == 0:
+                dfs(v)
+        color[u] = 2
+
+    for u in range(n):
+        if color[u] == 0:
+            dfs(u)
+
+    fwd = [(s, d) for s, d in edges
+           if 0 <= s < n and 0 <= d < n and (s, d) not in back]
+    # longest-path layering over the acyclic forward edges
+    layer = [0] * n
+    for _ in range(n):
+        changed = False
+        for s, d in fwd:
+            if layer[d] < layer[s] + 1:
+                layer[d] = layer[s] + 1
+                changed = True
+        if not changed:
+            break
+
+    by_layer = {}
+    for i in range(n):
+        by_layer.setdefault(layer[i], []).append(i)
+    bw, bh, hgap, vgap, pad = 150, 28, 30, 46, 20
+    pos = {}
+    width = pad * 2
+    for ly in sorted(by_layer):
+        row = by_layer[ly]
+        for col, i in enumerate(row):
+            pos[i] = (pad + col * (bw + hgap), pad + ly * (bh + vgap))
+        width = max(width, pad * 2 + len(row) * (bw + hgap) - hgap)
+    height = pad * 2 + (max(by_layer) + 1) * (bh + vgap) - vgap \
+        if by_layer else pad * 2
+
+    parts = ['<svg xmlns="http://www.w3.org/2000/svg" width="%d" '
+             'height="%d" font-family="monospace" font-size="12">'
+             % (width, height),
+             '<defs><marker id="arr" markerWidth="8" markerHeight="8" '
+             'refX="7" refY="3" orient="auto"><path d="M0,0 L7,3 L0,6 z" '
+             'fill="#555"/></marker></defs>']
+    for s, d in edges:
+        if not (0 <= s < n and 0 <= d < n):
+            continue
+        x1, y1 = pos[s][0] + bw / 2, pos[s][1] + bh
+        x2, y2 = pos[d][0] + bw / 2, pos[d][1]
+        if (s, d) in back:    # curved dashed return edge (the cycle)
+            y1, y2 = pos[s][1] + bh / 2, pos[d][1] + bh / 2
+            x1, x2 = pos[s][0], pos[d][0]
+            bend = min(pos[s][0], pos[d][0]) - 40
+            parts.append(
+                '<path d="M%g,%g C%g,%g %g,%g %g,%g" fill="none" '
+                'stroke="#999" stroke-dasharray="4 3" '
+                'marker-end="url(#arr)"/>' % (x1, y1, bend, y1,
+                                              bend, y2, x2, y2))
+        else:
+            parts.append('<line x1="%g" y1="%g" x2="%g" y2="%g" '
+                         'stroke="#555" marker-end="url(#arr)"/>'
+                         % (x1, y1, x2, y2))
+    for i, label in enumerate(nodes):
+        x, y = pos[i]
+        parts.append('<rect x="%g" y="%g" width="%d" height="%d" '
+                     'fill="#eef" stroke="#336"/>' % (x, y, bw, bh))
+        parts.append('<text x="%g" y="%g" text-anchor="middle">%s</text>'
+                     % (x + bw / 2, y + bh / 2 + 4,
+                        _svg_escape(label[:22])))
+    parts.append("</svg>")
+    return "".join(parts)
 
 
 class WebStatus:
@@ -46,6 +158,16 @@ class WebStatus:
         with self._lock:
             return json.loads(json.dumps(self._entries, default=str))
 
+    def _graph_entry(self, name):
+        with self._lock:
+            for key, e in self._entries.items():
+                if (key == name or e.get("workflow") == name) \
+                        and "graph_nodes" in e:
+                    return (e["graph_nodes"],
+                            [tuple(x) for x in e.get("graph_edges", [])],
+                            e.get("graph_dot", ""))
+        return None
+
     # ---------------------------------------------------------------- server
     def start(self, host="127.0.0.1", port=0):
         status = self
@@ -56,15 +178,41 @@ class WebStatus:
                     body = json.dumps(status.snapshot(),
                                       default=str).encode()
                     ctype = "application/json"
+                elif self.path.startswith("/graph/"):
+                    target = self.path[len("/graph/"):]
+                    base, _, ext = target.rpartition(".")
+                    found = status._graph_entry(base)
+                    if found is None or ext not in ("svg", "dot"):
+                        self.send_error(404)
+                        return
+                    nodes, graph_edges, dot = found
+                    if ext == "dot":
+                        body, ctype = dot.encode(), "text/plain"
+                    else:
+                        body = render_graph_svg(
+                            nodes, graph_edges).encode()
+                        ctype = "image/svg+xml"
                 elif self.path == "/" or self.path.startswith("/index"):
                     import html as html_mod
                     rows = ""
                     for name, e in sorted(status.snapshot().items()):
-                        rows += ("<tr><td>%s</td><td>%s</td><td>%s</td>"
-                                 "<td>%s</td><td>%s</td></tr>") % tuple(
-                            html_mod.escape(str(v)) for v in (
-                                name, e.get("epoch", ""), e.get("best", ""),
-                                e.get("metrics", ""), e.get("updated", "")))
+                        wf_name = e.get("workflow", name)
+                        graph = ('<a href="/graph/%s.svg">svg</a> '
+                                 '<a href="/graph/%s.dot">dot</a>'
+                                 % (name, name)
+                                 if "graph_nodes" in e else "")
+                        cells = "".join(
+                            "<td>%s</td>" % html_mod.escape(str(v))
+                            for v in (
+                                wf_name,
+                                "%s/%s" % (e.get("process", 0),
+                                           e.get("processes", 1)),
+                                e.get("epoch", ""), e.get("best", ""),
+                                e.get("metrics", "")))
+                        rows += ("<tr>%s<td>%s</td><td>%s</td></tr>"
+                                 % (cells, graph,
+                                    html_mod.escape(
+                                        str(e.get("updated", "")))))
                     body = (_PAGE % rows).encode()
                     ctype = "text/html"
                 else:
@@ -72,6 +220,28 @@ class WebStatus:
                     return
                 self.send_response(200)
                 self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                # remote report-in: non-zero processes of a multi-host
+                # run (or remote launchers) push their rows here — the
+                # TPU-era form of the reference's slave→master status
+                if self.path.rstrip("/") != "/report":
+                    self.send_error(404)
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    payload = json.loads(self.rfile.read(length))
+                    name = payload.pop("name")
+                    status.update(str(name), **payload)
+                    body = b'{"ok": true}'
+                    self.send_response(200)
+                except Exception as e:   # noqa: BLE001 — told to client
+                    body = json.dumps({"error": str(e)}).encode()
+                    self.send_response(400)
+                self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -103,28 +273,97 @@ def get_default():
     return _default
 
 
+def post_report(url, name, **fields):
+    """Report one row into a remote dashboard (``POST /report``)."""
+    import urllib.request
+    req = urllib.request.Request(
+        url.rstrip("/") + "/report",
+        data=json.dumps({"name": name, **fields}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+def attach_web_status(workflow, port=0, report_url=None,
+                      host="127.0.0.1"):
+    """Product wiring for the dashboard (CLI ``--web-status``): start a
+    local server (or target a remote one via ``report_url``) and link a
+    :class:`StatusReporter` off the workflow's decision so every epoch
+    pushes a row.  Returns the local WebStatus (None in report_url
+    mode).  For a multi-HOST run the master must bind a reachable
+    interface (``host="0.0.0.0"`` / CLI ``--web-status-host``) or
+    workers' ``POST /report`` cannot reach it."""
+    status = None
+    if report_url is None:
+        status = WebStatus().start(host=host, port=port)
+    reporter = StatusReporter(workflow, status=status,
+                              report_url=report_url,
+                              name="web_status_reporter")
+    decision = getattr(workflow, "decision", None)
+    if decision is not None:
+        reporter.link_from(decision)
+    return status
+
+
 class StatusReporter(Unit):
     """Graph unit pushing decision progress into a WebStatus.
 
     Wire: ``reporter.link_from(decision)`` + link_attrs epoch_number etc.,
     or just construct with the workflow — it reads the decision directly.
+    Rows are keyed ``<workflow>[@<process>]`` so a multi-host run shows
+    one row per process; the unit graph is pushed once on the first run
+    and served at ``/graph/<row>.svg`` / ``.dot``.  Pass ``report_url``
+    to push rows to ANOTHER process's dashboard instead of a local one
+    (how slave processes reported to the reference's master).
     """
 
-    def __init__(self, workflow, status=None, **kwargs):
+    def __init__(self, workflow, status=None, report_url=None, **kwargs):
         super().__init__(workflow, **kwargs)
-        self.status = status or get_default()
+        self.report_url = report_url
+        self.status = None if report_url else (status or get_default())
+        self._graph_pushed = False
+
+    def _process_info(self):
+        try:
+            import jax
+            return jax.process_index(), jax.process_count()
+        except Exception:   # noqa: BLE001 — before backend init
+            return 0, 1
 
     def run(self):
         wf = self.workflow
         decision = getattr(wf, "decision", None)
         if decision is None:
             return
+        proc, procs = self._process_info()
+        row = wf.name if procs == 1 else "%s@%d" % (wf.name, proc)
         last = decision.epoch_metrics[-1] if decision.epoch_metrics else {}
         metrics = {set_name: {k: v for k, v in m.items()
                               if isinstance(v, (int, float))}
                    for set_name, m in last.items()}
-        self.status.update(wf.name,
-                           epoch=int(getattr(decision, "epoch_number", 0)),
-                           best=decision.best_metric,
-                           complete=bool(decision.complete),
-                           metrics=metrics)
+        fields = dict(
+            workflow=wf.name, process=proc, processes=procs,
+            epoch=int(getattr(decision, "epoch_number", 0)),
+            best=decision.best_metric,
+            complete=bool(decision.complete),
+            metrics=metrics)
+        if not self._graph_pushed:
+            units = list(wf._units)
+            ids = {u: i for i, u in enumerate(units)}
+            fields.update(
+                graph_nodes=[u.name for u in units],
+                graph_edges=[[ids[u], ids[s]] for u in units
+                             for s in u.links_to if s in ids],
+                graph_dot=wf.generate_graph())
+            self._graph_pushed = True
+        if self.report_url is not None:
+            # best-effort: a dashboard outage or network blip must never
+            # abort the training run it reports on
+            try:
+                post_report(self.report_url, row, **fields)
+            except Exception as e:   # noqa: BLE001 — logged, not fatal
+                self._graph_pushed = False     # retry the graph later
+                self.warning("status report to %s failed: %s",
+                             self.report_url, e)
+        else:
+            self.status.update(row, **fields)
