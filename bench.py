@@ -612,6 +612,57 @@ def bench_sgd_backends(n=4 * 1024 * 1024, iters=20, smoke=False):
     return record
 
 
+# --------------------------------------------------- lrn backend (XLA/Pallas)
+def bench_lrn_backends(iters=8, smoke=False):
+    """XLA-vs-Pallas LRN comparison at the AlexNet-LRN1 train shape
+    (fwd+bwd — the top memory-bound item of the post-bf16 step,
+    docs/PERF.md round-5 analysis): per-application device time by
+    in-jit K-vs-1 repetition.  The winner keeps the default
+    (functional._LRN_BACKEND)."""
+    import jax
+    import jax.numpy as jnp
+    from veles_tpu.ops import functional as F
+
+    shape = (8, 28, 28, 32) if smoke else (128, 55, 55, 96)
+    if smoke:
+        iters = 2                 # interpret-mode pallas is slow off-TPU
+    key = jax.random.PRNGKey(0)
+    x0 = jax.random.normal(key, shape, jnp.float32)
+    dy0 = jax.random.normal(jax.random.fold_in(key, 1), shape,
+                            jnp.float32)
+    record = {"shape": list(shape)}
+    for backend in ("xla", "pallas"):
+        F.set_lrn_backend(backend)
+        try:
+            def fwd_bwd(x, dy, k):
+                def body(i, acc):
+                    y, vjp = jax.vjp(F.lrn_forward, acc)
+                    (dx,) = vjp(dy)
+                    return dx
+                return jax.lax.fori_loop(0, k, body, x)
+
+            f1 = jax.jit(lambda x, dy: fwd_bwd(x, dy, 1))
+            fk = jax.jit(lambda x, dy: fwd_bwd(x, dy, 1 + iters))
+            _sync(f1(x0, dy0)); _sync(fk(x0, dy0))       # compile
+            times = []
+            for fn in (f1, fk):
+                best = float("inf")
+                for _ in range(3):
+                    begin = time.perf_counter()
+                    out = fn(x0, dy0)
+                    _sync(out)
+                    best = min(best, time.perf_counter() - begin)
+                times.append(best)
+            record[backend + "_us"] = round(
+                (times[1] - times[0]) / iters * 1e6, 2)
+        finally:
+            F.set_lrn_backend("xla")
+    if "xla_us" in record and "pallas_us" in record:
+        record["winner"] = ("pallas" if record["pallas_us"] <
+                            record["xla_us"] else "xla")
+    return record
+
+
 # --------------------------------------------------- records input pipeline
 def records_fixture(tmpdir, data, labels, mb):
     """Write a record file and open it through RecordsLoader — the shared
@@ -712,7 +763,7 @@ def bench_numpy_floor(wf, min_seconds=3.0):
 
 
 KNOWN_CONFIGS = ("mnist", "cifar", "alexnet", "alexnet_records", "sgd",
-                 "records", "convergence", "lm", "scaling")
+                 "lrn", "records", "convergence", "lm", "scaling")
 #: "convergence" expands to one watchdog worker per sub-bench, so a hang
 #: in one (e.g. a tunnel death mid-compile) cannot discard the others
 CONVERGENCE_SUBS = ("kohonen", "mnist_fc", "cifar_conv",
@@ -787,6 +838,24 @@ def run_configs(wanted, args):
         results["mnist_fc"]["numpy_floor_samples_per_sec"] = round(floor, 1)
         results["mnist_fc"]["vs_numpy_floor"] = round(
             results["mnist_fc"]["samples_per_sec"] / floor, 2)
+        # int8-artifact predict parity ON THIS DEVICE (VERDICT r4 task 8:
+        # the CPU-side test exists; this puts the TPU number in the
+        # bench record): quantized vs fp32 artifact outputs
+        import tempfile
+        from veles_tpu import export
+        d = tempfile.mkdtemp()
+        fp = export.export_model(wf, os.path.join(d, "m.veles"))
+        qp = export.export_model(wf, os.path.join(d, "m8.veles"),
+                                 quantize="int8")
+        ref, qm = export.load_model(fp), export.load_model(qp)
+        x = numpy.random.RandomState(0).uniform(
+            -1, 1, (256, 784)).astype(numpy.float32)
+        a, b = ref.predict(x), qm.predict(x)
+        results["mnist_fc"]["artifact_int8_parity"] = {
+            "argmax_agreement": float(
+                (a.argmax(1) == b.argmax(1)).mean()),
+            "max_abs_diff": float(numpy.abs(a - b).max()),
+        }
 
     if "mnist" in wanted:
         guarded("mnist", _bench_mnist)
@@ -955,6 +1024,14 @@ def run_configs(wanted, args):
     if "sgd" in wanted:
         guarded("sgd", _bench_sgd)
 
+    def _bench_lrn():
+        results["lrn_fwd_bwd"] = bench_lrn_backends(smoke=args.smoke)
+        print("lrn_fwd_bwd: %s" % results["lrn_fwd_bwd"],
+              file=sys.stderr)
+
+    if "lrn" in wanted:
+        guarded("lrn", _bench_lrn)
+
     def _bench_recs():
         results["records_pipeline"] = bench_records(
             smoke=args.smoke, seconds=min(target, 4.0))
@@ -995,6 +1072,14 @@ def emit_summary(results):
         print(json.dumps({
             "metric": "sgd_update_device_us",
             "value": results["sgd_update"].get("xla_us"),
+            "unit": "us",
+            "vs_baseline": None,
+            "configs": results,
+        }))
+    elif "lrn_fwd_bwd" in results:
+        print(json.dumps({
+            "metric": "lrn_fwd_bwd_device_us",
+            "value": results["lrn_fwd_bwd"].get("xla_us"),
             "unit": "us",
             "vs_baseline": None,
             "configs": results,
@@ -1140,7 +1225,7 @@ def main():
                         help="tiny sizes on CPU for CI validation")
     parser.add_argument("--configs",
                         default="mnist,cifar,alexnet,alexnet_records,"
-                                "sgd,records,convergence,lm,scaling",
+                                "sgd,lrn,records,convergence,lm,scaling",
                         help="comma list: " + ",".join(KNOWN_CONFIGS))
     parser.add_argument("--seconds", type=float, default=None,
                         help="target seconds per timing window")

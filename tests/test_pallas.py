@@ -168,3 +168,78 @@ class TestStochasticPooling:
         assert numpy.isfinite(errs).all()
         # 2 epochs x 50 valid samples: just require training stays sane
         assert errs[-1] <= errs[0] + 5
+
+
+class TestPallasLRN:
+    def _x(self, shape=(4, 7, 7, 96), seed=0, scale=1.0):
+        return jax.random.normal(jax.random.PRNGKey(seed), shape,
+                                 jnp.float32) * scale
+
+    @pytest.mark.parametrize("c", [16, 96, 128, 200])
+    def test_forward_matches_functional(self, c):
+        """One-pass banded-matmul LRN ≡ the shifted-slice XLA form at
+        every channel width (below/at/above the 128-lane tile)."""
+        from veles_tpu.ops import pallas_kernels as PK
+        x = self._x((3, 5, 5, c), seed=c)
+        ref = F.lrn_forward(x)
+        got = PK.lrn_forward(x)
+        numpy.testing.assert_allclose(numpy.asarray(got),
+                                      numpy.asarray(ref),
+                                      rtol=2e-6, atol=2e-6)
+
+    @pytest.mark.parametrize("n", [4, 5])
+    def test_even_and_odd_window_match_xla(self, n):
+        """Even n has an ASYMMETRIC window in the XLA form (pad n//2 +
+        n shifted slices); the band must replicate it, values AND
+        grads — not the symmetric |i-j|<=n//2 approximation."""
+        from veles_tpu.ops import pallas_kernels as PK
+        x = self._x((2, 3, 3, 24), seed=n)
+        dy = self._x((2, 3, 3, 24), seed=n + 10)
+        ref, ref_vjp = jax.vjp(lambda a: F.lrn_forward(a, n=n), x)
+        got, got_vjp = jax.vjp(lambda a: PK.lrn_forward(a, n=n), x)
+        numpy.testing.assert_allclose(numpy.asarray(got),
+                                      numpy.asarray(ref),
+                                      rtol=2e-6, atol=2e-6)
+        numpy.testing.assert_allclose(numpy.asarray(got_vjp(dy)[0]),
+                                      numpy.asarray(ref_vjp(dy)[0]),
+                                      rtol=3e-5, atol=3e-6)
+
+    def test_gradient_matches_functional(self):
+        """The fused custom VJP ≡ jax autodiff of the XLA form."""
+        from veles_tpu.ops import pallas_kernels as PK
+        x = self._x((2, 4, 4, 32), seed=1, scale=2.0)
+        dy = self._x((2, 4, 4, 32), seed=2)
+
+        ref = jax.vjp(lambda a: F.lrn_forward(a, 2e-4, 0.7, 5, 1.5), x)[1](
+            dy)[0]
+        got = jax.vjp(lambda a: PK.lrn_forward(a, 2e-4, 0.7, 5, 1.5), x)[1](
+            dy)[0]
+        numpy.testing.assert_allclose(numpy.asarray(got),
+                                      numpy.asarray(ref),
+                                      rtol=3e-5, atol=3e-6)
+
+    def test_backend_flag_routes(self):
+        """set_lrn_backend('pallas') swaps the kernel into the DEFAULT
+        lrn path (what the norm unit calls) and back."""
+        x = self._x((2, 3, 3, 24), seed=3)
+        ref = numpy.asarray(F.lrn_forward(x))
+        F.set_lrn_backend("pallas")
+        try:
+            got = numpy.asarray(F.lrn_forward(x))
+        finally:
+            F.set_lrn_backend("xla")
+        numpy.testing.assert_allclose(got, ref, rtol=2e-6, atol=2e-6)
+        with pytest.raises(ValueError):
+            F.set_lrn_backend("nope")
+
+    def test_trains_under_jit(self):
+        """The custom-VJP kernel composes with jit + grad at AlexNet-LRN1
+        shape fragments (the path the fused step takes)."""
+        from veles_tpu.ops import pallas_kernels as PK
+        x = self._x((2, 6, 6, 96), seed=4)
+
+        @jax.jit
+        def loss(a):
+            return (PK.lrn_forward(a) ** 2).sum()
+        g = jax.grad(loss)(x)
+        assert numpy.isfinite(numpy.asarray(g)).all()

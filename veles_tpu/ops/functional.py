@@ -421,12 +421,33 @@ def stochastic_pooling(x, window=(2, 2), stride=None, rng=None, train=True,
 
 
 # ------------------------------------------------- local response norm (LRN)
+#: 'xla' = the shifted-slice form below (loop-fused elementwise chain);
+#: 'pallas' = the one-pass fused kernel with banded-matmul window sum and
+#: fused backward (ops/pallas_kernels.py::lrn_forward) — the top
+#: memory-bound item of the post-bf16 AlexNet step (docs/PERF.md).
+#: Benchmarked against each other by bench.py's lrn record; the default
+#: stays whichever wins on hardware.
+_LRN_BACKEND = "xla"
+
+
+def set_lrn_backend(mode):
+    """mode: 'xla' | 'pallas'.  Clears jit caches (trace-time flag)."""
+    global _LRN_BACKEND
+    if mode not in ("xla", "pallas"):
+        raise ValueError("unknown lrn backend %r" % (mode,))
+    _LRN_BACKEND = mode
+    jax.clear_caches()
+
+
 def lrn_forward(x, alpha=1e-4, beta=0.75, n=5, k=2.0):
     """AlexNet cross-channel local response normalization.
 
     y = x / (k + alpha/n * sum_{j in window(n)} x_j^2)^beta over the channel
     axis.  Ref: veles/znicz/normalization.py::LRNormalizerForward [H].
     """
+    if _LRN_BACKEND == "pallas":
+        from veles_tpu.ops import pallas_kernels as PK
+        return PK.lrn_forward(x, alpha, beta, n, k)
     c = x.shape[-1]
     sq = x * x
     half = n // 2

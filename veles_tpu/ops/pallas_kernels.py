@@ -91,6 +91,126 @@ def fused_sgd_update(param, velocity, grad, batch_size, learning_rate,
             new_v.reshape(-1)[:n].reshape(shape))
 
 
+# --------------------------------------------------------------- fused LRN
+# AlexNet cross-channel LRN is the top memory-bound item left in the
+# round-4 trace once convs go bf16 (docs/PERF.md: LRN fwd+bwd chains run
+# at ~350-460 GB/s because XLA's loop fusions re-read the activation
+# across the shifted-slice window sum).  One Pallas pass instead: read x
+# once, take the channel-window sum as a BANDED MATMUL on the MXU
+# (x² @ band, band[i,j] = |i-j| <= n//2 — a (C, C) 0/1 matrix), apply
+# the power elementwise, write y (+ the denominator, which the fused
+# backward reuses: dx = dy·d^-β − 2(α/n)β·x·((dy·x·d^(−β−1)) @ band)).
+
+
+def _lrn_band(c, n, dtype=jnp.float32):
+    """band[j, i] = 1 iff channel j is in i's window — defined to match
+    the XLA path EXACTLY: pad (n//2, n//2) + n shifted slices puts
+    window(i) = [i - n//2, i + n - 1 - n//2], which is asymmetric for
+    even n (symmetric |i-j| <= n//2 would silently change numerics
+    under set_lrn_backend).  The backward uses band.T (sum over j with
+    i in window(j))."""
+    j, i = jnp.meshgrid(jnp.arange(c), jnp.arange(c), indexing="ij")
+    off = j - i + n // 2
+    return ((off >= 0) & (off < n)).astype(dtype)
+
+
+def _lrn_fwd_kernel(x_ref, band_ref, y_ref, d_ref, *, alpha_n, beta, k):
+    x = x_ref[:]
+    s = jnp.dot(x * x, band_ref[:],
+                preferred_element_type=jnp.float32)
+    d = k + alpha_n * s
+    d_ref[:] = d
+    y_ref[:] = x * d ** -beta
+
+
+def _lrn_bwd_kernel(x_ref, d_ref, dy_ref, band_ref, dx_ref, *,
+                    alpha_n, beta):
+    x, d, dy = x_ref[:], d_ref[:], dy_ref[:]
+    dpow = d ** (-beta - 1.0)
+    inner = jnp.dot(dy * x * dpow, band_ref[:],
+                    preferred_element_type=jnp.float32)
+    dx_ref[:] = dy * (d * dpow) - (2.0 * alpha_n * beta) * x * inner
+
+
+def _lrn_call(kernel, arrays, band, out_n, block_rows=1024,
+              interpret=None, pad_values=None):
+    """Shared grid/padding plumbing: arrays are (M, C) operands; the
+    channel dim pads to the 128-lane tile, rows pad to the block.
+    ``pad_values`` gives the fill per operand — the denominator must pad
+    with 1.0, not 0.0, or its negative power is inf in the pad region
+    (inf·0 = NaN poisons nothing numerically but trips debug checks)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    m, c = arrays[0].shape
+    lanes = -(-c // 128) * 128
+    rows = -(-m // block_rows) * block_rows
+    if pad_values is None:
+        pad_values = [0.0] * len(arrays)
+
+    def prep(a, fill):
+        return jnp.pad(a, ((0, rows - m), (0, lanes - c)),
+                       constant_values=fill)
+
+    band_p = jnp.pad(band, ((0, lanes - c), (0, lanes - c)))
+    grid = (rows // block_rows,)
+    block = pl.BlockSpec((block_rows, lanes), lambda i: (i, 0))
+    whole = pl.BlockSpec((lanes, lanes), lambda i: (0, 0))
+    outs = pl.pallas_call(
+        kernel,
+        grid=grid,
+        out_shape=tuple(jax.ShapeDtypeStruct((rows, lanes), jnp.float32)
+                        for _ in range(out_n)),
+        in_specs=[block] * len(arrays) + [whole],
+        out_specs=tuple(block for _ in range(out_n)),
+        interpret=_interpret(interpret),
+    )(*[prep(a, f) for a, f in zip(arrays, pad_values)], band_p)
+    return tuple(o[:m, :c] for o in outs)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5))
+def lrn_forward(x, alpha=1e-4, beta=0.75, n=5, k=2.0, interpret=None):
+    """Cross-channel LRN as one fused Pallas pass (same semantics as
+    ``functional.lrn_forward``; ref: veles/znicz/normalization.py [H]).
+    Differentiable via a fused custom VJP — the backward is one kernel,
+    not XLA's re-derived slice chain."""
+    y, _ = _lrn_fwd(x, alpha, beta, n, k, interpret)
+    return y
+
+
+def _lrn_fwd(x, alpha, beta, n, k, interpret):
+    shape = x.shape
+    c = shape[-1]
+    x2 = x.reshape(-1, c).astype(jnp.float32)
+    kern = functools.partial(_lrn_fwd_kernel, alpha_n=alpha / n,
+                             beta=beta, k=k)
+    y, d = _lrn_call(kern, [x2], _lrn_band(c, n), 2,
+                     interpret=interpret)
+    # residuals must be jax types only (shape/dtype are recovered from
+    # the cotangent in the backward)
+    return y.reshape(shape).astype(x.dtype), (x2, d)
+
+
+def _lrn_fwd_vjp(x, alpha, beta, n, k, interpret):
+    y, res = _lrn_fwd(x, alpha, beta, n, k, interpret)
+    return y, res
+
+
+def _lrn_bwd_vjp(alpha, beta, n, k, interpret, res, dy):
+    x2, d = res
+    shape, dtype = dy.shape, dy.dtype
+    c = x2.shape[-1]
+    dy2 = dy.reshape(-1, c).astype(jnp.float32)
+    kern = functools.partial(_lrn_bwd_kernel, alpha_n=alpha / n,
+                             beta=beta)
+    (dx,) = _lrn_call(kern, [x2, d, dy2], _lrn_band(c, n).T, 1,
+                      interpret=interpret, pad_values=[0.0, 1.0, 0.0])
+    return (dx.reshape(shape).astype(dtype),)
+
+
+lrn_forward.defvjp(_lrn_fwd_vjp, _lrn_bwd_vjp)
+
+
 # -------------------------------------------------- dropout with counter RNG
 def _dropout_kernel(seed_ref, x_ref, out_ref, *, keep_threshold_i32,
                     inv_keep):
