@@ -9,6 +9,8 @@ time in print_stats.
 import glob
 import os
 
+import numpy
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -51,6 +53,23 @@ class TestLauncherProfile:
         step_time = wf._fused_runner.measure_device_step_time(iters=3)
         assert step_time is not None and 0.0 < step_time < 60.0
         wf.print_stats()  # must not raise with the device-time line
+
+    def test_stats_measurement_never_moves_weights(self, tmp_path):
+        """measure_device_step_time re-dispatches real train steps for
+        timing but must DISCARD their updates: the final weights after
+        the last epoch's metrics are recorded may not change because
+        stats were printed (VERDICT r4 weak #5 regression guard)."""
+        import jax
+        from veles_tpu.launcher import Launcher
+        wf = _build_tiny_mnist()
+        Launcher(wf, stats=False).boot()
+        runner = wf._fused_runner
+        before = jax.tree.map(numpy.array, runner.state)
+        runner.measure_device_step_time(iters=3)
+        wf.print_stats()
+        after = jax.tree.map(numpy.array, runner.state)
+        for a, b in zip(jax.tree.leaves(before), jax.tree.leaves(after)):
+            numpy.testing.assert_array_equal(a, b)
 
 
 def test_cli_serve_after_training(tmp_path):

@@ -188,7 +188,13 @@ class FusedRunner:
         the last dispatched batch ``iters`` times and ending the window in
         a value fetch (``block_until_ready`` does not block through the
         TPU tunnel).  None until a train step has run.  Feeds the
-        ``print_stats`` device-time line (SURVEY §5.1 profiling rebuild)."""
+        ``print_stats`` device-time line (SURVEY §5.1 profiling rebuild).
+
+        The timing dispatches REAL train steps but their updated state is
+        DISCARDED (``self._train`` does not donate and the result is never
+        assigned) — printing stats can never move the final weights;
+        pinned by tests/test_launcher.py::
+        test_stats_measurement_never_moves_weights."""
         import time
         import numpy
         import jax

@@ -110,8 +110,163 @@ class JSONBackend:
         return json.dumps(facts, indent=2, default=str)
 
 
+class ConfluenceBackend:
+    """Confluence storage-format page body (ref: veles/publishing/
+    confluence_backend [M]).  Renders the XHTML-based storage format a
+    Confluence ``/rest/api/content`` POST accepts; pair with
+    :func:`publish_confluence` to upload."""
+
+    suffix = ".confluence.xml"
+
+    def render(self, facts):
+        rows = ""
+        if facts["epochs"]:
+            keys = sorted({k for row in facts["epochs"] for k in row})
+            head = "".join("<th>%s</th>" % html.escape(k) for k in keys)
+            body = ""
+            for row in facts["epochs"]:
+                body += "<tr>" + "".join(
+                    "<td>%s</td>" % (("%.6g" % row[k])
+                                     if isinstance(row.get(k), float)
+                                     else row.get(k, "")) for k in keys) \
+                    + "</tr>"
+            rows = "<table><tbody><tr>%s</tr>%s</tbody></table>" % (
+                head, body)
+        return ("<h1>Training report: %(name)s</h1>"
+                "<p>class <code>%(cls)s</code> — generated %(at)s</p>"
+                "<p>best metric <strong>%(best)s</strong> at epoch "
+                "%(epoch)s</p>"
+                '<ac:structured-macro ac:name="code">'
+                "<ac:plain-text-body><![CDATA[units: %(units)s]]>"
+                "</ac:plain-text-body></ac:structured-macro>"
+                "%(rows)s") % {
+            "name": html.escape(str(facts["workflow"])),
+            "cls": html.escape(str(facts["workflow_class"])),
+            "at": facts["generated_at"],
+            "best": facts["best_metric"],
+            "epoch": facts["best_epoch"],
+            "units": ", ".join(facts["units"]),
+            "rows": rows,
+        }
+
+
+def publish_confluence(base_url, space_key, title, facts, auth=None):
+    """Create a Confluence page holding the report (the reference's
+    confluence upload flow, via the stable REST API instead of its
+    XML-RPC).  ``auth`` is a (user, token) pair for basic auth; returns
+    the decoded JSON response."""
+    import base64 as b64
+    import urllib.request
+    payload = {
+        "type": "page",
+        "title": title,
+        "space": {"key": space_key},
+        "body": {"storage": {
+            "value": ConfluenceBackend().render(facts),
+            "representation": "storage"}},
+    }
+    req = urllib.request.Request(
+        base_url.rstrip("/") + "/rest/api/content",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    if auth is not None:
+        req.add_header(
+            "Authorization", "Basic " + b64.b64encode(
+                ("%s:%s" % auth).encode()).decode("ascii"))
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return json.loads(resp.read())
+
+
+class PDFBackend:
+    """Print report via matplotlib PdfPages (ref: veles/publishing/
+    pdf_backend [M]): a summary page plus per-metric learning curves.
+
+    Chart choices follow the in-house dataviz method: change-over-time →
+    line marks; metrics of different scales never share an axis — each
+    metric gets its own small-multiple panel (single series, titled, so
+    no legend is needed); recessive grid, thin 2px lines."""
+
+    suffix = ".pdf"
+    binary = True
+
+    SURFACE = "#fcfcfb"
+    INK = "#0b0b0b"
+    INK2 = "#52514e"
+    SERIES = "#2a78d6"
+
+    def render(self, facts):
+        import io
+        import matplotlib
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+        from matplotlib.backends.backend_pdf import PdfPages
+
+        buf = io.BytesIO()
+        with PdfPages(buf) as pdf:
+            fig = plt.figure(figsize=(8.27, 11.69))   # A4 portrait
+            fig.patch.set_facecolor(self.SURFACE)
+            lines = [
+                ("Training report: %s" % facts["workflow"], 16, self.INK),
+                ("", 10, self.INK2),
+                ("class %s" % facts["workflow_class"], 10, self.INK2),
+                ("generated %s" % facts["generated_at"], 10, self.INK2),
+                ("best metric %s (epoch %s)"
+                 % (facts["best_metric"], facts["best_epoch"]), 11,
+                 self.INK),
+            ]
+            if facts["run_seconds"]:
+                lines.append(("run time %.1f s" % facts["run_seconds"],
+                              10, self.INK2))
+            lines.append(("units: %s" % ", ".join(facts["units"]), 9,
+                          self.INK2))
+            y = 0.93
+            for text, size, color in lines:
+                fig.text(0.08, y, text, fontsize=size, color=color,
+                         family="monospace", wrap=True)
+                y -= 0.035
+            pdf.savefig(fig)
+            plt.close(fig)
+
+            rows = facts["epochs"]
+            keys = sorted({k for row in rows for k in row
+                           if k != "epoch"}) if rows else []
+            if keys:
+                epochs = [row["epoch"] for row in rows]
+                ncols = 2
+                nrows = (len(keys) + ncols - 1) // ncols
+                fig, axes = plt.subplots(
+                    nrows, ncols, figsize=(8.27, 2.6 * nrows),
+                    squeeze=False)
+                fig.patch.set_facecolor(self.SURFACE)
+                for ax in axes.flat[len(keys):]:
+                    ax.axis("off")
+                for ax, key in zip(axes.flat, keys):
+                    ys = [row.get(key) for row in rows]
+                    xs = [e for e, v in zip(epochs, ys) if v is not None]
+                    ax.plot(xs, [v for v in ys if v is not None],
+                            color=self.SERIES, linewidth=2)
+                    ax.set_title(key, fontsize=9, color=self.INK,
+                                 family="monospace", loc="left")
+                    ax.set_xlabel("epoch", fontsize=8, color=self.INK2)
+                    from matplotlib.ticker import MaxNLocator
+                    ax.xaxis.set_major_locator(
+                        MaxNLocator(integer=True))
+                    ax.tick_params(labelsize=7, colors=self.INK2)
+                    ax.set_facecolor(self.SURFACE)
+                    ax.grid(True, color="#e4e3df", linewidth=0.6)
+                    for side in ("top", "right"):
+                        ax.spines[side].set_visible(False)
+                    for side in ("left", "bottom"):
+                        ax.spines[side].set_color(self.INK2)
+                fig.tight_layout()
+                pdf.savefig(fig)
+                plt.close(fig)
+        return buf.getvalue()
+
+
 BACKENDS = {"markdown": MarkdownBackend, "html": HTMLBackend,
-            "json": JSONBackend}
+            "json": JSONBackend, "pdf": PDFBackend,
+            "confluence": ConfluenceBackend}
 
 
 class Publisher:
@@ -128,7 +283,11 @@ class Publisher:
         for backend in self.backends:
             path = os.path.join(
                 out_dir, "report_%s%s" % (facts["workflow"], backend.suffix))
-            with open(path, "w", encoding="utf-8") as f:
-                f.write(backend.render(facts))
+            if getattr(backend, "binary", False):
+                with open(path, "wb") as f:
+                    f.write(backend.render(facts))
+            else:
+                with open(path, "w", encoding="utf-8") as f:
+                    f.write(backend.render(facts))
             paths.append(path)
         return paths
