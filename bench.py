@@ -885,6 +885,17 @@ def run_configs(wanted, args):
         bench_bf16_variant(
             "alexnet_bf16",
             lambda: build_alexnet(*sizes["alexnet"], **alex_kwargs))
+        # the full fast path: bf16 convs + the fused Pallas LRN — shown
+        # NEXT TO alexnet_bf16 so the LRN kernel's end-to-end effect is
+        # a diff between two records, win or lose (docs/PERF.md r5)
+        from veles_tpu.ops import functional as F
+        F.set_lrn_backend("pallas")
+        try:
+            bench_bf16_variant(
+                "alexnet_fast",
+                lambda: build_alexnet(*sizes["alexnet"], **alex_kwargs))
+        finally:
+            F.set_lrn_backend("xla")
 
     if "alexnet" in wanted:
         guarded("alexnet", _bench_alexnet)

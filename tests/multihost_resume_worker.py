@@ -1,0 +1,121 @@
+"""Worker for the multi-host snapshot/resume test (tests/test_multihost.py).
+
+Two processes form one 8-device CPU mesh (4 virtual devices each) and
+train MNIST-FC with a ShardedTrainer.  Three phases, selected by argv[4]:
+
+- ``full``    — train 2·K steps straight through; print the final digest.
+- ``first``   — train K steps, then process 0 publishes a snapshot of the
+  gathered global state (sync_to_runner → snapshot_state → atomic file);
+  both processes exit.
+- ``second``  — every process restores the SAME snapshot file into its
+  local runner, rebuilds the ShardedTrainer (whose init-digest guard
+  cross-checks the restored state), and trains the remaining K steps
+  continuing the step counter; print the final digest.
+
+The parent asserts digest(full) == digest(second) on every process —
+interrupt + restore across the mesh is bit-exact, the multi-host form of
+the single-process SIGKILL contract (SURVEY §5.3 downgrade note).
+"""
+
+import json
+import os
+import sys
+import zlib
+
+
+K = 3
+
+
+def digest(runner):
+    import jax
+    import numpy
+    return [zlib.crc32(numpy.ascontiguousarray(leaf).tobytes())
+            for leaf in jax.tree.leaves(
+                jax.tree.map(numpy.asarray, runner.state))]
+
+
+def build():
+    import numpy  # noqa: F401
+    from veles_tpu import prng
+    from veles_tpu.config import root
+    prng.reset()
+    prng.seed_all(1)
+    root.mnist.update({
+        "loader": {"minibatch_size": 32, "n_train": 128, "n_valid": 32},
+        "decision": {"max_epochs": 100, "fail_iterations": 50},
+        "layers": [
+            {"type": "all2all_tanh", "output_sample_shape": 16,
+             "learning_rate": 0.05, "momentum": 0.9},
+            {"type": "softmax", "output_sample_shape": 10,
+             "learning_rate": 0.05, "momentum": 0.9},
+        ],
+    })
+    from veles_tpu.samples import mnist
+    return mnist.build(fused=True)
+
+
+def train_steps(trainer, loader, steps, step0=0):
+    import numpy
+    from veles_tpu.loader.base import TRAIN
+    done = 0
+    while done < steps:
+        loader.run()
+        if loader.minibatch_class != TRAIN:
+            continue
+        trainer.train_step(
+            numpy.asarray(loader.minibatch_data.mem),
+            numpy.asarray(loader.minibatch_labels.mem),
+            numpy.asarray(loader.minibatch_mask.mem),
+            loader.minibatch_size, step=step0 + done)
+        done += 1
+
+
+def main(coordinator, num_processes, process_id, phase, snap_dir):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=4").strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    from veles_tpu import snapshotter
+    from veles_tpu.parallel import (ShardedTrainer, make_mesh,
+                                    spmd_loader_shard)
+
+    wf = build()
+    mesh = make_mesh(len(jax.devices()))
+    shard_idx, shard_cnt = spmd_loader_shard(mesh)
+    wf.loader.shard_spmd(shard_idx, shard_cnt)
+    wf.initialize()
+    snap_path = os.path.join(snap_dir, "mid.pickle.gz")
+
+    if phase == "second":
+        # every process restores the SAME published snapshot, THEN
+        # shards it — the trainer's init digest guard cross-checks
+        snapshotter.restore(wf, snap_path)
+        trainer = ShardedTrainer(wf._fused_runner, mesh)
+        train_steps(trainer, wf.loader, K, step0=K)
+        trainer.sync_to_runner()
+        print("DIGEST " + json.dumps(digest(wf._fused_runner)))
+        return
+
+    trainer = ShardedTrainer(wf._fused_runner, mesh)
+    train_steps(trainer, wf.loader, K)
+    if phase == "first":
+        trainer.sync_to_runner()
+        if jax.process_index() == 0:     # single-writer rule
+            snapshotter.save(wf, snap_path)
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("snapshot written")
+        print("SNAPSHOT OK")
+        return
+    assert phase == "full"
+    train_steps(trainer, wf.loader, K, step0=K)
+    trainer.sync_to_runner()
+    print("DIGEST " + json.dumps(digest(wf._fused_runner)))
+
+
+if __name__ == "__main__":
+    main(sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4],
+         sys.argv[5])

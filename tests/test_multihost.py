@@ -54,13 +54,14 @@ def _parse_metrics(stdout):
     raise AssertionError("no METRICS line in worker output:\n" + stdout)
 
 
-def _run_workers(mode):
+def _spawn_workers(script, extra_args):
+    """Launch 2 coordinated worker processes of ``script``; return their
+    stdouts (asserting rc=0), killing stragglers on the way out."""
     port = _free_port()
-    coordinator = "127.0.0.1:%d" % port
     procs = [
         subprocess.Popen(
-            [sys.executable, os.path.join(HERE, "multihost_worker.py"),
-             coordinator, "2", str(pid), mode],
+            [sys.executable, os.path.join(HERE, script),
+             "127.0.0.1:%d" % port, "2", str(pid)] + list(extra_args),
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
             env=_worker_env(), cwd=REPO)
         for pid in range(2)
@@ -72,13 +73,18 @@ def _run_workers(mode):
             assert p.returncode == 0, (
                 "worker failed rc=%d\nstdout:\n%s\nstderr:\n%s"
                 % (p.returncode, stdout, stderr[-4000:]))
-            outs.append(_parse_metrics(stdout))
+            outs.append(stdout)
     finally:
         for p in procs:
             if p.poll() is None:
                 p.kill()
                 p.wait()
     return outs
+
+
+def _run_workers(mode):
+    return [_parse_metrics(out)
+            for out in _spawn_workers("multihost_worker.py", [mode])]
 
 
 @functools.lru_cache(maxsize=1)
@@ -148,28 +154,38 @@ def test_two_process_divergent_init_detected():
     copies, so divergent init across processes must fail loudly at
     construction (digest cross-check, ADVICE r4) — not silently train a
     Frankenstein tensor."""
-    port = _free_port()
-    coordinator = "127.0.0.1:%d" % port
-    procs = [
-        subprocess.Popen(
-            [sys.executable, os.path.join(HERE, "multihost_worker.py"),
-             coordinator, "2", str(pid), "diverge"],
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-            env=_worker_env(), cwd=REPO)
-        for pid in range(2)
-    ]
-    try:
-        for p in procs:
-            stdout, stderr = p.communicate(timeout=300)
-            assert p.returncode == 0, (
-                "worker failed rc=%d\nstdout:\n%s\nstderr:\n%s"
-                % (p.returncode, stdout, stderr[-4000:]))
-            assert "DIVERGE-CAUGHT" in stdout, stdout
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-                p.wait()
+    for out in _spawn_workers("multihost_worker.py", ["diverge"]):
+        assert "DIVERGE-CAUGHT" in out, out
+
+
+def _run_resume_workers(phase, snap_dir):
+    return _spawn_workers("multihost_resume_worker.py", [phase, snap_dir])
+
+
+def _digests(outs):
+    got = []
+    for out in outs:
+        for line in out.splitlines():
+            if line.startswith("DIGEST "):
+                got.append(json.loads(line[len("DIGEST "):]))
+    return got
+
+
+def test_two_process_snapshot_resume_bit_exact(tmp_path):
+    """Interrupt + restore ACROSS THE MESH: a 2-process SPMD run
+    snapshotted at step K and resumed in fresh processes must reach the
+    bit-identical state of an uninterrupted 2-process run — the
+    multi-host form of the kill-and-resume contract (SURVEY §5.3)."""
+    full = _digests(_run_resume_workers("full", str(tmp_path)))
+    assert len(full) == 2 and full[0] == full[1]
+
+    outs = _run_resume_workers("first", str(tmp_path))
+    assert all("SNAPSHOT OK" in o for o in outs)
+    assert os.path.exists(os.path.join(str(tmp_path), "mid.pickle.gz"))
+
+    resumed = _digests(_run_resume_workers("second", str(tmp_path)))
+    assert len(resumed) == 2 and resumed[0] == resumed[1]
+    assert resumed[0] == full[0], "resumed run diverged from straight run"
 
 
 def test_spmd_loader_shard_single_process_collapses():

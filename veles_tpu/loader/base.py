@@ -110,23 +110,38 @@ class Loader(Unit):
         """Snapshot restore, shard-aware.
 
         Snapshots are written by process 0 only, so the captured
-        ``_shard``/``_spmd_shard`` (and the ``_order`` planned for them) are
-        process 0's.  Resuming with the SAME topology restores them
-        bit-exactly.  Resuming under a DIFFERENT shard identity (another
-        process of a distributed run, or a changed process count) keeps
-        THIS process's runtime identity — set by the launcher before
-        restore — and rebuilds the epoch plan for it; epoch_number and the
-        PRNG streams still come from the snapshot, so coverage is correct
-        but mid-epoch position is restarted (cross-topology resume cannot
-        be bit-exact).
+        ``_shard``/``_spmd_shard`` are process 0's.  Resuming with the
+        SAME topology restores the cursor bit-exactly.  Under a
+        DIFFERENT shard identity THIS process's runtime identity — set
+        by the launcher before restore — wins; what happens to the
+        cursor depends on the mode:
+
+        - SPMD (``shard_spmd``): the restored plan holds GLOBAL chunks
+          (sliced per shard only at run()), so it is valid verbatim for
+          every spmd shard — plan AND position survive, making
+          multi-host snapshot/resume bit-exact on all processes
+          (tests/test_multihost.py::test_two_process_snapshot_resume).
+        - index-striding (``shard``): the plan was built from
+          ``idx[pi::pc]`` and is genuinely shard-specific — rebuild it;
+          epoch_number and PRNG streams still come from the snapshot,
+          so coverage is correct but mid-epoch position restarts.
         """
         runtime = (self._shard, self._spmd_shard)
         super().load_state_dict(d)
         restored = (self._shard, self._spmd_shard)
         if restored != runtime:
+            spmd_only = (restored[0] == runtime[0]
+                         and restored[1] is not None
+                         and runtime[1] is not None
+                         # legacy snapshots stored process-0's LOCAL
+                         # slice; only a GLOBAL plan (full-width chunks)
+                         # is shard-portable — anything else rebuilds
+                         and all(len(chunk) == self.max_minibatch_size
+                                 for _, chunk, _ in self._order or ()))
             self._shard, self._spmd_shard = runtime
-            self._order = None
-            self._position = 0
+            if not spmd_only:
+                self._order = None
+                self._position = 0
 
     @property
     def local_minibatch_size(self):
@@ -135,6 +150,16 @@ class Loader(Unit):
         if self._spmd_shard is None:
             return self.max_minibatch_size
         return self.max_minibatch_size // self._spmd_shard[1]
+
+    def local_chunk(self, chunk):
+        """This process's contiguous slice of a GLOBAL plan chunk (the
+        identity when not SPMD-sharded) — the one place the plan's
+        global indices become local rows (run(), prefetch)."""
+        if self._spmd_shard is None:
+            return chunk
+        pi, pc = self._spmd_shard
+        local = self.max_minibatch_size // pc
+        return chunk[pi * local:(pi + 1) * local]
 
     @property
     def total_samples(self):
@@ -189,9 +214,11 @@ class Loader(Unit):
                     chunk = numpy.concatenate(
                         [chunk, numpy.full(mb - actual, chunk[0])])
                 chunk = chunk.astype(numpy.int32)
-                if spmd is not None:
-                    local = mb // spmd[1]
-                    chunk = chunk[spmd[0] * local:(spmd[0] + 1) * local]
+                # SPMD chunks stay GLOBAL in the plan and are sliced per
+                # shard at consumption (run()) — the plan is then
+                # shard-identity-independent, which is what lets a
+                # process-0 snapshot resume bit-exactly on EVERY process
+                # of a multi-host run (load_state_dict)
                 plan.append((cls, chunk, actual))
         self._order = plan
 
@@ -207,9 +234,11 @@ class Loader(Unit):
             mask = numpy.zeros(self.max_minibatch_size, numpy.float32)
             mask[:actual] = 1.0
         else:
-            # local slice of the global liveness mask
+            # the plan holds the GLOBAL chunk; take this shard's
+            # contiguous slice (and the local liveness mask) here
             pi, pc = self._spmd_shard
             local = self.max_minibatch_size // pc
+            indices = self.local_chunk(indices)
             rows = numpy.arange(pi * local, (pi + 1) * local)
             mask = (rows < actual).astype(numpy.float32)
         self.minibatch_mask.reset(mask)

@@ -150,8 +150,10 @@ class RecordsLoader(Loader):
             self.minibatch_labels.reset(labels)
         if self.prefetch and self._position < len(self._order):
             # stage the NEXT minibatch while the device computes this one
-            # (run() already advanced _position past the current entry)
-            nxt = self._order[self._position][1]
+            # (run() already advanced _position past the current entry;
+            # plan chunks are GLOBAL — prefetch this shard's slice, the
+            # same rows fill_minibatch will be handed)
+            nxt = self.local_chunk(self._order[self._position][1])
             self._pending = (nxt.tobytes(),
                              self._pool.submit(self._gather, nxt))
 

@@ -128,22 +128,8 @@ class SnapshotterBase(Unit):
 
     # -- payload -------------------------------------------------------------
     def payload(self):
-        wf = self.workflow
-        from veles_tpu.config import root
-        import veles_tpu
-        return {
-            "format": FORMAT,
-            "framework_version": veles_tpu.__version__,
-            "workflow_class": "%s.%s" % (type(wf).__module__,
-                                         type(wf).__name__),
-            "workflow_name": wf.name,
-            "epoch": int(getattr(self, "epoch_number", 0)),
-            "best_metric": getattr(
-                getattr(wf, "decision", None), "best_metric", None),
-            "time": time.time(),
-            "state": wf.snapshot_state(),
-            "config": root.as_dict(),
-        }
+        return build_payload(self.workflow,
+                             epoch=int(getattr(self, "epoch_number", 0)))
 
     def export(self):
         raise NotImplementedError
@@ -261,6 +247,46 @@ def import_(path):
         raise ValueError("unsupported snapshot format %r in %s" %
                          (payload.get("format"), path))
     return payload
+
+
+def build_payload(workflow, epoch=None):
+    """The one snapshot-payload builder (unit export AND one-shot
+    :func:`save` share it, so the fields can never drift).  ``epoch``
+    defaults to the loader's live counter."""
+    from veles_tpu.config import root
+    import veles_tpu
+    if epoch is None:
+        epoch = int(getattr(getattr(workflow, "loader", None),
+                            "epoch_number", 0))
+    return {
+        "format": FORMAT,
+        "framework_version": veles_tpu.__version__,
+        "workflow_class": "%s.%s" % (type(workflow).__module__,
+                                     type(workflow).__name__),
+        "workflow_name": workflow.name,
+        "epoch": int(epoch),
+        "best_metric": getattr(
+            getattr(workflow, "decision", None), "best_metric", None),
+        "time": time.time(),
+        "state": workflow.snapshot_state(),
+        "config": root.as_dict(),
+    }
+
+
+def save(workflow, path):
+    """One-shot snapshot of a built workflow to ``path`` (compression
+    sniffed from the suffix), atomically published — the module-level
+    counterpart of :func:`restore` for callers without a Snapshotter
+    unit in the graph (e.g. a distributed driver checkpointing between
+    phases)."""
+    suffix = path.rsplit(".", 1)[-1]
+    compression = suffix if suffix in ("gz", "bz2", "xz") else ""
+    payload = build_payload(workflow)
+    tmp = path + ".tmp"
+    with _open_for_suffix(tmp, compression) as f:
+        pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
+    os.replace(tmp, path)
+    return path
 
 
 def restore(workflow, path_or_payload):
