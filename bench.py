@@ -612,6 +612,102 @@ def bench_sgd_backends(n=4 * 1024 * 1024, iters=20, smoke=False):
     return record
 
 
+# ------------------------------------------------ native PJRT runner (C++)
+def bench_native_runner(smoke=False):
+    """End-to-end proof of the standalone C++ PJRT runner (libVeles
+    parity): train tiny MNIST on CPU, export a native bundle, run
+    native/artifact_runner against a PJRT plugin .so, and parity-check
+    its output against the in-framework forward.  The worker's own jax
+    is cpu-pinned by orchestrate(), so on hardware the C++ binary is the
+    tunnel's only client; off-hardware (or tunnel down) the record still
+    proves build+selfcheck and reports the execute error."""
+    import subprocess
+    import tempfile
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    nat_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "veles_tpu", "native")
+    record = {}
+    try:
+        subprocess.run(["make", "artifact_runner"], cwd=nat_dir,
+                       check=True, stdout=subprocess.PIPE,
+                       stderr=subprocess.STDOUT, timeout=180)
+    except Exception as e:   # noqa: BLE001 — recorded
+        return {"error": "build failed: %r" % (e,)}
+    runner_bin = os.path.join(nat_dir, "artifact_runner")
+
+    from veles_tpu.native import find_pjrt_plugin
+    plugin = find_pjrt_plugin()
+    if plugin is None:
+        return {"error": "no PJRT plugin .so found"}
+    record["plugin"] = plugin
+
+    out = subprocess.run([runner_bin, "--selfcheck", plugin],
+                         stdout=subprocess.PIPE, timeout=120)
+    record["selfcheck"] = ("ok" if b"SELFCHECK OK" in out.stdout
+                           else "failed rc=%d" % out.returncode)
+
+    from veles_tpu import export, prng
+    from veles_tpu.config import root
+    prng.reset()
+    prng.seed_all(1)
+    root.__dict__.pop("mnist", None)
+    root.mnist.update({
+        "loader": {"minibatch_size": 50, "n_train": 500, "n_valid": 100},
+        "decision": {"max_epochs": 1, "fail_iterations": 5},
+        "layers": [
+            {"type": "all2all_tanh", "output_sample_shape": 64,
+             "learning_rate": 0.03, "momentum": 0.9},
+            {"type": "softmax", "output_sample_shape": 10,
+             "learning_rate": 0.03, "momentum": 0.9},
+        ],
+    })
+    from veles_tpu.samples import mnist
+    wf = mnist.train()
+    if smoke:
+        # CI contract run: no device behind either plugin here, and a
+        # client-create against a dead tunnel can hang — prove build +
+        # selfcheck + export only (the TPU-marked test and the real
+        # bench run cover execute)
+        import tempfile as _tf
+        export.export_native_bundle(
+            wf, os.path.join(_tf.mkdtemp(prefix="native_smoke_"), "nb"),
+            batch=8)
+        record["execute"] = "skipped (smoke: no device)"
+        record["bundle_export"] = "ok"
+        return record
+    tmp = tempfile.mkdtemp(prefix="native_bench_")
+    bundle = export.export_native_bundle(wf, os.path.join(tmp, "nb"),
+                                         batch=8)
+    x = numpy.random.RandomState(0).uniform(
+        -1, 1, (8, 784)).astype(numpy.float32)
+    in_bin = os.path.join(tmp, "in.bin")
+    out_bin = os.path.join(tmp, "out.bin")
+    with open(in_bin, "wb") as f:
+        f.write(x.tobytes())
+    begin = time.perf_counter()
+    proc = subprocess.run([runner_bin, bundle, plugin, in_bin, out_bin],
+                          stdout=subprocess.PIPE,
+                          stderr=subprocess.STDOUT, timeout=600)
+    wall = time.perf_counter() - begin
+    tail = proc.stdout.decode(errors="replace")[-400:]
+    if proc.returncode != 0 or b"EXECUTE OK" not in proc.stdout:
+        record["execute"] = "failed: %s" % tail.strip()
+        return record
+    got = numpy.fromfile(out_bin, numpy.float32).reshape(8, -1)
+    want = numpy.asarray(wf._fused_runner.eval_forward()(
+        wf._fused_runner.state, x))
+    record.update({
+        "execute": "ok",
+        "compile_plus_infer_wall_s": round(wall, 2),
+        "max_abs_diff_vs_framework": float(numpy.abs(got - want).max()),
+        "parity": bool(numpy.allclose(got, want, rtol=1e-3, atol=1e-3)),
+    })
+    return record
+
+
 # --------------------------------------------------- lrn backend (XLA/Pallas)
 def bench_lrn_backends(iters=8, smoke=False):
     """XLA-vs-Pallas LRN comparison at the AlexNet-LRN1 train shape
@@ -763,7 +859,8 @@ def bench_numpy_floor(wf, min_seconds=3.0):
 
 
 KNOWN_CONFIGS = ("mnist", "cifar", "alexnet", "alexnet_records", "sgd",
-                 "lrn", "records", "convergence", "lm", "scaling")
+                 "lrn", "records", "convergence", "lm", "scaling",
+                 "native")
 #: "convergence" expands to one watchdog worker per sub-bench, so a hang
 #: in one (e.g. a tunnel death mid-compile) cannot discard the others
 CONVERGENCE_SUBS = ("kohonen", "mnist_fc", "cifar_conv",
@@ -1043,6 +1140,24 @@ def run_configs(wanted, args):
     if "lrn" in wanted:
         guarded("lrn", _bench_lrn)
 
+    def _bench_native():
+        if args.in_process and not args.smoke:
+            # bench_native_runner pins THIS process's jax to cpu (the
+            # tunnel must belong to the C++ client alone) — under
+            # --in-process that would poison sibling configs' device
+            # numbers or contend for the tunnel; the watchdog-worker
+            # path is the supported one
+            results["native_runner"] = {
+                "skipped": "needs its own worker process — run without "
+                           "--in-process"}
+            return
+        results["native_runner"] = bench_native_runner(smoke=args.smoke)
+        print("native_runner: %s" % results["native_runner"],
+              file=sys.stderr)
+
+    if "native" in wanted:
+        guarded("native", _bench_native)
+
     def _bench_recs():
         results["records_pipeline"] = bench_records(
             smoke=args.smoke, seconds=min(target, 4.0))
@@ -1092,6 +1207,15 @@ def emit_summary(results):
             "metric": "lrn_fwd_bwd_device_us",
             "value": results["lrn_fwd_bwd"].get("xla_us"),
             "unit": "us",
+            "vs_baseline": None,
+            "configs": results,
+        }))
+    elif "native_runner" in results:
+        print(json.dumps({
+            "metric": "native_runner_compile_plus_infer_wall_s",
+            "value": results["native_runner"].get(
+                "compile_plus_infer_wall_s"),
+            "unit": "s",
             "vs_baseline": None,
             "configs": results,
         }))
@@ -1192,9 +1316,16 @@ def orchestrate(wanted, args, argv):
             continue
         cmd = [sys.executable, os.path.abspath(__file__),
                "--worker", name] + argv
+        env = dict(os.environ)
+        if name == "native":
+            # the native config's ONLY tunnel client must be the C++
+            # runner: pin the worker's own jax to cpu so the in-process
+            # client never claims the (one-client-at-a-time) tunnel
+            env["JAX_PLATFORMS"] = "cpu"
+            env.pop("PALLAS_AXON_POOL_IPS", None)
         try:
             proc = subprocess.run(cmd, stdout=subprocess.PIPE,
-                                  timeout=per_config)
+                                  timeout=per_config, env=env)
             line = proc.stdout.decode(errors="replace").strip().splitlines()
             got = (json.loads(line[-1])["results"] if line
                    else {name + "_error":
@@ -1236,7 +1367,8 @@ def main():
                         help="tiny sizes on CPU for CI validation")
     parser.add_argument("--configs",
                         default="mnist,cifar,alexnet,alexnet_records,"
-                                "sgd,lrn,records,convergence,lm,scaling",
+                                "sgd,lrn,records,convergence,lm,scaling,"
+                                "native",
                         help="comma list: " + ",".join(KNOWN_CONFIGS))
     parser.add_argument("--seconds", type=float, default=None,
                         help="target seconds per timing window")

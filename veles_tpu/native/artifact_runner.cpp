@@ -1,0 +1,249 @@
+// Standalone native inference runner over the PJRT C API.
+//
+// The reference shipped libVeles/libZnicz: C++ engines executing exported
+// models without Python (SURVEY §2.4).  The TPU-native equivalent maps the
+// exported program onto the SAME runtime the framework trains with: this
+// binary dlopens a PJRT plugin (libtpu.so on TPU hosts, any PJRT plugin
+// elsewhere), compiles the bundle's StableHLO, and executes it — zero
+// Python, zero framework.
+//
+// Bundle layout (written by veles_tpu.export.export_native_bundle):
+//   program.mlir        StableHLO text; trained weights baked as constants
+//   compile_options.pb  serialized xla CompileOptionsProto (1 replica)
+//   manifest.json       shapes/dtypes (informational; input shape is also
+//                       embedded in the program signature)
+//
+// Usage:
+//   artifact_runner <bundle_dir> <plugin.so> [input.bin output.bin]
+//   artifact_runner --selfcheck <plugin.so>
+//
+// input.bin: raw little-endian f32 of the program's input shape;
+// output.bin: raw f32 written back.  --selfcheck only loads the plugin and
+// reports its PJRT API version (works without a device attached).
+//
+// pjrt_c_api.h is the public Apache-2.0 OpenXLA header, vendored verbatim
+// from the XLA distribution installed on this image (PJRT API v0.72); the
+// API is append-only versioned via struct_size, so close plugin versions
+// interoperate.
+
+#include <dlfcn.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include "pjrt_c_api.h"
+
+namespace {
+
+const PJRT_Api* g_api = nullptr;
+
+[[noreturn]] void die(const std::string& what) {
+  std::fprintf(stderr, "artifact_runner: %s\n", what.c_str());
+  std::exit(1);
+}
+
+void check(PJRT_Error* err, const char* op) {
+  if (err == nullptr) return;
+  PJRT_Error_Message_Args msg{};
+  msg.struct_size = PJRT_Error_Message_Args_STRUCT_SIZE;
+  msg.error = err;
+  g_api->PJRT_Error_Message(&msg);
+  std::string text(msg.message, msg.message_size);
+  PJRT_Error_Destroy_Args destroy{};
+  destroy.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+  destroy.error = err;
+  g_api->PJRT_Error_Destroy(&destroy);
+  die(std::string(op) + ": " + text);
+}
+
+void await(PJRT_Event* event, const char* op) {
+  PJRT_Event_Await_Args args{};
+  args.struct_size = PJRT_Event_Await_Args_STRUCT_SIZE;
+  args.event = event;
+  check(g_api->PJRT_Event_Await(&args), op);
+  PJRT_Event_Destroy_Args destroy{};
+  destroy.struct_size = PJRT_Event_Destroy_Args_STRUCT_SIZE;
+  destroy.event = event;
+  g_api->PJRT_Event_Destroy(&destroy);
+}
+
+std::string read_file(const std::string& path) {
+  std::ifstream in(path, std::ios::binary);
+  if (!in) die("cannot read " + path);
+  std::ostringstream buf;
+  buf << in.rdbuf();
+  return buf.str();
+}
+
+const PJRT_Api* load_plugin(const char* path) {
+  void* lib = dlopen(path, RTLD_NOW | RTLD_GLOBAL);
+  if (lib == nullptr) die(std::string("dlopen failed: ") + dlerror());
+  using GetPjrtApiFn = const PJRT_Api* (*)();
+  auto get = reinterpret_cast<GetPjrtApiFn>(dlsym(lib, "GetPjrtApi"));
+  if (get == nullptr) die("plugin exports no GetPjrtApi symbol");
+  const PJRT_Api* api = get();
+  if (api == nullptr) die("GetPjrtApi returned null");
+  return api;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    std::fprintf(stderr,
+                 "usage: %s <bundle_dir> <plugin.so> [in.bin out.bin]\n"
+                 "       %s --selfcheck <plugin.so>\n",
+                 argv[0], argv[0]);
+    return 2;
+  }
+  const bool selfcheck = std::strcmp(argv[1], "--selfcheck") == 0;
+  g_api = load_plugin(argv[2]);
+  std::printf("pjrt_api_version %d.%d (header %d.%d)\n",
+              g_api->pjrt_api_version.major_version,
+              g_api->pjrt_api_version.minor_version, PJRT_API_MAJOR,
+              PJRT_API_MINOR);
+  if (selfcheck) {
+    std::printf("SELFCHECK OK\n");
+    return 0;
+  }
+
+  PJRT_Plugin_Initialize_Args init{};
+  init.struct_size = PJRT_Plugin_Initialize_Args_STRUCT_SIZE;
+  check(g_api->PJRT_Plugin_Initialize(&init), "plugin initialize");
+
+  PJRT_Client_Create_Args create{};
+  create.struct_size = PJRT_Client_Create_Args_STRUCT_SIZE;
+  check(g_api->PJRT_Client_Create(&create), "client create");
+  PJRT_Client* client = create.client;
+
+  PJRT_Client_AddressableDevices_Args devs{};
+  devs.struct_size = PJRT_Client_AddressableDevices_Args_STRUCT_SIZE;
+  devs.client = client;
+  check(g_api->PJRT_Client_AddressableDevices(&devs),
+        "addressable devices");
+  if (devs.num_addressable_devices == 0) die("no addressable devices");
+  PJRT_Device* device = devs.addressable_devices[0];
+
+  const std::string bundle = argv[1];
+  std::string mlir = read_file(bundle + "/program.mlir");
+  std::string options = read_file(bundle + "/compile_options.pb");
+
+  PJRT_Program program{};
+  program.struct_size = PJRT_Program_STRUCT_SIZE;
+  program.code = mlir.data();
+  program.code_size = mlir.size();
+  program.format = "mlir";
+  program.format_size = 4;
+
+  PJRT_Client_Compile_Args compile{};
+  compile.struct_size = PJRT_Client_Compile_Args_STRUCT_SIZE;
+  compile.client = client;
+  compile.program = &program;
+  compile.compile_options = options.data();
+  compile.compile_options_size = options.size();
+  check(g_api->PJRT_Client_Compile(&compile), "compile");
+  PJRT_LoadedExecutable* executable = compile.executable;
+  std::printf("compiled %s/program.mlir (%zu bytes)\n", bundle.c_str(),
+              mlir.size());
+
+  if (argc < 5) {
+    std::printf("COMPILE OK (no input given)\n");
+    return 0;
+  }
+
+  // the runner's contract is one input, one output — verify instead of
+  // trusting the bundle (a multi-output program would otherwise make
+  // the plugin write past the 1-element output list below)
+  PJRT_Executable* raw_exec = nullptr;
+  {
+    PJRT_LoadedExecutable_GetExecutable_Args get{};
+    get.struct_size = PJRT_LoadedExecutable_GetExecutable_Args_STRUCT_SIZE;
+    get.loaded_executable = executable;
+    check(g_api->PJRT_LoadedExecutable_GetExecutable(&get),
+          "get executable");
+    raw_exec = get.executable;
+    PJRT_Executable_NumOutputs_Args num{};
+    num.struct_size = PJRT_Executable_NumOutputs_Args_STRUCT_SIZE;
+    num.executable = raw_exec;
+    check(g_api->PJRT_Executable_NumOutputs(&num), "num outputs");
+    if (num.num_outputs != 1)
+      die("program has " + std::to_string(num.num_outputs) +
+          " outputs; this runner serves single-output programs");
+  }
+
+  // ------------------------------------------------------------- input
+  // shape travels in a tiny sidecar so this binary needs no JSON parser:
+  // input.bin may be preceded by "input.shape" = ascii dims, else rank-1
+  std::string raw = read_file(argv[3]);
+  std::vector<int64_t> dims;
+  {
+    std::ifstream shp(bundle + "/input.shape");
+    int64_t d;
+    while (shp >> d) dims.push_back(d);
+    if (dims.empty()) dims.push_back((int64_t)(raw.size() / 4));
+  }
+  {
+    int64_t want = 4;  // f32 bytes
+    for (int64_t d : dims) want *= d;
+    if ((int64_t)raw.size() != want)
+      die("input size mismatch: " + std::string(argv[3]) + " has " +
+          std::to_string(raw.size()) + " bytes, input.shape needs " +
+          std::to_string(want));
+  }
+
+  PJRT_Client_BufferFromHostBuffer_Args h2d{};
+  h2d.struct_size = PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
+  h2d.client = client;
+  h2d.data = raw.data();
+  h2d.type = PJRT_Buffer_Type_F32;
+  h2d.dims = dims.data();
+  h2d.num_dims = dims.size();
+  h2d.host_buffer_semantics =
+      PJRT_HostBufferSemantics_kImmutableUntilTransferCompletes;
+  h2d.device = device;
+  check(g_api->PJRT_Client_BufferFromHostBuffer(&h2d), "host->device");
+  await(h2d.done_with_host_buffer, "h2d done");
+  PJRT_Buffer* input = h2d.buffer;
+
+  // ----------------------------------------------------------- execute
+  PJRT_ExecuteOptions exec_options{};
+  exec_options.struct_size = PJRT_ExecuteOptions_STRUCT_SIZE;
+  PJRT_Buffer* arg_list[] = {input};
+  PJRT_Buffer* const* arg_lists[] = {arg_list};
+  PJRT_Buffer* out_list[1] = {nullptr};
+  PJRT_Buffer** out_lists[] = {out_list};
+  PJRT_Event* done[1] = {nullptr};
+
+  PJRT_LoadedExecutable_Execute_Args exec{};
+  exec.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
+  exec.executable = executable;
+  exec.options = &exec_options;
+  exec.argument_lists = arg_lists;
+  exec.num_devices = 1;
+  exec.num_args = 1;
+  exec.output_lists = out_lists;
+  exec.device_complete_events = done;
+  check(g_api->PJRT_LoadedExecutable_Execute(&exec), "execute");
+  await(done[0], "execute done");
+
+  // ------------------------------------------------------------ output
+  PJRT_Buffer_ToHostBuffer_Args d2h{};
+  d2h.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
+  d2h.src = out_list[0];
+  check(g_api->PJRT_Buffer_ToHostBuffer(&d2h), "query output size");
+  std::vector<char> out(d2h.dst_size);
+  d2h.dst = out.data();
+  check(g_api->PJRT_Buffer_ToHostBuffer(&d2h), "device->host");
+  await(d2h.event, "d2h done");
+
+  std::ofstream of(argv[4], std::ios::binary);
+  of.write(out.data(), (std::streamsize)out.size());
+  of.close();
+  std::printf("EXECUTE OK: wrote %zu bytes to %s\n", out.size(), argv[4]);
+  return 0;
+}
