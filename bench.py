@@ -515,6 +515,32 @@ def bench_lm(smoke=False, iters=None):
     rec["decode_tokens_per_sec"] = round(dec_mb / per_tok, 1)
     rec["decode_ms_per_token"] = round(per_tok * 1e3, 3)
     rec["decode_batch"] = dec_mb
+
+    # GQA serving lever: same model shape with 1 kv head — the decode
+    # delta vs the record above is what grouped-query attention buys
+    # (smaller cache reads per token) on this hardware
+    gqa_host = init_transformer_params(prng.get("init"), vocab, d, heads,
+                                       layers, max_len=seq + 1,
+                                       n_kv_heads=1, rope=True)
+    gqa_params = jax.tree.map(jnp.asarray, gqa_host)
+
+    def gqa_decode_time(n):
+        out = generate(gqa_params, dprompt, n, heads, temperature=0,
+                       max_len=cache_len, rope=True)
+        _sync(out)
+        best = float("inf")
+        for _ in range(3):
+            begin = time.perf_counter()
+            _sync(generate(gqa_params, dprompt, n, heads, temperature=0,
+                           max_len=cache_len, rope=True))
+            best = min(best, time.perf_counter() - begin)
+        return best
+
+    gqa_per_tok = (gqa_decode_time(n_long) - gqa_decode_time(n_short)) \
+        / (n_long - n_short)
+    rec["decode_tokens_per_sec_gqa1_rope"] = round(dec_mb / gqa_per_tok,
+                                                   1)
+    rec["gqa_decode_speedup"] = round(per_tok / gqa_per_tok, 2)
     return rec
 
 

@@ -25,22 +25,55 @@ from veles_tpu.ops.functional import matmul
 NEG_INF = -1e30
 
 
-def attention(q, k, v, causal=False, bias=None):
+def attention(q, k, v, causal=False, bias=None, window=None):
     """Dense scaled-dot-product attention.
 
     q, k, v: (..., heads, seq, head_dim) — returns the same shape as q.
+    ``window=W`` additionally restricts each query to the last W keys
+    (sliding-window attention — O(seq·W) effective context, the
+    long-context serving trade that bounds KV-cache reads); windowed
+    attention is a CAUSAL concept here and requires causal=True (a
+    lookback bound with unbounded lookahead is never what anyone means).
     """
+    if window and not causal:
+        raise ValueError("window requires causal=True")
     dh = q.shape[-1]
     scores = matmul(q, jnp.swapaxes(k, -1, -2)) / jnp.sqrt(
         jnp.asarray(dh, q.dtype))
     if bias is not None:
         scores = scores + bias
-    if causal:
+    if causal or window:
         s_q, s_k = scores.shape[-2], scores.shape[-1]
-        mask = jnp.tril(jnp.ones((s_q, s_k), bool), s_k - s_q)
+        q_pos = jnp.arange(s_q)[:, None] + (s_k - s_q)
+        k_pos = jnp.arange(s_k)[None, :]
+        mask = jnp.ones((s_q, s_k), bool)
+        if causal:
+            mask &= q_pos >= k_pos
+        if window:
+            mask &= q_pos - k_pos < window
         scores = jnp.where(mask, scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
     return matmul(probs, v)
+
+
+# ----------------------------------------------------------------- rotary
+def rope_rotate(x, positions, theta=10000.0):
+    """Rotary position embedding over (..., seq, head_dim).
+
+    Rotates feature pairs (i, i + head_dim/2) — the half-split ("NeoX")
+    layout, NOT the GPT-J interleaved even/odd pairing — by
+    position-dependent angles — relative positions enter attention through the q·k product
+    itself, so no learned positional table is needed and decode caches
+    hold PRE-rotated keys (each position's rotation is final).
+    ``positions``: (seq,) int array (traced ok)."""
+    dh = x.shape[-1]
+    half = dh // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=x.dtype) / half)
+    ang = positions.astype(x.dtype)[:, None] * freqs[None, :]  # (s, half)
+    cos, sin = jnp.cos(ang), jnp.sin(ang)
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([x1 * cos - x2 * sin,
+                            x2 * cos + x1 * sin], axis=-1)
 
 
 def _online_update(carry, q, k, v, score_bias):
@@ -63,11 +96,18 @@ def _online_update(carry, q, k, v, score_bias):
     return o_new, l_new, m_new
 
 
-def blockwise_attention(q, k, v, block_size=128, causal=False):
+def blockwise_attention(q, k, v, block_size=128, causal=False,
+                        window=None):
     """Flash-style attention: scan over key/value blocks with the online
     softmax — numerically equal to ``attention`` but O(block) live memory,
     so sequence length is bounded by HBM, not by the seq² score matrix.
+    ``window`` composes (sliding-window mask inside each block; NEG_INF
+    is FINITE, so fully-masked early blocks contribute transient terms
+    that the online rescale zeroes once a live block arrives — every
+    causal query has at least itself live).
     """
+    if window and not causal:
+        raise ValueError("window requires causal=True")
     *lead, s_q, dh = q.shape
     s_k = k.shape[-2]
     if s_k % block_size:
@@ -85,8 +125,11 @@ def blockwise_attention(q, k, v, block_size=128, causal=False):
         i, kb_i, vb_i = blk
         bias = None
         if causal:
-            k_pos = i * block_size + jnp.arange(block_size)
-            allowed = q_pos[:, None] + (s_k - s_q) >= k_pos[None, :]
+            k_pos = (i * block_size + jnp.arange(block_size))[None, :]
+            abs_q = q_pos[:, None] + (s_k - s_q)
+            allowed = abs_q >= k_pos
+            if window:
+                allowed &= abs_q - k_pos < window
             bias = jnp.where(allowed, 0.0, NEG_INF).astype(q.dtype)
         return _online_update(carry, q, kb_i, vb_i, bias), None
 
@@ -99,70 +142,117 @@ def blockwise_attention(q, k, v, block_size=128, causal=False):
 
 
 # ------------------------------------------------------------ MHA as layer
-def init_mha_params(stream, d_model, n_heads, dtype="float32"):
-    """Param pytree for one multi-head attention layer (wq/wk/wv/wo)."""
+def init_mha_params(stream, d_model, n_heads, dtype="float32",
+                    n_kv_heads=None):
+    """Param pytree for one multi-head attention layer (wq/wk/wv/wo).
+
+    ``n_kv_heads < n_heads`` makes it grouped-query attention: wk/wv
+    project to only n_kv_heads·head_dim features, shrinking BOTH the
+    projection weights and the decode KV cache by the group factor (the
+    long-context serving memory lever); must divide n_heads."""
     import numpy
+    kv = n_kv_heads or n_heads
+    if n_heads % kv:
+        raise ValueError("n_kv_heads %d must divide n_heads %d"
+                         % (kv, n_heads))
+    d_kv = d_model // n_heads * kv
     s = (6.0 / (2 * d_model)) ** 0.5
 
-    def mk():
-        w = numpy.zeros((d_model, d_model), dtype)
+    def mk(n_out=d_model):
+        w = numpy.zeros((d_model, n_out), dtype)
         stream.fill(w, -s, s)
         return w
 
-    return {"wq": mk(), "wk": mk(), "wv": mk(), "wo": mk()}
+    return {"wq": mk(), "wk": mk(d_kv), "wv": mk(d_kv), "wo": mk()}
+
+
+def kv_heads_of(params, n_heads, d_model):
+    """Number of key/value heads, inferred from wk's width (GQA-aware)."""
+    return params["wk"].shape[-1] // (d_model // n_heads)
+
+
+def _repeat_kv(k, n_heads):
+    """Broadcast n_kv_heads → n_heads along the head axis (GQA share)."""
+    reps = n_heads // k.shape[-3]
+    return k if reps == 1 else jnp.repeat(k, reps, axis=-3)
 
 
 def mha_forward(params, x, n_heads, causal=True, block_size=None,
-                return_kv=False):
+                return_kv=False, rope=False, window=None,
+                positions=None):
     """Multi-head attention over (batch, seq, d_model).
 
     ``return_kv=True`` additionally returns the projected (k, v) heads
     — the prefill half of KV-cached decoding (autoregressive serving
-    writes them into the cache once instead of recomputing per token).
-    """
+    writes them into the cache once instead of recomputing per token;
+    under GQA those are the n_kv_heads, i.e. the smaller cache).
+    ``rope`` rotates q/k (``positions`` defaults to 0..s-1); ``window``
+    restricts attention to the last W positions."""
     b, s, d = x.shape
     dh = d // n_heads
+    kv = kv_heads_of(params, n_heads, d)
 
-    def split(w):
-        return matmul(x, w).reshape(b, s, n_heads, dh).transpose(0, 2, 1, 3)
+    def split(w, heads):
+        return matmul(x, w).reshape(b, s, heads, dh).transpose(0, 2, 1, 3)
 
-    q, k, v = split(params["wq"]), split(params["wk"]), split(params["wv"])
+    q = split(params["wq"], n_heads)
+    k = split(params["wk"], kv)
+    v = split(params["wv"], kv)
+    if rope:
+        pos = positions if positions is not None else jnp.arange(s)
+        q, k = rope_rotate(q, pos), rope_rotate(k, pos)
+    kr, vr = _repeat_kv(k, n_heads), _repeat_kv(v, n_heads)
     if block_size:
-        o = blockwise_attention(q, k, v, block_size, causal=causal)
+        o = blockwise_attention(q, kr, vr, block_size, causal=causal,
+                                window=window)
     else:
-        o = attention(q, k, v, causal=causal)
+        o = attention(q, kr, vr, causal=causal, window=window)
     o = o.transpose(0, 2, 1, 3).reshape(b, s, d)
     out = matmul(o, params["wo"])
     return (out, k, v) if return_kv else out
 
 
-def mha_decode_step(params, x, k_cache, v_cache, pos, n_heads):
+def mha_decode_step(params, x, k_cache, v_cache, pos, n_heads,
+                    rope=False, window=None):
     """One autoregressive decode step with a KV cache.
 
     x: (batch, 1, d_model) — the current position's activations;
-    k_cache/v_cache: (batch, heads, max_len, head_dim) with positions
+    k_cache/v_cache: (batch, kv_heads, max_len, head_dim) with positions
     [0, pos) filled; ``pos`` is a traced scalar.  Returns
     (out (batch, 1, d_model), k_cache, v_cache) with position ``pos``
     written.  The O(seq) attention against the cache replaces the
     O(seq²) full recompute per generated token — the standard serving
     path on TPU (static cache shape, dynamic_update_slice, no growing
-    arrays under jit).
+    arrays under jit).  GQA caches hold the n_kv_heads only; ``rope``
+    rotates the new q/k at ``pos`` (cached keys are pre-rotated);
+    ``window`` masks cache entries older than W positions.
     """
     b, _, d = x.shape
     dh = d // n_heads
+    kv = kv_heads_of(params, n_heads, d)
 
-    def split(w):
-        return matmul(x, w).reshape(b, 1, n_heads, dh).transpose(0, 2, 1, 3)
+    def split(w, heads):
+        return matmul(x, w).reshape(b, 1, heads, dh).transpose(0, 2, 1, 3)
 
-    q = split(params["wq"])                     # (b, h, 1, dh)
+    q = split(params["wq"], n_heads)            # (b, h, 1, dh)
+    k_new = split(params["wk"], kv)
+    if rope:
+        pos_arr = jnp.asarray(pos)[None]
+        q = rope_rotate(q, pos_arr)
+        k_new = rope_rotate(k_new, pos_arr)
     k_cache = jax.lax.dynamic_update_slice(
-        k_cache, split(params["wk"]), (0, 0, pos, 0))
+        k_cache, k_new, (0, 0, pos, 0))
     v_cache = jax.lax.dynamic_update_slice(
-        v_cache, split(params["wv"]), (0, 0, pos, 0))
-    scores = matmul(q, jnp.swapaxes(k_cache, -1, -2)) / jnp.sqrt(
+        v_cache, split(params["wv"], kv), (0, 0, pos, 0))
+    scores = matmul(q, jnp.swapaxes(_repeat_kv(k_cache, n_heads),
+                                    -1, -2)) / jnp.sqrt(
         jnp.asarray(dh, q.dtype))               # (b, h, 1, max_len)
-    live = jnp.arange(k_cache.shape[2]) <= pos
+    idx = jnp.arange(k_cache.shape[2])
+    live = idx <= pos
+    if window:
+        live &= idx > pos - window
     scores = jnp.where(live[None, None, None, :], scores, NEG_INF)
-    o = matmul(jax.nn.softmax(scores, axis=-1), v_cache)
+    o = matmul(jax.nn.softmax(scores, axis=-1),
+               _repeat_kv(v_cache, n_heads))
     o = o.transpose(0, 2, 1, 3).reshape(b, 1, d)
     return matmul(o, params["wo"]), k_cache, v_cache

@@ -25,10 +25,14 @@ from veles_tpu.ops.decision import DecisionBase
 
 def init_transformer_params(stream, vocab, d_model=64, n_heads=4,
                             n_layers=2, d_ff=None, max_len=512,
-                            dtype="float32", n_experts=0):
+                            dtype="float32", n_experts=0,
+                            n_kv_heads=None, rope=False):
     """``n_experts > 0`` replaces every block's dense FFN with a
     top-1-routed mixture of experts (ops/moe.py) — expert weights carry
-    an expert-major leading axis, shardable over an 'expert' mesh axis."""
+    an expert-major leading axis, shardable over an 'expert' mesh axis.
+    ``n_kv_heads`` < n_heads makes attention grouped-query (smaller
+    KV projections and decode caches); ``rope=True`` drops the learned
+    positional table entirely — positions enter via rotary q/k."""
     d_ff = d_ff or 4 * d_model
     s_emb = d_model ** -0.5
 
@@ -40,12 +44,15 @@ def init_transformer_params(stream, vocab, d_model=64, n_heads=4,
 
     embed = numpy.zeros((vocab, d_model), dtype)
     stream.fill_normal(embed, 0.0, s_emb)
-    pos = numpy.zeros((max_len, d_model), dtype)
-    stream.fill_normal(pos, 0.0, s_emb)
+    pos = None
+    if not rope:
+        pos = numpy.zeros((max_len, d_model), dtype)
+        stream.fill_normal(pos, 0.0, s_emb)
     blocks = []
     for _ in range(n_layers):
         blk = {
-            "attn": init_mha_params(stream, d_model, n_heads, dtype),
+            "attn": init_mha_params(stream, d_model, n_heads, dtype,
+                                    n_kv_heads=n_kv_heads),
             "ln1": {"g": numpy.ones(d_model, dtype),
                     "b": numpy.zeros(d_model, dtype)},
             "ln2": {"g": numpy.ones(d_model, dtype),
@@ -63,9 +70,12 @@ def init_transformer_params(stream, vocab, d_model=64, n_heads=4,
                 "b2": numpy.zeros(d_model, dtype),
             })
         blocks.append(blk)
-    return {"embed": embed, "pos": pos, "blocks": blocks,
-            "ln_f": {"g": numpy.ones(d_model, dtype),
-                     "b": numpy.zeros(d_model, dtype)}}
+    out = {"embed": embed, "blocks": blocks,
+           "ln_f": {"g": numpy.ones(d_model, dtype),
+                    "b": numpy.zeros(d_model, dtype)}}
+    if pos is not None:
+        out["pos"] = pos
+    return out
 
 
 def _layernorm(x, g, b, eps=1e-5):
@@ -76,7 +86,8 @@ def _layernorm(x, g, b, eps=1e-5):
 
 
 def block_forward(blk, h, n_heads, block_size=None, attn_fn=None,
-                  with_aux=False, token_mask=None):
+                  with_aux=False, token_mask=None, rope=False,
+                  window=None):
     """One decoder block (pre-LN attention + FFN with residuals) — shared
     by the sequential forward and the pipeline-parallel stage runner
     (veles_tpu.parallel.pipeline).  A block carrying ``moe`` params uses
@@ -84,11 +95,18 @@ def block_forward(blk, h, n_heads, block_size=None, attn_fn=None,
     returns (h, moe_load_balancing_loss) (0 for dense blocks;
     ``token_mask`` keeps padded rows out of the router statistics)."""
     hn = _layernorm(h, blk["ln1"]["g"], blk["ln1"]["b"])
-    if attn_fn is not None:
+    if attn_fn is not None:    # injected attention (ring SP)
+        if rope or window:
+            # the injected path never rotates q/k or masks the window —
+            # running a RoPE model through it would silently drop ALL
+            # positional signal (rope params have no pos table)
+            raise ValueError("rope/window are not supported with an "
+                             "injected attn_fn (ring attention)")
         h = h + attn_fn(blk["attn"], hn)
     else:
         h = h + mha_forward(blk["attn"], hn, n_heads, causal=True,
-                            block_size=block_size)
+                            block_size=block_size, rope=rope,
+                            window=window)
     hn = _layernorm(h, blk["ln2"]["g"], blk["ln2"]["b"])
     if "moe" in blk and with_aux:
         from veles_tpu.ops.moe import moe_ffn
@@ -111,11 +129,15 @@ def _block_ffn(blk, hn):
 
 
 def embed_tokens(params, tokens):
-    """Token + positional embedding — the pre-block-stack half, shared by
-    the sequential forward and the pipeline-parallel path."""
+    """Token (+ learned positional, absent under RoPE) embedding — the
+    pre-block-stack half, shared by the sequential forward and the
+    pipeline-parallel path."""
     import jax.numpy as jnp
     s = tokens.shape[1]
-    return jnp.take(params["embed"], tokens, axis=0) + params["pos"][:s]
+    h = jnp.take(params["embed"], tokens, axis=0)
+    if "pos" in params:
+        h = h + params["pos"][:s]
+    return h
 
 
 def head_logits(params, h):
@@ -137,17 +159,18 @@ def nll_from_hidden(params, h, targets, mask):
 
 
 def transformer_forward(params, tokens, n_heads, block_size=None,
-                        attn_fn=None):
+                        attn_fn=None, rope=False, window=None):
     """Logits (batch, seq, vocab); ``attn_fn(q_input)`` optionally replaces
     the attention call (ring attention injection point)."""
     h = embed_tokens(params, tokens)
     for blk in params["blocks"]:
-        h = block_forward(blk, h, n_heads, block_size, attn_fn)
+        h = block_forward(blk, h, n_heads, block_size, attn_fn,
+                          rope=rope, window=window)
     return head_logits(params, h)
 
 
 def lm_loss(params, tokens, mask, n_heads, block_size=None,
-            moe_aux_coef=0.0, remat=False):
+            moe_aux_coef=0.0, remat=False, rope=False, window=None):
     """Mean next-token cross-entropy (masked rows excluded).
 
     ``moe_aux_coef > 0`` adds the mean per-MoE-block load-balancing loss
@@ -174,12 +197,13 @@ def lm_loss(params, tokens, mask, n_heads, block_size=None,
         if moe_aux_coef and "moe" in blk:
             h, aux = wrap(lambda b, x: block_forward(
                 b, x, n_heads, block_size, with_aux=True,
-                token_mask=token_mask))(blk, h)
+                token_mask=token_mask, rope=rope, window=window))(blk, h)
             aux_total = aux_total + aux
             n_moe += 1
         else:
             h = wrap(lambda b, x: block_forward(
-                b, x, n_heads, block_size))(blk, h)
+                b, x, n_heads, block_size, rope=rope,
+                window=window))(blk, h)
     loss = nll_from_hidden(params, h, tokens[:, 1:], mask)
     if n_moe:
         loss = loss + moe_aux_coef * aux_total / n_moe
@@ -187,9 +211,10 @@ def lm_loss(params, tokens, mask, n_heads, block_size=None,
 
 
 # ---------------------------------------------------------------- serving
-def prefill(params, tokens, n_heads, max_len):
+def prefill(params, tokens, n_heads, max_len, rope=False, window=None):
     """Run the prompt through the stack once, capturing each block's
-    projected K/V heads into fixed-shape caches.
+    projected K/V heads into fixed-shape caches (n_kv_heads-wide under
+    GQA — the smaller cache is the point).
 
     Returns (h (b, s, d) block-stack activations, caches) where caches
     is a per-block list of (k, v) arrays shaped
@@ -208,7 +233,8 @@ def prefill(params, tokens, n_heads, max_len):
 
         def attn_capture(p, hn, captured=captured):
             out, k, v = mha_forward(p, hn, n_heads, causal=True,
-                                    return_kv=True)
+                                    return_kv=True, rope=rope,
+                                    window=window)
             captured["kv"] = (k, v)
             return out
 
@@ -218,22 +244,25 @@ def prefill(params, tokens, n_heads, max_len):
     return h, caches
 
 
-def block_decode_step(blk, h, k_cache, v_cache, pos, n_heads):
+def block_decode_step(blk, h, k_cache, v_cache, pos, n_heads,
+                      rope=False, window=None):
     """One block over ONE position against its KV cache (decode path)."""
     from veles_tpu.ops.attention import mha_decode_step
     hn = _layernorm(h, blk["ln1"]["g"], blk["ln1"]["b"])
     attn, k_cache, v_cache = mha_decode_step(blk["attn"], hn, k_cache,
-                                             v_cache, pos, n_heads)
+                                             v_cache, pos, n_heads,
+                                             rope=rope, window=window)
     h = h + attn
     hn = _layernorm(h, blk["ln2"]["g"], blk["ln2"]["b"])
     return h + _block_ffn(blk, hn), k_cache, v_cache
 
 
 def _generate_impl(params, prompt, rng, temperature, true_len, n_new,
-                   n_heads, greedy, max_len, top_k):
+                   n_heads, greedy, max_len, top_k, rope, window):
     import jax
     import jax.numpy as jnp
-    h, caches = prefill(params, prompt, n_heads, max_len)
+    h, caches = prefill(params, prompt, n_heads, max_len, rope=rope,
+                        window=window)
     # ``true_len`` is TRACED: the prompt may be right-padded to a bucket
     # length so servers compile one program per bucket, not per exact
     # prompt length.  Under causal attention every position < true_len is
@@ -270,12 +299,14 @@ def _generate_impl(params, prompt, rng, temperature, true_len, n_new,
         key, sub = next_key(key)
         tok = sample(logits, sub)
         pos = true_len + i
-        x = (jnp.take(params["embed"], tok, axis=0)[:, None, :]
-             + jax.lax.dynamic_slice_in_dim(params["pos"], pos, 1,
-                                            axis=0)[None])
+        x = jnp.take(params["embed"], tok, axis=0)[:, None, :]
+        if "pos" in params:
+            x = x + jax.lax.dynamic_slice_in_dim(params["pos"], pos, 1,
+                                                 axis=0)[None]
         new_caches = []
         for blk, (kc, vc) in zip(params["blocks"], caches):
-            x, kc, vc = block_decode_step(blk, x, kc, vc, pos, n_heads)
+            x, kc, vc = block_decode_step(blk, x, kc, vc, pos, n_heads,
+                                          rope=rope, window=window)
             new_caches.append((kc, vc))
         logits = head_logits(params, x)[:, 0, :]
         return (new_caches, logits, key), tok
@@ -299,7 +330,8 @@ NEG_INF_LOGIT = -1e30
 
 
 def generate(params, prompt, n_new, n_heads, rng=None, temperature=1.0,
-             max_len=None, top_k=None, true_len=None):
+             max_len=None, top_k=None, true_len=None, rope=False,
+             window=None):
     """Autoregressive sampling with a KV cache, fully under jit.
 
     prompt: (batch, s) int32; returns (batch, s + n_new) int32.
@@ -335,7 +367,7 @@ def generate(params, prompt, n_new, n_heads, rng=None, temperature=1.0,
     if start + n_new > max_len:
         raise ValueError("prompt + n_new = %d exceeds max_len %d"
                          % (start + n_new, max_len))
-    if max_len > params["pos"].shape[0]:
+    if "pos" in params and max_len > params["pos"].shape[0]:
         raise ValueError("max_len %d exceeds the positional table (%d)"
                          % (max_len, params["pos"].shape[0]))
     greedy = not temperature
@@ -348,12 +380,12 @@ def generate(params, prompt, n_new, n_heads, rng=None, temperature=1.0,
         _GENERATE_JIT = jax.jit(
             _generate_impl,
             static_argnames=("n_new", "n_heads", "greedy", "max_len",
-                             "top_k"))
+                             "top_k", "rope", "window"))
     return _GENERATE_JIT(params, prompt, None if greedy else rng,
                          jnp.asarray(temperature or 1.0, jnp.float32),
                          jnp.asarray(start, jnp.int32),
                          n_new=n_new, n_heads=n_heads, greedy=greedy,
-                         max_len=max_len,
+                         max_len=max_len, rope=rope, window=window,
                          # greedy never reads top_k — null it so distinct
                          # values cannot fork identical compiles
                          top_k=None if greedy else top_k)
@@ -368,7 +400,8 @@ def trainer_sample_tokens(trainer, prompt, n_new=32, temperature=0.0,
     marshals params to the portable per-layer form (works on pipelined
     trainers too) and runs the KV-cached ``generate``.  Pass ``params``
     to reuse an already-marshalled tree (servers marshal once, not per
-    request); ``max_len`` pins the cache shape across calls."""
+    request); ``max_len`` pins the cache shape across calls.  RoPE and
+    sliding-window settings follow the trainer's own configuration."""
     import jax
     import jax.numpy as jnp
     if params is None:
@@ -379,7 +412,10 @@ def trainer_sample_tokens(trainer, prompt, n_new=32, temperature=0.0,
                                   n_new, trainer.n_heads, rng=rng,
                                   temperature=temperature,
                                   max_len=max_len, top_k=top_k,
-                                  true_len=true_len))
+                                  true_len=true_len,
+                                  rope=getattr(trainer, "rope", False),
+                                  window=getattr(trainer, "window",
+                                                 None)))
 
 
 def make_adam_train_step(loss_fn, learning_rate, beta1=0.9, beta2=0.999,
@@ -420,11 +456,24 @@ class TransformerTrainer(AcceleratedUnit):
                  n_layers=2, max_len=512, learning_rate=1e-3,
                  block_size=None, beta1=0.9, beta2=0.999, eps=1e-8,
                  n_experts=0, moe_aux_coef=1e-2, pipeline_stages=0,
-                 pipeline_microbatches=4, remat=False, **kwargs):
+                 pipeline_microbatches=4, remat=False, n_kv_heads=None,
+                 rope=False, window=None, **kwargs):
         super().__init__(workflow, **kwargs)
         self.vocab = vocab
         self.d_model = d_model
         self.n_heads = n_heads
+        #: grouped-query attention: kv heads < query heads shrink the
+        #: KV projections AND the decode cache by the group factor
+        self.n_kv_heads = n_kv_heads
+        #: rotary positions (no learned pos table; relative positions)
+        self.rope = rope
+        #: sliding-window attention: each token sees the last W only
+        self.window = window
+        if pipeline_stages > 0 and (rope or window):
+            raise ValueError(
+                "rope/window are not threaded through the pipeline "
+                "stage scan yet — use the sequential path "
+                "(pipeline_stages=0) for these options")
         self.n_layers = n_layers
         self.max_len = max_len
         self.learning_rate = learning_rate
@@ -519,7 +568,8 @@ class TransformerTrainer(AcceleratedUnit):
                 if training and self.n_experts > 0 else 0.0)
         return lambda params, tokens, mask: lm_loss(
             params, tokens, mask, self.n_heads, self.block_size,
-            moe_aux_coef=coef, remat=self.remat)
+            moe_aux_coef=coef, remat=self.remat, rope=self.rope,
+            window=self.window)
 
     def initialize(self, device=None, **kwargs):
         import jax
@@ -530,7 +580,8 @@ class TransformerTrainer(AcceleratedUnit):
             host = init_transformer_params(
                 prng_mod.get("init"), self.vocab, self.d_model,
                 self.n_heads, self.n_layers, max_len=self.max_len,
-                n_experts=self.n_experts)
+                n_experts=self.n_experts, n_kv_heads=self.n_kv_heads,
+                rope=self.rope)
             self.params = jax.tree.map(jnp.asarray, host)
             if self.pipeline_stages > 0:
                 from veles_tpu.parallel.pipeline import stack_blocks
