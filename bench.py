@@ -487,6 +487,21 @@ def bench_lm(smoke=False, iters=None):
     rec["tokens_per_sec_remat"] = round(toks / remat_s, 1)
     rec["remat_overhead_pct"] = round(100.0 * (remat_s / step_s - 1.0), 1)
 
+    # attention-backend comparison: the bundled TPU Pallas flash kernel
+    # vs XLA's fused attention on the SAME train step (TPU only — the
+    # kernel has no CPU lowering); the winner would keep the default
+    if jax.default_backend() == "tpu":
+        from veles_tpu.ops import attention as A
+        A.set_attention_backend("flash_pallas")
+        try:
+            flash_s = measure(remat=False)
+            rec["tokens_per_sec_flash_pallas"] = round(toks / flash_s, 1)
+            rec["flash_vs_xla_speedup"] = round(step_s / flash_s, 2)
+        except Exception as exc:   # noqa: BLE001 — recorded, not fatal
+            rec["flash_pallas_error"] = repr(exc)[-300:]
+        finally:
+            A.set_attention_backend("xla")
+
     # serving side: KV-cached greedy decode throughput.  generate() is
     # one jit call (prefill + scan); both timings PIN the same max_len
     # (cache shape) so the n_long-vs-n_short subtraction isolates step

@@ -143,3 +143,38 @@ class TestRingAttention:
         numpy.testing.assert_allclose(numpy.asarray(g),
                                       numpy.asarray(g_dense),
                                       rtol=1e-3, atol=1e-4)
+
+
+class TestFlashPallasBackend:
+    """The bundled TPU Pallas flash-attention kernel as an opt-in
+    backend (attention.set_attention_backend)."""
+
+    def test_backend_flag_validates(self):
+        from veles_tpu.ops import attention as A
+        with pytest.raises(ValueError):
+            A.set_attention_backend("nope")
+        A.set_attention_backend("xla")   # restore-is-default no-op
+
+    def test_off_tpu_is_a_loud_error(self):
+        """No silent fallback: off-TPU the kernel must refuse, not
+        quietly compute something else."""
+        from veles_tpu.ops import attention as A
+        if jax.default_backend() == "tpu":
+            pytest.skip("on-TPU: covered by the parity test")
+        q = jnp.zeros((1, 2, 128, 64), jnp.float32)
+        with pytest.raises(RuntimeError, match="TPU"):
+            A.flash_attention_tpu(q, q, q)
+
+    @pytest.mark.skipif(jax.default_backend() != "tpu",
+                        reason="the bundled kernel has no CPU lowering")
+    def test_matches_xla_attention_on_tpu(self):
+        from veles_tpu.ops import attention as A
+        key = jax.random.PRNGKey(0)
+        q = jax.random.normal(key, (2, 4, 256, 64), jnp.float32)
+        k = jax.random.normal(jax.random.fold_in(key, 1), q.shape)
+        v = jax.random.normal(jax.random.fold_in(key, 2), q.shape)
+        ref = A.attention(q, k, v, causal=True)
+        got = A.flash_attention_tpu(q, k, v, causal=True)
+        numpy.testing.assert_allclose(numpy.asarray(got),
+                                      numpy.asarray(ref),
+                                      rtol=2e-3, atol=2e-3)

@@ -141,6 +141,39 @@ def blockwise_attention(q, k, v, block_size=128, causal=False,
     return o / l[..., None]
 
 
+def flash_attention_tpu(q, k, v, causal=True):
+    """The official TPU Pallas flash-attention kernel (bundled with jax)
+    as a drop-in for ``attention``: (b, h, s, dh) in/out, our scaling
+    convention (1/√dh) applied via sm_scale.  TPU-only — the kernel has
+    no interpret-mode escape hatch, so off-TPU callers get a loud error
+    instead of a silent fallback."""
+    if jax.default_backend() != "tpu":
+        raise RuntimeError("flash_attention_tpu needs a TPU backend "
+                           "(the bundled Pallas kernel has no CPU "
+                           "lowering); use attention/blockwise_attention")
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        flash_attention)
+    dh = q.shape[-1]
+    return flash_attention(q, k, v, causal=causal,
+                           sm_scale=float(1.0 / (dh ** 0.5)))
+
+
+#: attention backend for mha_forward's non-windowed causal path:
+#: 'xla' (dense or our blockwise scan) | 'flash_pallas' (the bundled
+#: TPU Pallas kernel above).  Benchmarked by bench.py's lm config on
+#: hardware; the default stays whichever wins there.
+_ATTN_BACKEND = "xla"
+
+
+def set_attention_backend(mode):
+    """mode: 'xla' | 'flash_pallas'.  Clears jit caches (trace-time)."""
+    global _ATTN_BACKEND
+    if mode not in ("xla", "flash_pallas"):
+        raise ValueError("unknown attention backend %r" % (mode,))
+    _ATTN_BACKEND = mode
+    jax.clear_caches()
+
+
 # ------------------------------------------------------------ MHA as layer
 def init_mha_params(stream, d_model, n_heads, dtype="float32",
                     n_kv_heads=None):
@@ -202,7 +235,9 @@ def mha_forward(params, x, n_heads, causal=True, block_size=None,
         pos = positions if positions is not None else jnp.arange(s)
         q, k = rope_rotate(q, pos), rope_rotate(k, pos)
     kr, vr = _repeat_kv(k, n_heads), _repeat_kv(v, n_heads)
-    if block_size:
+    if _ATTN_BACKEND == "flash_pallas" and not window:
+        o = flash_attention_tpu(q, kr, vr, causal=causal)
+    elif block_size:
         o = blockwise_attention(q, kr, vr, block_size, causal=causal,
                                 window=window)
     else:
