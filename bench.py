@@ -490,7 +490,13 @@ def bench_lm(smoke=False, iters=None):
     # attention-backend comparison: the bundled TPU Pallas flash kernel
     # vs XLA's fused attention on the SAME train step (TPU only — the
     # kernel has no CPU lowering); the winner would keep the default
-    if jax.default_backend() == "tpu":
+    if jax.default_backend() != "tpu":
+        pass                                  # kernel has no CPU lowering
+    elif seq % 128:
+        # the bundled kernel's default blocks are 128-wide; a short
+        # smoke sequence is "not applicable", not "kernel broke"
+        rec["flash_pallas_skipped"] = "seq %d not divisible by 128" % seq
+    else:
         from veles_tpu.ops import attention as A
         A.set_attention_backend("flash_pallas")
         try:

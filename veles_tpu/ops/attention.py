@@ -166,10 +166,14 @@ _ATTN_BACKEND = "xla"
 
 
 def set_attention_backend(mode):
-    """mode: 'xla' | 'flash_pallas'.  Clears jit caches (trace-time)."""
+    """mode: 'xla' | 'flash_pallas'.  Clears jit caches (trace-time
+    flag) — but only on an actual change, so a restore-to-current no-op
+    doesn't wipe every compiled function in the process."""
     global _ATTN_BACKEND
     if mode not in ("xla", "flash_pallas"):
         raise ValueError("unknown attention backend %r" % (mode,))
+    if mode == _ATTN_BACKEND:
+        return
     _ATTN_BACKEND = mode
     jax.clear_caches()
 
