@@ -181,6 +181,18 @@ def epoch_plan_arrays(loader, wanted_cls=None):
     return numpy.stack(idx), numpy.stack(mask)
 
 
+def best_time(fn, reps=3):
+    """Best-of-``reps`` wall time of ``fn()``, each run ended by a value
+    FETCH (see _sync) — the shared core of every K-vs-1 microbench."""
+    best = float("inf")
+    for _ in range(reps):
+        begin = time.perf_counter()
+        out = fn()
+        _sync(out)
+        best = min(best, time.perf_counter() - begin)
+    return best
+
+
 def timed_window(dispatch, target_seconds, initial=1):
     """Grow the work window until it dominates the fetch round-trip:
     ``dispatch(n, start)`` issues ``n`` work units beginning at offset
@@ -461,15 +473,8 @@ def bench_lm(smoke=False, iters=None):
 
         f1, fk = chain(1), chain(1 + iters)
         _sync(f1(params, opt)); _sync(fk(params, opt))    # compile
-        times = []
-        for fn in (f1, fk):
-            best = float("inf")
-            for _ in range(3):
-                begin = time.perf_counter()
-                _sync(fn(params, opt))
-                best = min(best, time.perf_counter() - begin)
-            times.append(best)
-        return (times[1] - times[0]) / iters
+        return (best_time(lambda: fk(params, opt))
+                - best_time(lambda: f1(params, opt))) / iters
 
     step_s = measure(remat=False)
     toks = mb * seq
@@ -520,16 +525,10 @@ def bench_lm(smoke=False, iters=None):
     cache_len = 8 + n_long
 
     def decode_time(n):
-        out = generate(params, dprompt, n, heads, temperature=0,
-                       max_len=cache_len)
-        _sync(out)   # compile
-        best = float("inf")
-        for _ in range(3):
-            begin = time.perf_counter()
-            _sync(generate(params, dprompt, n, heads, temperature=0,
-                           max_len=cache_len))
-            best = min(best, time.perf_counter() - begin)
-        return best
+        run = lambda: generate(params, dprompt, n, heads, temperature=0,
+                               max_len=cache_len)
+        _sync(run())   # compile
+        return best_time(run)
 
     per_tok = (decode_time(n_long) - decode_time(n_short)) \
         / (n_long - n_short)
@@ -546,16 +545,11 @@ def bench_lm(smoke=False, iters=None):
     gqa_params = jax.tree.map(jnp.asarray, gqa_host)
 
     def gqa_decode_time(n):
-        out = generate(gqa_params, dprompt, n, heads, temperature=0,
-                       max_len=cache_len, rope=True)
-        _sync(out)
-        best = float("inf")
-        for _ in range(3):
-            begin = time.perf_counter()
-            _sync(generate(gqa_params, dprompt, n, heads, temperature=0,
-                           max_len=cache_len, rope=True))
-            best = min(best, time.perf_counter() - begin)
-        return best
+        run = lambda: generate(gqa_params, dprompt, n, heads,
+                               temperature=0, max_len=cache_len,
+                               rope=True)
+        _sync(run())   # compile
+        return best_time(run)
 
     gqa_per_tok = (gqa_decode_time(n_long) - gqa_decode_time(n_short)) \
         / (n_long - n_short)
@@ -640,17 +634,9 @@ def bench_sgd_backends(n=4 * 1024 * 1024, iters=20, smoke=False):
             f1 = jax.jit(lambda p, v, g: chain(p, v, g, 1))
             fk = jax.jit(lambda p, v, g: chain(p, v, g, 1 + iters))
             _sync(f1(p0, v0, g0)); _sync(fk(p0, v0, g0))  # compile
-            times = []
-            for fn in (f1, fk):
-                best = float("inf")
-                for _ in range(3):
-                    begin = time.perf_counter()
-                    out = fn(p0, v0, g0)
-                    _sync(out)
-                    best = min(best, time.perf_counter() - begin)
-                times.append(best)
             record[backend + "_us"] = round(
-                (times[1] - times[0]) / iters * 1e6, 2)
+                (best_time(lambda: fk(p0, v0, g0))
+                 - best_time(lambda: f1(p0, v0, g0))) / iters * 1e6, 2)
         finally:
             F.set_sgd_backend("xla")
     if "xla_us" in record and "pallas_us" in record:
@@ -787,17 +773,9 @@ def bench_lrn_backends(iters=8, smoke=False):
             f1 = jax.jit(lambda x, dy: fwd_bwd(x, dy, 1))
             fk = jax.jit(lambda x, dy: fwd_bwd(x, dy, 1 + iters))
             _sync(f1(x0, dy0)); _sync(fk(x0, dy0))       # compile
-            times = []
-            for fn in (f1, fk):
-                best = float("inf")
-                for _ in range(3):
-                    begin = time.perf_counter()
-                    out = fn(x0, dy0)
-                    _sync(out)
-                    best = min(best, time.perf_counter() - begin)
-                times.append(best)
             record[backend + "_us"] = round(
-                (times[1] - times[0]) / iters * 1e6, 2)
+                (best_time(lambda: fk(x0, dy0))
+                 - best_time(lambda: f1(x0, dy0))) / iters * 1e6, 2)
         finally:
             F.set_lrn_backend("xla")
     if "xla_us" in record and "pallas_us" in record:

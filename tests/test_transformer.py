@@ -561,6 +561,22 @@ class TestLongContextOptions:
         out = char_lm.sample_tokens(wf, [[1, 2, 3]], n_new=5)
         assert out.shape == (1, 8)
 
+    def test_bucketed_prompt_bit_exact_with_rope_gqa(self):
+        """Serving composes prompt BUCKETING (traced true_len) with
+        RoPE+GQA: right-padded decode must equal the unpadded decode —
+        pad keys are rotated at pad positions but masked/overwritten,
+        so rotation of dead slots can never leak in."""
+        params = self._params(rope=True, n_kv_heads=2)
+        prompt = jnp.asarray([[1, 2, 3, 4, 5]], jnp.int32)
+        plain = numpy.asarray(T.generate(
+            params, prompt, n_new=6, n_heads=4, temperature=0,
+            max_len=16, rope=True))
+        padded = jnp.pad(prompt, ((0, 0), (0, 3)))
+        bucketed = numpy.asarray(T.generate(
+            params, padded, n_new=6, n_heads=4, temperature=0,
+            max_len=16, rope=True, true_len=5))
+        numpy.testing.assert_array_equal(plain[:, 5:], bucketed[:, 8:])
+
     def test_pipeline_rejects_rope_window(self):
         from veles_tpu.workflow import Workflow
         wf = Workflow(None, name="w")

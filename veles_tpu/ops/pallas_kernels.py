@@ -140,7 +140,6 @@ def _lrn_call(kernel, arrays, band, out_n, block_rows=1024,
     with 1.0, not 0.0, or its negative power is inf in the pad region
     (inf·0 = NaN poisons nothing numerically but trips debug checks)."""
     from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
 
     m, c = arrays[0].shape
     lanes = -(-c // 128) * 128

@@ -348,13 +348,10 @@ class StatusReporter(Unit):
             complete=bool(decision.complete),
             metrics=metrics)
         if not self._graph_pushed:
-            units = list(wf._units)
-            ids = {u: i for i, u in enumerate(units)}
-            fields.update(
-                graph_nodes=[u.name for u in units],
-                graph_edges=[[ids[u], ids[s]] for u in units
-                             for s in u.links_to if s in ids],
-                graph_dot=wf.generate_graph())
+            nodes, edges = wf.graph_data()
+            fields.update(graph_nodes=nodes,
+                          graph_edges=[list(e) for e in edges],
+                          graph_dot=wf.generate_graph())
             self._graph_pushed = True
         if self.report_url is not None:
             # best-effort: a dashboard outage or network blip must never

@@ -193,20 +193,28 @@ class Workflow(Unit):
             # release the pinned minibatch (HBM) once measured
             runner._last_train_args = None
 
+    def graph_data(self):
+        """(node_labels, edge_index_pairs) of the unit graph — the one
+        structural source both the dot renderer below and the web-status
+        SVG view consume."""
+        units = list(self._units)
+        ids = {u: i for i, u in enumerate(units)}
+        edges = [(ids[u], ids[s]) for u in units
+                 for s in u.links_to if s in ids]
+        return [u.name for u in units], edges
+
     def generate_graph(self, filename=None):
         """Render the unit graph as graphviz dot text.
 
         Ref: veles/workflow.py::Workflow.generate_graph [M] — used by docs
         and the web status view.
         """
+        nodes, edges = self.graph_data()
         lines = ["digraph %s {" % self.name.replace(" ", "_")]
-        ids = {unit: "u%d" % i for i, unit in enumerate(self._units)}
-        for unit, uid in ids.items():
-            lines.append('  %s [label="%s"];' % (uid, unit.name))
-        for unit, uid in ids.items():
-            for succ in unit.links_to:
-                if succ in ids:
-                    lines.append("  %s -> %s;" % (uid, ids[succ]))
+        for i, label in enumerate(nodes):
+            lines.append('  u%d [label="%s"];' % (i, label))
+        for src, dst in edges:
+            lines.append("  u%d -> u%d;" % (src, dst))
         lines.append("}")
         text = "\n".join(lines)
         if filename:
