@@ -178,3 +178,49 @@ class TestFlashPallasBackend:
         numpy.testing.assert_allclose(numpy.asarray(got),
                                       numpy.asarray(ref),
                                       rtol=2e-3, atol=2e-3)
+
+
+class TestWindowedRingAttention:
+    """Sliding window composes with sequence-parallel ring attention:
+    positions are global, so the band crosses shard borders exactly."""
+
+    @pytest.mark.parametrize("window", [1, 5, 12, 999])
+    def test_matches_dense_windowed(self, window):
+        from veles_tpu.ops.attention import attention
+        from veles_tpu.parallel.ring import make_seq_mesh, ring_attention
+        mesh = make_seq_mesh(4, devices=jax.devices("cpu")[:4])
+        key = jax.random.PRNGKey(0)
+        # s_local = 8 => window=5 stays in-shard for some queries and
+        # crosses the border for others; 12 always crosses; 999 ≡ causal
+        q = jax.random.normal(key, (2, 2, 32, 8), jnp.float32)
+        k = jax.random.normal(jax.random.fold_in(key, 1), q.shape)
+        v = jax.random.normal(jax.random.fold_in(key, 2), q.shape)
+        ref = attention(q, k, v, causal=True, window=window)
+        got = ring_attention(q, k, v, mesh, causal=True, window=window)
+        numpy.testing.assert_allclose(numpy.asarray(got),
+                                      numpy.asarray(ref),
+                                      rtol=1e-4, atol=1e-5)
+
+    def test_window_requires_causal(self):
+        from veles_tpu.parallel.ring import make_seq_mesh, ring_attention
+        mesh = make_seq_mesh(2, devices=jax.devices("cpu")[:2])
+        q = jnp.zeros((1, 1, 8, 4), jnp.float32)
+        with pytest.raises(ValueError, match="causal"):
+            ring_attention(q, q, q, mesh, causal=False, window=2)
+
+
+@pytest.mark.parametrize("window", [1, 3, 10, 999])
+def test_blockwise_windowed_matches_dense(window):
+    """Flash-style blockwise + sliding window ≡ dense windowed (incl.
+    fully-masked EARLY blocks, whose transient terms the online rescale
+    must zero — the finite-NEG_INF subtlety)."""
+    from veles_tpu.ops.attention import attention, blockwise_attention
+    key = jax.random.PRNGKey(5)
+    q = jax.random.normal(key, (2, 2, 32, 8), jnp.float32)
+    k = jax.random.normal(jax.random.fold_in(key, 1), q.shape)
+    v = jax.random.normal(jax.random.fold_in(key, 2), q.shape)
+    ref = attention(q, k, v, causal=True, window=window)
+    got = blockwise_attention(q, k, v, block_size=8, causal=True,
+                              window=window)
+    numpy.testing.assert_allclose(numpy.asarray(got), numpy.asarray(ref),
+                                  rtol=1e-4, atol=1e-5)

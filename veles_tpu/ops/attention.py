@@ -44,14 +44,9 @@ def attention(q, k, v, causal=False, bias=None, window=None):
         scores = scores + bias
     if causal or window:
         s_q, s_k = scores.shape[-2], scores.shape[-1]
-        q_pos = jnp.arange(s_q)[:, None] + (s_k - s_q)
-        k_pos = jnp.arange(s_k)[None, :]
-        mask = jnp.ones((s_q, s_k), bool)
-        if causal:
-            mask &= q_pos >= k_pos
-        if window:
-            mask &= q_pos - k_pos < window
-        scores = jnp.where(mask, scores, NEG_INF)
+        scores = scores + band_bias(jnp.arange(s_q) + (s_k - s_q),
+                                    jnp.arange(s_k), causal, window,
+                                    scores.dtype)
     probs = jax.nn.softmax(scores, axis=-1)
     return matmul(probs, v)
 
@@ -74,6 +69,18 @@ def rope_rotate(x, positions, theta=10000.0):
     x1, x2 = x[..., :half], x[..., half:]
     return jnp.concatenate([x1 * cos - x2 * sin,
                             x2 * cos + x1 * sin], axis=-1)
+
+
+def band_bias(q_pos, k_pos, causal, window, dtype):
+    """Additive score bias for the global-position causal/sliding-window
+    band — THE shared mask the dense, blockwise and ring decompositions
+    all apply, so a semantics change lands in one place."""
+    allowed = jnp.ones((q_pos.shape[0], k_pos.shape[0]), bool)
+    if causal:
+        allowed &= q_pos[:, None] >= k_pos[None, :]
+    if window:
+        allowed &= q_pos[:, None] - k_pos[None, :] < window
+    return jnp.where(allowed, 0.0, NEG_INF).astype(dtype)
 
 
 def _online_update(carry, q, k, v, score_bias):
@@ -125,12 +132,9 @@ def blockwise_attention(q, k, v, block_size=128, causal=False,
         i, kb_i, vb_i = blk
         bias = None
         if causal:
-            k_pos = (i * block_size + jnp.arange(block_size))[None, :]
-            abs_q = q_pos[:, None] + (s_k - s_q)
-            allowed = abs_q >= k_pos
-            if window:
-                allowed &= abs_q - k_pos < window
-            bias = jnp.where(allowed, 0.0, NEG_INF).astype(q.dtype)
+            bias = band_bias(q_pos + (s_k - s_q),
+                             i * block_size + jnp.arange(block_size),
+                             causal, window, q.dtype)
         return _online_update(carry, q, kb_i, vb_i, bias), None
 
     o0 = jnp.zeros_like(q)

@@ -19,7 +19,8 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from veles_tpu.ops.attention import _online_update, NEG_INF
+from veles_tpu.ops.attention import (_online_update,
+                                     band_bias, NEG_INF)
 
 
 def make_seq_mesh(n_devices=None, data_parallel=1, devices=None):
@@ -36,7 +37,7 @@ def make_seq_mesh(n_devices=None, data_parallel=1, devices=None):
     return Mesh(grid, ("data", "seq"))
 
 
-def _ring_attention_local(q, k, v, axis_name, causal):
+def _ring_attention_local(q, k, v, axis_name, causal, window=None):
     """Per-shard body (runs under shard_map): q/k/v are the LOCAL sequence
     blocks (batch, heads, s_local, dh)."""
     n = jax.lax.psum(1, axis_name)
@@ -52,9 +53,14 @@ def _ring_attention_local(q, k, v, axis_name, causal):
         src = (my_index - step) % n
         bias = None
         if causal:
-            k_pos = src * s_local + jnp.arange(s_local)
-            allowed = q_pos[:, None] >= k_pos[None, :]
-            bias = jnp.where(allowed, 0.0, NEG_INF).astype(q.dtype)
+            # the shared global-position band (attention.band_bias):
+            # the window just masks across shard borders.  Step 0 is
+            # the own block (every query sees itself), so the online
+            # max is finite before any fully-masked distant block
+            # arrives — same transient-safety argument as
+            # blockwise_attention.
+            bias = band_bias(q_pos, src * s_local + jnp.arange(s_local),
+                             causal, window, q.dtype)
         o_l_m = _online_update(o_l_m, q, k_blk, v_blk, bias)
         # rotate kv around the ring for the next step (ICI neighbor copy)
         kv = jax.tree.map(
@@ -73,20 +79,25 @@ def _ring_attention_local(q, k, v, axis_name, causal):
 
 
 def ring_attention(q, k, v, mesh, causal=True, seq_axis="seq",
-                   data_axis="data"):
+                   data_axis="data", window=None):
     """Sequence-parallel attention over ``mesh``.
 
     q, k, v: (batch, heads, seq, head_dim) GLOBAL arrays; the sequence axis
     is sharded over ``seq_axis``, batch over ``data_axis``; output sharding
-    matches q.  Numerically equals dense ``attention(q, k, v, causal)``.
+    matches q.  Numerically equals dense ``attention(q, k, v, causal)``;
+    ``window=W`` composes (equals the dense sliding-window form — global
+    positions, so the band crosses shard borders correctly; a future
+    optimization could skip ring steps entirely outside the band).
     """
     from jax.sharding import NamedSharding, PartitionSpec as P
     from jax import shard_map
 
+    if window and not causal:
+        raise ValueError("window requires causal=True")
     spec = P(data_axis, None, seq_axis, None)
     fn = shard_map(
         functools.partial(_ring_attention_local, axis_name=seq_axis,
-                          causal=causal),
+                          causal=causal, window=window),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     q = jax.device_put(q, NamedSharding(mesh, spec))
     k = jax.device_put(k, NamedSharding(mesh, spec))
