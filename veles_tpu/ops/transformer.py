@@ -576,6 +576,16 @@ class TransformerTrainer(AcceleratedUnit):
         import jax.numpy as jnp
         if not hasattr(self, "input") or self.input.is_empty:
             raise DeferredInitError(self.name)
+        loader_vocab = getattr(getattr(self.workflow, "loader", None),
+                               "vocab", None)
+        if loader_vocab is not None and loader_vocab > self.vocab:
+            # jnp.take CLIPS out-of-range token ids silently — a loader
+            # emitting a wider alphabet than the embedding would train
+            # to completion on garbage; fail here instead
+            raise ValueError(
+                "loader vocab %d exceeds trainer vocab %d — set "
+                "root.<name>.trainer.vocab to cover the data source"
+                % (loader_vocab, self.vocab))
         if self.params is None:
             host = init_transformer_params(
                 prng_mod.get("init"), self.vocab, self.d_model,

@@ -19,20 +19,69 @@ from veles_tpu.workflow import Repeater
 
 
 class CharSequenceLoader(FullBatchLoader):
-    """Synthetic token sequences with predictable structure: each sequence
-    cycles an arithmetic pattern ``t[i+1] = (t[i] + step) % vocab`` whose
-    step is sampled per sequence — a 1-layer model can learn it."""
+    """Token sequences for the LM: REAL TEXT when ``text_path`` points at
+    a file (byte-level — every file is its own tokenizer-free corpus,
+    vocab 256, split into overlapping seq_len windows, last 1/8 of the
+    FILE held out as validation so the split is by position, not by
+    window shuffle), synthetic otherwise: each synthetic sequence cycles
+    an arithmetic pattern ``t[i+1] = (t[i] + step) % vocab`` whose step
+    is sampled per sequence — a 1-layer model can learn it (loss
+    provably reducible, hermetic CI)."""
 
     def __init__(self, workflow, n_train=512, n_valid=128, seq_len=64,
-                 vocab=32, **kwargs):
+                 vocab=32, text_path=None, **kwargs):
         super().__init__(workflow, **kwargs)
         self.n_train = n_train
         self.n_valid = n_valid
         self.seq_len = seq_len
         self.vocab = vocab
+        #: optional real corpus (any file, read as bytes)
+        self.text_path = text_path
         self.has_labels = False
 
+    def _load_text(self):
+        import os
+        raw = numpy.fromfile(self.text_path, numpy.uint8)
+        if len(raw) < 2 * self.seq_len:
+            raise ValueError("%s: %d bytes < 2 windows of seq_len %d"
+                             % (self.text_path, len(raw), self.seq_len))
+        self.vocab = 256
+        split = len(raw) - max(len(raw) // 8, self.seq_len)
+
+        def windows(chunk, cap):
+            # stride spreads the cap across the WHOLE chunk (a large
+            # corpus contributes windows from everywhere, not just its
+            # first cap·stride bytes), overlapping when the chunk is
+            # small
+            span = len(chunk) - self.seq_len
+            n = min(max(span // max(self.seq_len // 2, 1) + 1, 1), cap)
+            stride = max(span // max(n - 1, 1), 1) if n > 1 else 1
+            return numpy.stack([
+                chunk[i * stride:i * stride + self.seq_len]
+                for i in range(n)])
+
+        train = windows(raw[:split], self.n_train)
+        valid = windows(raw[split:], self.n_valid)
+        self.original_data.reset(numpy.concatenate(
+            [valid, train]).astype(numpy.int32))
+        self.class_lengths = [0, len(valid), len(train)]
+        self.info("text corpus %s: %d bytes -> %d train / %d valid "
+                  "windows of %d (byte-level vocab 256)",
+                  os.path.basename(str(self.text_path)), len(raw),
+                  len(train), len(valid), self.seq_len)
+
     def load_data(self):
+        import os
+        if self.text_path:
+            # an EXPLICIT corpus path must never fall back silently — a
+            # typo would train to convergence on synthetic data while
+            # the user believes the metrics are for their corpus
+            if not os.path.exists(str(self.text_path)):
+                raise FileNotFoundError(
+                    "char_lm text_path %r does not exist"
+                    % (self.text_path,))
+            self._load_text()
+            return
         stream = prng.get("charlm_synth", pinned=True)
         total = self.n_train + self.n_valid
         starts = stream.randint(0, self.vocab, total)
@@ -75,11 +124,21 @@ class CharLMWorkflow(NNWorkflow):
 
 
 def default_config():
+    # a real text corpus is byte-level: the trainer's vocab must cover
+    # every byte, so the default follows the data source (explicit
+    # root.char_lm.trainer.vocab always wins; vocab CONSISTENCY between
+    # loader and trainer is enforced at trainer.initialize either way).
+    # Raw-dict probing: a dotted read would create a phantom Config node
+    # that defaults() then refuses to overwrite.
+    loader_node = root.char_lm.__dict__.get("loader")
+    text = (loader_node.__dict__.get("text_path")
+            if loader_node is not None else None)
+    vocab = 256 if isinstance(text, str) and text else 32
     root.char_lm.defaults({
         "loader": {"minibatch_size": 64, "n_train": 512, "n_valid": 128,
-                   "seq_len": 64, "vocab": 32},
-        "trainer": {"vocab": 32, "d_model": 64, "n_heads": 4, "n_layers": 2,
-                    "max_len": 64, "learning_rate": 1e-3},
+                   "seq_len": 64, "vocab": vocab, "text_path": None},
+        "trainer": {"vocab": vocab, "d_model": 64, "n_heads": 4,
+                    "n_layers": 2, "max_len": 64, "learning_rate": 1e-3},
         "decision": {"max_epochs": 10, "fail_iterations": 20},
     })
     return root.char_lm
