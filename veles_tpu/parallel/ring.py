@@ -51,17 +51,37 @@ def _ring_attention_local(q, k, v, axis_name, causal, window=None):
         k_blk, v_blk = kv
         # kv block currently held originated on device (my_index - step) % n
         src = (my_index - step) % n
-        bias = None
-        if causal:
+        def attend(c):
             # the shared global-position band (attention.band_bias):
             # the window just masks across shard borders.  Step 0 is
             # the own block (every query sees itself), so the online
             # max is finite before any fully-masked distant block
             # arrives — same transient-safety argument as
-            # blockwise_attention.
-            bias = band_bias(q_pos, src * s_local + jnp.arange(s_local),
-                             causal, window, q.dtype)
-        o_l_m = _online_update(o_l_m, q, k_blk, v_blk, bias)
+            # blockwise_attention.  Built INSIDE the branch so skipped
+            # steps skip the (s_local x s_local) mask too.
+            bias = (band_bias(q_pos,
+                              src * s_local + jnp.arange(s_local),
+                              causal, window, q.dtype)
+                    if causal else None)
+            return _online_update(c, q, k_blk, v_blk, bias)
+
+        if causal:
+            # EARLY EXIT: skip the attention math entirely for blocks
+            # with no live (query, key) pair — future blocks under
+            # causality, too-old blocks under a window; most ring steps
+            # are then just the ppermute.  A pair (q, k) is live iff
+            # k <= q and (no window or q - k < W); over the block's key
+            # span [k_first, k_last] and this device's query span
+            # [q_first, q_last] that reduces to the interval test
+            #   k_first <= q_last  AND  k_last > q_first - W.
+            k_first = src * s_local
+            k_last = k_first + s_local - 1
+            live = k_first <= q_pos[-1]
+            if window:
+                live &= k_last > q_pos[0] - window
+            o_l_m = jax.lax.cond(live, attend, lambda c: c, o_l_m)
+        else:
+            o_l_m = attend(o_l_m)
         # rotate kv around the ring for the next step (ICI neighbor copy)
         kv = jax.tree.map(
             lambda a: jax.lax.ppermute(a, axis_name, perm), kv)
@@ -86,8 +106,10 @@ def ring_attention(q, k, v, mesh, causal=True, seq_axis="seq",
     is sharded over ``seq_axis``, batch over ``data_axis``; output sharding
     matches q.  Numerically equals dense ``attention(q, k, v, causal)``;
     ``window=W`` composes (equals the dense sliding-window form — global
-    positions, so the band crosses shard borders correctly; a future
-    optimization could skip ring steps entirely outside the band).
+    positions, so the band crosses shard borders correctly).  Ring steps
+    whose whole block is outside the band skip the attention math (only
+    the ppermute runs), so per-device compute under a small window is
+    O(s_local + W) keys per query block rather than O(seq).
     """
     from jax.sharding import NamedSharding, PartitionSpec as P
     from jax import shard_map
