@@ -162,6 +162,36 @@ def flash_attention_tpu(q, k, v, causal=True):
                            sm_scale=float(1.0 / (dh ** 0.5)))
 
 
+def rolling_slot_update(slot_pos, pos, window):
+    """Ring-buffer bookkeeping for one decode step, computed ONCE per
+    step (shared by every block — same writes): position ``pos`` lands
+    in slot ``pos % W``; ``slot_pos`` (W,) int32 tracks which absolute
+    position each slot holds (-1 = never written).  Returns
+    (slot, updated slot_pos, live mask): a slot is live iff it holds a
+    real position inside the window."""
+    slot = pos % window
+    slot_pos = jax.lax.dynamic_update_slice(
+        slot_pos, jnp.asarray(pos, slot_pos.dtype)[None], (slot,))
+    live = ((slot_pos >= 0) & (slot_pos <= pos)
+            & (slot_pos > pos - window))
+    return slot, slot_pos, live
+
+
+def mha_decode_step_rolling(params, x, k_cache, v_cache, slot, live,
+                            pos, n_heads):
+    """One decode step against a RING-BUFFER KV cache of size W — the
+    same `_decode_attend` core as ``mha_decode_step``, writing at the
+    precomputed ``slot`` under the precomputed ``live`` mask
+    (:func:`rolling_slot_update`).  With RoPE (keys carry their own
+    rotation; no positional table bounds the length) this gives
+    UNBOUNDED autoregressive decode in O(W) memory.
+
+    k_cache/v_cache: (batch, kv_heads, W, head_dim); returns
+    (out, k_cache, v_cache) with position ``pos`` written."""
+    return _decode_attend(params, x, k_cache, v_cache, slot, live, pos,
+                          n_heads)
+
+
 #: attention backend for mha_forward's non-windowed causal path:
 #: 'xla' (dense or our blockwise scan) | 'flash_pallas' (the bundled
 #: TPU Pallas kernel above).  Benchmarked by bench.py's lm config on
@@ -255,6 +285,40 @@ def mha_forward(params, x, n_heads, causal=True, block_size=None,
     return (out, k, v) if return_kv else out
 
 
+def _decode_attend(params, x, k_cache, v_cache, write_idx, live,
+                   rope_pos, n_heads):
+    """THE decode-step core shared by the linear-cache and ring-buffer
+    paths (they must never drift numerically): project q/k/v for one
+    position, optionally rotate q/k at ``rope_pos``, write the new k/v
+    at cache index ``write_idx``, attend over the cache under the
+    precomputed ``live`` mask (cache_len,), and project out."""
+    b, _, d = x.shape
+    dh = d // n_heads
+    kv = kv_heads_of(params, n_heads, d)
+
+    def split(w, heads):
+        return matmul(x, w).reshape(b, 1, heads, dh).transpose(0, 2, 1, 3)
+
+    q = split(params["wq"], n_heads)            # (b, h, 1, dh)
+    k_new = split(params["wk"], kv)
+    if rope_pos is not None:
+        pos_arr = jnp.asarray(rope_pos)[None]
+        q = rope_rotate(q, pos_arr)
+        k_new = rope_rotate(k_new, pos_arr)
+    k_cache = jax.lax.dynamic_update_slice(
+        k_cache, k_new, (0, 0, write_idx, 0))
+    v_cache = jax.lax.dynamic_update_slice(
+        v_cache, split(params["wv"], kv), (0, 0, write_idx, 0))
+    scores = matmul(q, jnp.swapaxes(_repeat_kv(k_cache, n_heads),
+                                    -1, -2)) / jnp.sqrt(
+        jnp.asarray(dh, q.dtype))               # (b, h, 1, cache_len)
+    scores = jnp.where(live[None, None, None, :], scores, NEG_INF)
+    o = matmul(jax.nn.softmax(scores, axis=-1),
+               _repeat_kv(v_cache, n_heads))
+    o = o.transpose(0, 2, 1, 3).reshape(b, 1, d)
+    return matmul(o, params["wo"]), k_cache, v_cache
+
+
 def mha_decode_step(params, x, k_cache, v_cache, pos, n_heads,
                     rope=False, window=None):
     """One autoregressive decode step with a KV cache.
@@ -270,32 +334,9 @@ def mha_decode_step(params, x, k_cache, v_cache, pos, n_heads,
     rotates the new q/k at ``pos`` (cached keys are pre-rotated);
     ``window`` masks cache entries older than W positions.
     """
-    b, _, d = x.shape
-    dh = d // n_heads
-    kv = kv_heads_of(params, n_heads, d)
-
-    def split(w, heads):
-        return matmul(x, w).reshape(b, 1, heads, dh).transpose(0, 2, 1, 3)
-
-    q = split(params["wq"], n_heads)            # (b, h, 1, dh)
-    k_new = split(params["wk"], kv)
-    if rope:
-        pos_arr = jnp.asarray(pos)[None]
-        q = rope_rotate(q, pos_arr)
-        k_new = rope_rotate(k_new, pos_arr)
-    k_cache = jax.lax.dynamic_update_slice(
-        k_cache, k_new, (0, 0, pos, 0))
-    v_cache = jax.lax.dynamic_update_slice(
-        v_cache, split(params["wv"], kv), (0, 0, pos, 0))
-    scores = matmul(q, jnp.swapaxes(_repeat_kv(k_cache, n_heads),
-                                    -1, -2)) / jnp.sqrt(
-        jnp.asarray(dh, q.dtype))               # (b, h, 1, max_len)
     idx = jnp.arange(k_cache.shape[2])
     live = idx <= pos
     if window:
         live &= idx > pos - window
-    scores = jnp.where(live[None, None, None, :], scores, NEG_INF)
-    o = matmul(jax.nn.softmax(scores, axis=-1),
-               _repeat_kv(v_cache, n_heads))
-    o = o.transpose(0, 2, 1, 3).reshape(b, 1, d)
-    return matmul(o, params["wo"]), k_cache, v_cache
+    return _decode_attend(params, x, k_cache, v_cache, pos, live,
+                          pos if rope else None, n_heads)

@@ -257,6 +257,33 @@ def block_decode_step(blk, h, k_cache, v_cache, pos, n_heads,
     return h + _block_ffn(blk, hn), k_cache, v_cache
 
 
+def _make_sampler(greedy, top_k, temperature):
+    """Token sampler shared by the full-cache and rolling decoders (the
+    top-k tie rule and traced-temperature handling must never drift
+    between them)."""
+    import jax
+    import jax.numpy as jnp
+
+    def sample(logits, key):
+        if greedy:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        lg = logits
+        if top_k is not None:
+            # keep only the k most likely tokens; ties at the cutoff
+            # stay eligible
+            vals = jax.lax.top_k(lg, top_k)[0]
+            lg = jnp.where(lg >= vals[..., -1:], lg, NEG_INF_LOGIT)
+        # temperature is TRACED: every sampling temperature shares one
+        # compilation (serve_lm exposes it to clients)
+        return jax.random.categorical(key, lg / temperature,
+                                      axis=-1).astype(jnp.int32)
+
+    def next_key(key):
+        return jax.random.split(key) if key is not None else (None, None)
+
+    return sample, next_key
+
+
 def _generate_impl(params, prompt, rng, temperature, true_len, n_new,
                    n_heads, greedy, max_len, top_k, rope, window):
     import jax
@@ -271,25 +298,7 @@ def _generate_impl(params, prompt, rng, temperature, true_len, n_new,
     # positions > pos — so bucketing is bit-exact, not approximate.
     logits = head_logits(params, jax.lax.dynamic_slice_in_dim(
         h, true_len - 1, 1, axis=1))[:, 0, :]
-
-    def sample(logits, key):
-        if greedy:
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        if top_k is not None:
-            # keep only the k most likely tokens (nucleus-style quality
-            # control); ties at the cutoff stay eligible
-            vals = jax.lax.top_k(logits, top_k)[0]
-            logits = jnp.where(logits >= vals[..., -1:], logits,
-                               NEG_INF_LOGIT)
-        # temperature is TRACED: every sampling temperature shares one
-        # compilation (serve_lm exposes it to clients — a static arg
-        # would let them force a recompile per distinct value)
-        return jax.random.categorical(
-            key, logits / temperature, axis=-1).astype(jnp.int32)
-
-    def next_key(key):
-        return jax.random.split(key) if key is not None else (None, None)
-
+    sample, next_key = _make_sampler(greedy, top_k, temperature)
 
     # the final sampled token never feeds the stack again, so the scan
     # runs n_new - 1 decode steps and the last sample happens outside
@@ -389,6 +398,120 @@ def generate(params, prompt, n_new, n_heads, rng=None, temperature=1.0,
                          # greedy never reads top_k — null it so distinct
                          # values cannot fork identical compiles
                          top_k=None if greedy else top_k)
+
+
+_GENERATE_ROLLING_JIT = None
+
+
+def block_decode_step_rolling(blk, h, k_cache, v_cache, slot, live, pos,
+                              n_heads):
+    """One block over ONE position against its ring-buffer cache — the
+    rolling sibling of :func:`block_decode_step` (same wiring, the
+    precomputed slot/live from attention.rolling_slot_update)."""
+    from veles_tpu.ops.attention import mha_decode_step_rolling
+    hn = _layernorm(h, blk["ln1"]["g"], blk["ln1"]["b"])
+    attn, k_cache, v_cache = mha_decode_step_rolling(
+        blk["attn"], hn, k_cache, v_cache, slot, live, pos, n_heads)
+    h = h + attn
+    hn = _layernorm(h, blk["ln2"]["g"], blk["ln2"]["b"])
+    return h + _block_ffn(blk, hn), k_cache, v_cache
+
+
+def _generate_rolling_impl(params, prompt, rng, temperature, n_new,
+                           n_heads, greedy, window, top_k):
+    import jax
+    import jax.numpy as jnp
+    from veles_tpu.ops.attention import rolling_slot_update
+    s = prompt.shape[1]
+    # prefill at the PROMPT width (no grow-to-max_len cache), windowed
+    h, caches = prefill(params, prompt, n_heads, max_len=s, rope=True,
+                        window=window)
+    logits = head_logits(params, h[:, -1:, :])[:, 0, :]
+    # fold each block's prompt K/V into a W-slot ring: the last
+    # min(s, W) positions land at slot p % W (consecutive => distinct)
+    keep = min(s, window)
+    ps = jnp.arange(s - keep, s)
+    slots = ps % window
+    slot_pos = jnp.full((window,), -1, jnp.int32).at[slots].set(ps)
+
+    def to_ring(c):
+        k, v = c
+        shape = k.shape[:2] + (window,) + k.shape[3:]
+        kr = jnp.zeros(shape, k.dtype).at[:, :, slots, :].set(
+            k[:, :, s - keep:s, :])
+        vr = jnp.zeros(shape, v.dtype).at[:, :, slots, :].set(
+            v[:, :, s - keep:s, :])
+        return kr, vr
+
+    caches = [to_ring(c) for c in caches]
+    sample, next_key = _make_sampler(greedy, top_k, temperature)
+
+    def body(carry, i):
+        caches, slot_pos, logits, key = carry
+        key, sub = next_key(key)
+        tok = sample(logits, sub)
+        pos = s + i
+        # ring bookkeeping once per step — every block writes the same
+        # slot under the same liveness
+        slot, slot_pos, live = rolling_slot_update(slot_pos, pos, window)
+        x = jnp.take(params["embed"], tok, axis=0)[:, None, :]
+        new_caches = []
+        for blk, (kc, vc) in zip(params["blocks"], caches):
+            x, kc, vc = block_decode_step_rolling(
+                blk, x, kc, vc, slot, live, pos, n_heads)
+            new_caches.append((kc, vc))
+        logits = head_logits(params, x)[:, 0, :]
+        return (new_caches, slot_pos, logits, key), tok
+
+    key0 = None if greedy else rng
+    (caches, slot_pos, logits, key), toks = jax.lax.scan(
+        body, (caches, slot_pos, logits, key0), jnp.arange(n_new - 1))
+    _, sub = next_key(key)
+    last = sample(logits, sub)
+    toks = jnp.concatenate([toks.T, last[:, None]], axis=1)
+    return jnp.concatenate([prompt, toks.astype(jnp.int32)], axis=1)
+
+
+def generate_rolling(params, prompt, n_new, n_heads, window, rng=None,
+                     temperature=1.0, top_k=None):
+    """UNBOUNDED autoregressive decode in O(window) memory.
+
+    For RoPE + sliding-window models only (no positional table to
+    outgrow, attention never reaches past the window): the KV cache is
+    a ring buffer of ``window`` slots
+    (attention.mha_decode_step_rolling), so ``n_new`` is limited by
+    nothing — where ``generate`` allocates max_len-sized caches and
+    rejects ``prompt + n_new > max_len``, this keeps decoding forever
+    at constant memory.  Matches ``generate(..., rope=True,
+    window=W)`` exactly while the full cache lasts (parity-tested).
+    """
+    import jax
+    import jax.numpy as jnp
+    global _GENERATE_ROLLING_JIT
+    if "pos" in params:
+        raise ValueError("generate_rolling needs a RoPE model (a learned "
+                         "positional table bounds the length anyway — "
+                         "use generate)")
+    if n_new < 1:
+        raise ValueError("n_new must be >= 1")
+    if not window or window < 1:
+        raise ValueError("generate_rolling needs window >= 1")
+    greedy = not temperature
+    if not greedy and rng is None:
+        raise ValueError("sampling (temperature > 0) needs rng")
+    if top_k is not None and not 1 <= top_k <= params["embed"].shape[0]:
+        raise ValueError("top_k %r out of range (vocab %d)"
+                         % (top_k, params["embed"].shape[0]))
+    if _GENERATE_ROLLING_JIT is None:
+        _GENERATE_ROLLING_JIT = jax.jit(
+            _generate_rolling_impl,
+            static_argnames=("n_new", "n_heads", "greedy", "window",
+                             "top_k"))
+    return _GENERATE_ROLLING_JIT(
+        params, prompt, None if greedy else rng,
+        jnp.asarray(temperature or 1.0, jnp.float32),
+        n_new=n_new, n_heads=n_heads, greedy=greedy, window=window,
+        top_k=None if greedy else top_k)
 
 
 def trainer_sample_tokens(trainer, prompt, n_new=32, temperature=0.0,
