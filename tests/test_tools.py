@@ -1,0 +1,70 @@
+"""Repo tools: the trace analyzer (tools/trace_analyze.py) against a
+synthetic Chrome trace, and the committed round-4 artifact."""
+
+import gzip
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import trace_analyze  # noqa: E402
+
+
+def _synthetic_trace(path, steps=4):
+    """2 heavy ops x `steps` + one while wrapper, with metadata."""
+    events = [
+        {"ph": "M", "pid": 1, "tid": 7, "name": "thread_name",
+         "args": {"name": "XLA Ops"}},
+        {"ph": "X", "pid": 1, "tid": 7, "name": "while.1", "ts": 0,
+         "dur": 4000 * steps,
+         "args": {"hlo_category": "while"}},
+    ]
+    for i in range(steps):
+        events.append({
+            "ph": "X", "pid": 1, "tid": 7, "name": "fusion.1",
+            "ts": 4000 * i, "dur": 3000,
+            "args": {"hlo_category": "convolution fusion",
+                     "model_flops": "6000000000",
+                     "bytes_accessed": "1000000"}})
+        events.append({
+            "ph": "X", "pid": 1, "tid": 7, "name": "fusion.2",
+            "ts": 4000 * i + 3000, "dur": 1000,
+            "args": {"hlo_category": "loop fusion",
+                     "model_flops": "0",
+                     "bytes_accessed": "2000000"}})
+    with gzip.open(path, "wt") as f:
+        json.dump({"traceEvents": events}, f)
+    return path
+
+
+def test_analyze_synthetic(tmp_path):
+    path = _synthetic_trace(str(tmp_path / "t.trace.json.gz"), steps=4)
+    res = trace_analyze.analyze(path)
+    assert res["steps"] == 4                   # inferred modal count
+    assert res["total_ms_per_step"] == pytest.approx(4.0)
+    rows = {r["op"]: r for r in res["rows"]}
+    conv = rows["fusion.1"]
+    assert conv["ms_per_step"] == pytest.approx(3.0)
+    assert conv["category"] == "convolution fusion"
+    # 6 GFLOP in 3ms => 2 TF/s; 1 MB in 3ms => ~0.33 GB/s
+    assert conv["tflops"] == pytest.approx(2.0)
+    assert rows["fusion.2"]["gbps"] == pytest.approx(2.0)
+    # the while wrapper is excluded from rows
+    assert "while.1" not in rows
+
+
+def test_analyze_committed_round4_artifact():
+    """The committed AlexNet trace stays parseable and the PERF.md
+    headline numbers stay reproducible from it."""
+    path = os.path.join(REPO, "docs", "traces",
+                        "alexnet_r4_step60ms.trace.json.gz")
+    res = trace_analyze.analyze(path)
+    assert res["steps"] == 8
+    assert 40.0 < res["total_ms_per_step"] < 43.0       # 41.3 ms/step
+    top = res["rows"][0]
+    assert top["category"] == "convolution fusion"
+    assert 3.5 < top["ms_per_step"] < 4.5

@@ -17,7 +17,7 @@ idiomatic JAX/XLA:
   veles/client.py [H] per SURVEY §2.5).
 """
 
-__version__ = "0.4.0"
+__version__ = "0.5.0"
 
 from veles_tpu.config import Config, root, get, Tune  # noqa: F401
 from veles_tpu.mutable import Bool, LinkableAttribute  # noqa: F401
