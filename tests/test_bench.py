@@ -15,9 +15,12 @@ REPO = os.path.dirname(HERE)
 BENCH = os.path.join(REPO, "bench.py")
 
 
-def _run(args, env_extra=None, timeout=300):
+def _run(args, env_extra=None, timeout=300, pin_cpu=True):
     env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
+    if pin_cpu:
+        env["JAX_PLATFORMS"] = "cpu"
+    else:
+        env.pop("JAX_PLATFORMS", None)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env.update(env_extra or {})
     proc = subprocess.run(
@@ -107,3 +110,22 @@ def test_emit_summary_priority_and_fallbacks():
     # all-errors still yields the one line with rc=1
     rc, rec = emit({"mnist_error": "boom"})
     assert rc == 1 and rec["metric"] == "bench_failed"
+
+
+def test_dead_tunnel_degrades_to_host_records():
+    """A dead tunnel must NOT zero the bench (round-4 failure mode):
+    device configs record unreachable-errors, but host-side configs
+    (records; the native runner's cpu-pinned worker) still produce real
+    records and the summary line is VALID with rc=0.  pin_cpu=False:
+    the simulate gate must see the mnist worker as a DEVICE worker
+    (orchestrate cpu-pins only host_only workers)."""
+    rc, lines = _run(["--configs", "mnist,records", "--seconds", "0.2"],
+                     env_extra={"VELES_BENCH_SIMULATE_DEAD_TUNNEL": "1",
+                                "VELES_BENCH_CONFIG_TIMEOUT_S": "240"},
+                     timeout=500, pin_cpu=False)
+    assert rc == 0, lines
+    rec = json.loads(lines[-1])
+    assert rec["metric"] == "records_pipeline_samples_per_sec"
+    assert rec["value"] > 0
+    assert "unreachable" in rec["configs"]["mnist_error"]
+    assert rec["configs"]["records_pipeline"]["samples_per_sec"] > 0
