@@ -910,14 +910,8 @@ def expand_configs(wanted):
 def probe_device(timeout_s=None):
     """Tiny compile+fetch under a hard deadline.  A wedged TPU-tunnel relay
     makes any dispatch hang FOREVER (observed for hours in round 4), so
-    the probe runs on a daemon thread and the caller gives up on it.
-    VELES_BENCH_SIMULATE_DEAD_TUNNEL=1 forces a failed probe on
-    non-cpu-pinned workers (tests the degraded-record path without a
-    wedged relay)."""
+    the probe runs on a daemon thread and the caller gives up on it."""
     import threading
-    if os.environ.get("VELES_BENCH_SIMULATE_DEAD_TUNNEL") \
-            and os.environ.get("JAX_PLATFORMS") != "cpu":
-        return False
     probe_ok = []
 
     def _probe():
