@@ -697,3 +697,76 @@ class TestRollingCache:
         with pytest.raises(ValueError, match="RoPE"):
             T.generate_rolling(params, prompt, n_new=4, n_heads=4,
                                window=2, temperature=0)
+
+
+class TestAttentionSinksDecode:
+    """sinks must hold at DECODE time too — prefill/train masks and both
+    KV-cache forms (linear and ring, where sinks are physically pinned
+    slots) all agree."""
+
+    def _params(self):
+        prng.reset(); prng.seed_all(13)
+        return jax.tree.map(jnp.asarray, T.init_transformer_params(
+            prng.get("init"), vocab=16, d_model=32, n_heads=4,
+            n_layers=2, max_len=24, rope=True))
+
+    def test_full_cache_decode_matches_forward(self):
+        """Greedy decode with window+sinks reproduces the full
+        forward's argmax at every step (no train/serve mask drift —
+        the exact scenario sinks exist for)."""
+        params = self._params()
+        prompt = jnp.asarray([[7, 3, 9, 1]], jnp.int32)
+        out = numpy.asarray(T.generate(
+            params, prompt, n_new=10, n_heads=4, temperature=0,
+            max_len=24, rope=True, window=3, sinks=2))[0]
+        seq = list(map(int, prompt[0]))
+        for _ in range(10):
+            logits = T.transformer_forward(
+                params, jnp.asarray([seq], jnp.int32), n_heads=4,
+                rope=True, window=3, sinks=2)
+            nxt = int(jnp.argmax(logits[0, -1]))
+            assert nxt == int(out[len(seq)]), seq
+            seq.append(nxt)
+
+    def test_rolling_matches_full_cache_and_runs_unbounded(self):
+        params = self._params()
+        prompt = jnp.asarray([[2, 4, 6, 8, 1]], jnp.int32)
+        full = numpy.asarray(T.generate(
+            params, prompt, n_new=12, n_heads=4, temperature=0,
+            max_len=24, rope=True, window=4, sinks=2))
+        rolling = numpy.asarray(T.generate_rolling(
+            params, prompt, n_new=12, n_heads=4, window=4, sinks=2,
+            temperature=0))
+        numpy.testing.assert_array_equal(full, rolling)
+        # unbounded with pinned sinks: far beyond the pos table bound
+        out = numpy.asarray(T.generate_rolling(
+            params, prompt, n_new=80, n_heads=4, window=4, sinks=2,
+            temperature=0))
+        assert out.shape == (1, 85)
+        assert out.min() >= 0 and out.max() < 16
+
+    def test_trainer_sinks_require_window(self):
+        from veles_tpu.workflow import Workflow
+        wf = Workflow(None, name="w")
+        with pytest.raises(ValueError, match="window"):
+            T.TransformerTrainer(wf, attn_sinks=2, name="t")
+
+    def test_char_lm_trains_with_sinks(self):
+        prng.reset(); prng.seed_all(5)
+        root.__dict__.pop("char_lm", None)
+        root.char_lm.update({
+            "loader": {"minibatch_size": 32, "n_train": 256,
+                       "n_valid": 64, "seq_len": 32, "vocab": 16},
+            "trainer": {"vocab": 16, "d_model": 32, "n_heads": 4,
+                        "n_layers": 1, "max_len": 32,
+                        "learning_rate": 3e-3, "n_experts": 0,
+                        "pipeline_stages": 0, "remat": False,
+                        "rope": True, "window": 8, "attn_sinks": 2},
+            "decision": {"max_epochs": 6, "fail_iterations": 10},
+        })
+        from veles_tpu.samples import char_lm
+        wf = char_lm.train()
+        losses = [m["validation"]["loss"]
+                  for m in wf.decision.epoch_metrics
+                  if "validation" in m]
+        assert losses[-1] < losses[0] * 0.7, losses

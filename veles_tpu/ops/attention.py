@@ -25,7 +25,7 @@ from veles_tpu.ops.functional import matmul
 NEG_INF = -1e30
 
 
-def attention(q, k, v, causal=False, bias=None, window=None):
+def attention(q, k, v, causal=False, bias=None, window=None, sinks=0):
     """Dense scaled-dot-product attention.
 
     q, k, v: (..., heads, seq, head_dim) — returns the same shape as q.
@@ -46,7 +46,7 @@ def attention(q, k, v, causal=False, bias=None, window=None):
         s_q, s_k = scores.shape[-2], scores.shape[-1]
         scores = scores + band_bias(jnp.arange(s_q) + (s_k - s_q),
                                     jnp.arange(s_k), causal, window,
-                                    scores.dtype)
+                                    scores.dtype, sinks=sinks)
     probs = jax.nn.softmax(scores, axis=-1)
     return matmul(probs, v)
 
@@ -71,15 +71,24 @@ def rope_rotate(x, positions, theta=10000.0):
                             x2 * cos + x1 * sin], axis=-1)
 
 
-def band_bias(q_pos, k_pos, causal, window, dtype):
+def band_bias(q_pos, k_pos, causal, window, dtype, sinks=0):
     """Additive score bias for the global-position causal/sliding-window
     band — THE shared mask the dense, blockwise and ring decompositions
-    all apply, so a semantics change lands in one place."""
+    all apply, so a semantics change lands in one place.
+
+    ``sinks=K`` keeps the first K positions attendable from EVERYWHERE
+    regardless of the window (attention-sink / StreamingLLM form: the
+    softmax dumps excess mass on early positions, and evicting them
+    degrades windowed models) — sinks bypass the window bound only,
+    never causality."""
     allowed = jnp.ones((q_pos.shape[0], k_pos.shape[0]), bool)
     if causal:
         allowed &= q_pos[:, None] >= k_pos[None, :]
     if window:
-        allowed &= q_pos[:, None] - k_pos[None, :] < window
+        in_window = q_pos[:, None] - k_pos[None, :] < window
+        if sinks:
+            in_window |= (k_pos < sinks)[None, :]
+        allowed &= in_window
     return jnp.where(allowed, 0.0, NEG_INF).astype(dtype)
 
 
@@ -104,7 +113,7 @@ def _online_update(carry, q, k, v, score_bias):
 
 
 def blockwise_attention(q, k, v, block_size=128, causal=False,
-                        window=None):
+                        window=None, sinks=0):
     """Flash-style attention: scan over key/value blocks with the online
     softmax — numerically equal to ``attention`` but O(block) live memory,
     so sequence length is bounded by HBM, not by the seq² score matrix.
@@ -134,7 +143,7 @@ def blockwise_attention(q, k, v, block_size=128, causal=False,
         if causal:
             bias = band_bias(q_pos + (s_k - s_q),
                              i * block_size + jnp.arange(block_size),
-                             causal, window, q.dtype)
+                             causal, window, q.dtype, sinks=sinks)
         return _online_update(carry, q, kb_i, vb_i, bias), None
 
     o0 = jnp.zeros_like(q)
@@ -162,19 +171,26 @@ def flash_attention_tpu(q, k, v, causal=True):
                            sm_scale=float(1.0 / (dh ** 0.5)))
 
 
-def rolling_slot_update(slot_pos, pos, window):
+def rolling_slot_update(slot_pos, pos, window, sinks=0):
     """Ring-buffer bookkeeping for one decode step, computed ONCE per
-    step (shared by every block — same writes): position ``pos`` lands
-    in slot ``pos % W``; ``slot_pos`` (W,) int32 tracks which absolute
-    position each slot holds (-1 = never written).  Returns
-    (slot, updated slot_pos, live mask): a slot is live iff it holds a
-    real position inside the window."""
-    slot = pos % window
+    step (shared by every block — same writes).  Cache layout:
+    ``sinks`` PINNED slots (positions 0..sinks-1, never evicted —
+    StreamingLLM sinks must survive forever) followed by a ``window``
+    -slot ring where position p >= sinks lands in slot
+    sinks + (p - sinks) % window.  ``slot_pos``
+    (sinks + window,) int32 tracks which absolute position each slot
+    holds (-1 = never written).  Returns (write slot, updated slot_pos,
+    live mask): a slot is live iff it holds a real position that is a
+    sink or inside the window."""
+    in_ring = pos >= sinks
+    slot = jnp.where(in_ring, sinks + (pos - sinks) % window, pos)         if sinks else pos % window
     slot_pos = jax.lax.dynamic_update_slice(
         slot_pos, jnp.asarray(pos, slot_pos.dtype)[None], (slot,))
-    live = ((slot_pos >= 0) & (slot_pos <= pos)
-            & (slot_pos > pos - window))
-    return slot, slot_pos, live
+    live = (slot_pos >= 0) & (slot_pos <= pos)
+    in_window = slot_pos > pos - window
+    if sinks:
+        in_window |= slot_pos < sinks
+    return slot, slot_pos, live & in_window
 
 
 def mha_decode_step_rolling(params, x, k_cache, v_cache, slot, live,
@@ -250,7 +266,7 @@ def _repeat_kv(k, n_heads):
 
 def mha_forward(params, x, n_heads, causal=True, block_size=None,
                 return_kv=False, rope=False, window=None,
-                positions=None):
+                positions=None, sinks=0):
     """Multi-head attention over (batch, seq, d_model).
 
     ``return_kv=True`` additionally returns the projected (k, v) heads
@@ -277,9 +293,10 @@ def mha_forward(params, x, n_heads, causal=True, block_size=None,
         o = flash_attention_tpu(q, kr, vr, causal=causal)
     elif block_size:
         o = blockwise_attention(q, kr, vr, block_size, causal=causal,
-                                window=window)
+                                window=window, sinks=sinks)
     else:
-        o = attention(q, kr, vr, causal=causal, window=window)
+        o = attention(q, kr, vr, causal=causal, window=window,
+                      sinks=sinks)
     o = o.transpose(0, 2, 1, 3).reshape(b, s, d)
     out = matmul(o, params["wo"])
     return (out, k, v) if return_kv else out
@@ -320,7 +337,7 @@ def _decode_attend(params, x, k_cache, v_cache, write_idx, live,
 
 
 def mha_decode_step(params, x, k_cache, v_cache, pos, n_heads,
-                    rope=False, window=None):
+                    rope=False, window=None, sinks=0):
     """One autoregressive decode step with a KV cache.
 
     x: (batch, 1, d_model) — the current position's activations;
@@ -337,6 +354,9 @@ def mha_decode_step(params, x, k_cache, v_cache, pos, n_heads,
     idx = jnp.arange(k_cache.shape[2])
     live = idx <= pos
     if window:
-        live &= idx > pos - window
+        in_window = idx > pos - window
+        if sinks:
+            in_window |= idx < sinks     # sinks bypass the window only
+        live &= in_window
     return _decode_attend(params, x, k_cache, v_cache, pos, live,
                           pos if rope else None, n_heads)

@@ -87,7 +87,7 @@ def _layernorm(x, g, b, eps=1e-5):
 
 def block_forward(blk, h, n_heads, block_size=None, attn_fn=None,
                   with_aux=False, token_mask=None, rope=False,
-                  window=None):
+                  window=None, sinks=0):
     """One decoder block (pre-LN attention + FFN with residuals) — shared
     by the sequential forward and the pipeline-parallel stage runner
     (veles_tpu.parallel.pipeline).  A block carrying ``moe`` params uses
@@ -96,7 +96,7 @@ def block_forward(blk, h, n_heads, block_size=None, attn_fn=None,
     ``token_mask`` keeps padded rows out of the router statistics)."""
     hn = _layernorm(h, blk["ln1"]["g"], blk["ln1"]["b"])
     if attn_fn is not None:    # injected attention (ring SP)
-        if rope or window:
+        if rope or window or sinks:
             # the injected path never rotates q/k or masks the window —
             # running a RoPE model through it would silently drop ALL
             # positional signal (rope params have no pos table)
@@ -106,7 +106,7 @@ def block_forward(blk, h, n_heads, block_size=None, attn_fn=None,
     else:
         h = h + mha_forward(blk["attn"], hn, n_heads, causal=True,
                             block_size=block_size, rope=rope,
-                            window=window)
+                            window=window, sinks=sinks)
     hn = _layernorm(h, blk["ln2"]["g"], blk["ln2"]["b"])
     if "moe" in blk and with_aux:
         from veles_tpu.ops.moe import moe_ffn
@@ -159,18 +159,19 @@ def nll_from_hidden(params, h, targets, mask):
 
 
 def transformer_forward(params, tokens, n_heads, block_size=None,
-                        attn_fn=None, rope=False, window=None):
+                        attn_fn=None, rope=False, window=None, sinks=0):
     """Logits (batch, seq, vocab); ``attn_fn(q_input)`` optionally replaces
     the attention call (ring attention injection point)."""
     h = embed_tokens(params, tokens)
     for blk in params["blocks"]:
         h = block_forward(blk, h, n_heads, block_size, attn_fn,
-                          rope=rope, window=window)
+                          rope=rope, window=window, sinks=sinks)
     return head_logits(params, h)
 
 
 def lm_loss(params, tokens, mask, n_heads, block_size=None,
-            moe_aux_coef=0.0, remat=False, rope=False, window=None):
+            moe_aux_coef=0.0, remat=False, rope=False, window=None,
+            sinks=0):
     """Mean next-token cross-entropy (masked rows excluded).
 
     ``moe_aux_coef > 0`` adds the mean per-MoE-block load-balancing loss
@@ -197,13 +198,14 @@ def lm_loss(params, tokens, mask, n_heads, block_size=None,
         if moe_aux_coef and "moe" in blk:
             h, aux = wrap(lambda b, x: block_forward(
                 b, x, n_heads, block_size, with_aux=True,
-                token_mask=token_mask, rope=rope, window=window))(blk, h)
+                token_mask=token_mask, rope=rope, window=window,
+                sinks=sinks))(blk, h)
             aux_total = aux_total + aux
             n_moe += 1
         else:
             h = wrap(lambda b, x: block_forward(
-                b, x, n_heads, block_size, rope=rope,
-                window=window))(blk, h)
+                b, x, n_heads, block_size, rope=rope, window=window,
+                sinks=sinks))(blk, h)
     loss = nll_from_hidden(params, h, tokens[:, 1:], mask)
     if n_moe:
         loss = loss + moe_aux_coef * aux_total / n_moe
@@ -211,7 +213,8 @@ def lm_loss(params, tokens, mask, n_heads, block_size=None,
 
 
 # ---------------------------------------------------------------- serving
-def prefill(params, tokens, n_heads, max_len, rope=False, window=None):
+def prefill(params, tokens, n_heads, max_len, rope=False, window=None,
+            sinks=0):
     """Run the prompt through the stack once, capturing each block's
     projected K/V heads into fixed-shape caches (n_kv_heads-wide under
     GQA — the smaller cache is the point).
@@ -234,7 +237,7 @@ def prefill(params, tokens, n_heads, max_len, rope=False, window=None):
         def attn_capture(p, hn, captured=captured):
             out, k, v = mha_forward(p, hn, n_heads, causal=True,
                                     return_kv=True, rope=rope,
-                                    window=window)
+                                    window=window, sinks=sinks)
             captured["kv"] = (k, v)
             return out
 
@@ -245,13 +248,14 @@ def prefill(params, tokens, n_heads, max_len, rope=False, window=None):
 
 
 def block_decode_step(blk, h, k_cache, v_cache, pos, n_heads,
-                      rope=False, window=None):
+                      rope=False, window=None, sinks=0):
     """One block over ONE position against its KV cache (decode path)."""
     from veles_tpu.ops.attention import mha_decode_step
     hn = _layernorm(h, blk["ln1"]["g"], blk["ln1"]["b"])
     attn, k_cache, v_cache = mha_decode_step(blk["attn"], hn, k_cache,
                                              v_cache, pos, n_heads,
-                                             rope=rope, window=window)
+                                             rope=rope, window=window,
+                                             sinks=sinks)
     h = h + attn
     hn = _layernorm(h, blk["ln2"]["g"], blk["ln2"]["b"])
     return h + _block_ffn(blk, hn), k_cache, v_cache
@@ -285,11 +289,12 @@ def _make_sampler(greedy, top_k, temperature):
 
 
 def _generate_impl(params, prompt, rng, temperature, true_len, n_new,
-                   n_heads, greedy, max_len, top_k, rope, window):
+                   n_heads, greedy, max_len, top_k, rope, window,
+                   sinks):
     import jax
     import jax.numpy as jnp
     h, caches = prefill(params, prompt, n_heads, max_len, rope=rope,
-                        window=window)
+                        window=window, sinks=sinks)
     # ``true_len`` is TRACED: the prompt may be right-padded to a bucket
     # length so servers compile one program per bucket, not per exact
     # prompt length.  Under causal attention every position < true_len is
@@ -315,7 +320,8 @@ def _generate_impl(params, prompt, rng, temperature, true_len, n_new,
         new_caches = []
         for blk, (kc, vc) in zip(params["blocks"], caches):
             x, kc, vc = block_decode_step(blk, x, kc, vc, pos, n_heads,
-                                          rope=rope, window=window)
+                                          rope=rope, window=window,
+                                          sinks=sinks)
             new_caches.append((kc, vc))
         logits = head_logits(params, x)[:, 0, :]
         return (new_caches, logits, key), tok
@@ -340,7 +346,7 @@ NEG_INF_LOGIT = -1e30
 
 def generate(params, prompt, n_new, n_heads, rng=None, temperature=1.0,
              max_len=None, top_k=None, true_len=None, rope=False,
-             window=None):
+             window=None, sinks=0):
     """Autoregressive sampling with a KV cache, fully under jit.
 
     prompt: (batch, s) int32; returns (batch, s + n_new) int32.
@@ -389,12 +395,13 @@ def generate(params, prompt, n_new, n_heads, rng=None, temperature=1.0,
         _GENERATE_JIT = jax.jit(
             _generate_impl,
             static_argnames=("n_new", "n_heads", "greedy", "max_len",
-                             "top_k", "rope", "window"))
+                             "top_k", "rope", "window", "sinks"))
     return _GENERATE_JIT(params, prompt, None if greedy else rng,
                          jnp.asarray(temperature or 1.0, jnp.float32),
                          jnp.asarray(start, jnp.int32),
                          n_new=n_new, n_heads=n_heads, greedy=greedy,
                          max_len=max_len, rope=rope, window=window,
+                         sinks=sinks,
                          # greedy never reads top_k — null it so distinct
                          # values cannot fork identical compiles
                          top_k=None if greedy else top_k)
@@ -405,6 +412,8 @@ _GENERATE_ROLLING_JIT = None
 
 def block_decode_step_rolling(blk, h, k_cache, v_cache, slot, live, pos,
                               n_heads):
+    # (slot/live come from attention.rolling_slot_update, which already
+    # encodes any sink pinning — this function is sink-agnostic)
     """One block over ONE position against its ring-buffer cache — the
     rolling sibling of :func:`block_decode_step` (same wiring, the
     precomputed slot/live from attention.rolling_slot_update)."""
@@ -418,29 +427,35 @@ def block_decode_step_rolling(blk, h, k_cache, v_cache, slot, live, pos,
 
 
 def _generate_rolling_impl(params, prompt, rng, temperature, n_new,
-                           n_heads, greedy, window, top_k):
+                           n_heads, greedy, window, top_k, sinks):
     import jax
     import jax.numpy as jnp
     from veles_tpu.ops.attention import rolling_slot_update
     s = prompt.shape[1]
     # prefill at the PROMPT width (no grow-to-max_len cache), windowed
     h, caches = prefill(params, prompt, n_heads, max_len=s, rope=True,
-                        window=window)
+                        window=window, sinks=sinks)
     logits = head_logits(params, h[:, -1:, :])[:, 0, :]
-    # fold each block's prompt K/V into a W-slot ring: the last
-    # min(s, W) positions land at slot p % W (consecutive => distinct)
-    keep = min(s, window)
-    ps = jnp.arange(s - keep, s)
-    slots = ps % window
-    slot_pos = jnp.full((window,), -1, jnp.int32).at[slots].set(ps)
+    # fold each block's prompt K/V into the [sinks | W-ring] cache: the
+    # first min(sinks, s) positions pin to their own slots, the last
+    # min(W, s - kept-sinks) positions land at sinks + (p - sinks) % W
+    # (consecutive => distinct)
+    n_sink = min(sinks, s)
+    tail_lo = max(sinks, s - window)
+    ps = jnp.concatenate([jnp.arange(n_sink),
+                          jnp.arange(tail_lo, s)])
+    slots = jnp.where(ps < sinks, ps,
+                      sinks + (ps - sinks) % window)
+    cache_len = sinks + window
+    slot_pos = jnp.full((cache_len,), -1, jnp.int32).at[slots].set(ps)
 
     def to_ring(c):
         k, v = c
-        shape = k.shape[:2] + (window,) + k.shape[3:]
+        shape = k.shape[:2] + (cache_len,) + k.shape[3:]
         kr = jnp.zeros(shape, k.dtype).at[:, :, slots, :].set(
-            k[:, :, s - keep:s, :])
+            k[:, :, ps, :])
         vr = jnp.zeros(shape, v.dtype).at[:, :, slots, :].set(
-            v[:, :, s - keep:s, :])
+            v[:, :, ps, :])
         return kr, vr
 
     caches = [to_ring(c) for c in caches]
@@ -453,7 +468,8 @@ def _generate_rolling_impl(params, prompt, rng, temperature, n_new,
         pos = s + i
         # ring bookkeeping once per step — every block writes the same
         # slot under the same liveness
-        slot, slot_pos, live = rolling_slot_update(slot_pos, pos, window)
+        slot, slot_pos, live = rolling_slot_update(slot_pos, pos, window,
+                                                   sinks=sinks)
         x = jnp.take(params["embed"], tok, axis=0)[:, None, :]
         new_caches = []
         for blk, (kc, vc) in zip(params["blocks"], caches):
@@ -473,7 +489,7 @@ def _generate_rolling_impl(params, prompt, rng, temperature, n_new,
 
 
 def generate_rolling(params, prompt, n_new, n_heads, window, rng=None,
-                     temperature=1.0, top_k=None):
+                     temperature=1.0, top_k=None, sinks=0):
     """UNBOUNDED autoregressive decode in O(window) memory.
 
     For RoPE + sliding-window models only (no positional table to
@@ -506,12 +522,12 @@ def generate_rolling(params, prompt, n_new, n_heads, window, rng=None,
         _GENERATE_ROLLING_JIT = jax.jit(
             _generate_rolling_impl,
             static_argnames=("n_new", "n_heads", "greedy", "window",
-                             "top_k"))
+                             "top_k", "sinks"))
     return _GENERATE_ROLLING_JIT(
         params, prompt, None if greedy else rng,
         jnp.asarray(temperature or 1.0, jnp.float32),
         n_new=n_new, n_heads=n_heads, greedy=greedy, window=window,
-        top_k=None if greedy else top_k)
+        top_k=None if greedy else top_k, sinks=sinks)
 
 
 def trainer_sample_tokens(trainer, prompt, n_new=32, temperature=0.0,
@@ -538,7 +554,9 @@ def trainer_sample_tokens(trainer, prompt, n_new=32, temperature=0.0,
                                   true_len=true_len,
                                   rope=getattr(trainer, "rope", False),
                                   window=getattr(trainer, "window",
-                                                 None)))
+                                                 None),
+                                  sinks=getattr(trainer, "attn_sinks",
+                                                0)))
 
 
 def make_adam_train_step(loss_fn, learning_rate, beta1=0.9, beta2=0.999,
@@ -580,7 +598,7 @@ class TransformerTrainer(AcceleratedUnit):
                  block_size=None, beta1=0.9, beta2=0.999, eps=1e-8,
                  n_experts=0, moe_aux_coef=1e-2, pipeline_stages=0,
                  pipeline_microbatches=4, remat=False, n_kv_heads=None,
-                 rope=False, window=None, **kwargs):
+                 rope=False, window=None, attn_sinks=0, **kwargs):
         super().__init__(workflow, **kwargs)
         self.vocab = vocab
         self.d_model = d_model
@@ -592,6 +610,12 @@ class TransformerTrainer(AcceleratedUnit):
         self.rope = rope
         #: sliding-window attention: each token sees the last W only
         self.window = window
+        #: attention sinks: the first K positions stay attendable under
+        #: the window (StreamingLLM form)
+        self.attn_sinks = attn_sinks
+        if attn_sinks and not window:
+            raise ValueError("attn_sinks only means something under a "
+                             "window (set window=W)")
         if pipeline_stages > 0 and (rope or window):
             raise ValueError(
                 "rope/window are not threaded through the pipeline "
@@ -692,7 +716,7 @@ class TransformerTrainer(AcceleratedUnit):
         return lambda params, tokens, mask: lm_loss(
             params, tokens, mask, self.n_heads, self.block_size,
             moe_aux_coef=coef, remat=self.remat, rope=self.rope,
-            window=self.window)
+            window=self.window, sinks=self.attn_sinks)
 
     def initialize(self, device=None, **kwargs):
         import jax

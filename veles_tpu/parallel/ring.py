@@ -37,7 +37,8 @@ def make_seq_mesh(n_devices=None, data_parallel=1, devices=None):
     return Mesh(grid, ("data", "seq"))
 
 
-def _ring_attention_local(q, k, v, axis_name, causal, window=None):
+def _ring_attention_local(q, k, v, axis_name, causal, window=None,
+                          sinks=0):
     """Per-shard body (runs under shard_map): q/k/v are the LOCAL sequence
     blocks (batch, heads, s_local, dh)."""
     n = jax.lax.psum(1, axis_name)
@@ -61,7 +62,7 @@ def _ring_attention_local(q, k, v, axis_name, causal, window=None):
             # steps skip the (s_local x s_local) mask too.
             bias = (band_bias(q_pos,
                               src * s_local + jnp.arange(s_local),
-                              causal, window, q.dtype)
+                              causal, window, q.dtype, sinks=sinks)
                     if causal else None)
             return _online_update(c, q, k_blk, v_blk, bias)
 
@@ -78,7 +79,10 @@ def _ring_attention_local(q, k, v, axis_name, causal, window=None):
             k_last = k_first + s_local - 1
             live = k_first <= q_pos[-1]
             if window:
-                live &= k_last > q_pos[0] - window
+                in_band = k_last > q_pos[0] - window
+                if sinks:
+                    in_band |= k_first < sinks
+                live &= in_band
             o_l_m = jax.lax.cond(live, attend, lambda c: c, o_l_m)
         else:
             o_l_m = attend(o_l_m)
@@ -99,7 +103,7 @@ def _ring_attention_local(q, k, v, axis_name, causal, window=None):
 
 
 def ring_attention(q, k, v, mesh, causal=True, seq_axis="seq",
-                   data_axis="data", window=None):
+                   data_axis="data", window=None, sinks=0):
     """Sequence-parallel attention over ``mesh``.
 
     q, k, v: (batch, heads, seq, head_dim) GLOBAL arrays; the sequence axis
@@ -119,7 +123,7 @@ def ring_attention(q, k, v, mesh, causal=True, seq_axis="seq",
     spec = P(data_axis, None, seq_axis, None)
     fn = shard_map(
         functools.partial(_ring_attention_local, axis_name=seq_axis,
-                          causal=causal, window=window),
+                          causal=causal, window=window, sinks=sinks),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     q = jax.device_put(q, NamedSharding(mesh, spec))
     k = jax.device_put(k, NamedSharding(mesh, spec))
