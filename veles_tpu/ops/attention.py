@@ -9,7 +9,13 @@ module is BEYOND-PARITY capability, designed TPU-first rather than ported:
   via ``lax.scan`` — O(block) memory instead of O(seq²), the single-chip
   long-context path;
 - ``mha_forward`` / ``init_mha_params``: a multi-head layer as a pure
-  function over a param pytree (the transformer building block);
+  function over a param pytree (the transformer building block) with
+  grouped-query attention (``n_kv_heads``), rotary positions
+  (``rope_rotate``), sliding windows and attention sinks — all masking
+  flows through ONE ``band_bias`` so every decomposition agrees;
+- KV-cached decoding: ``mha_decode_step`` (linear cache) and
+  ``mha_decode_step_rolling`` (ring-buffer cache with pinned sink
+  slots, O(window) memory) share the ``_decode_attend`` core;
 - the multi-chip sequence-parallel path (ring attention over a mesh axis)
   lives in ``veles_tpu.parallel.ring`` and reuses the same online-softmax
   update (``_online_update``) so the two decompositions agree numerically.
@@ -57,10 +63,10 @@ def rope_rotate(x, positions, theta=10000.0):
 
     Rotates feature pairs (i, i + head_dim/2) — the half-split ("NeoX")
     layout, NOT the GPT-J interleaved even/odd pairing — by
-    position-dependent angles — relative positions enter attention through the q·k product
-    itself, so no learned positional table is needed and decode caches
-    hold PRE-rotated keys (each position's rotation is final).
-    ``positions``: (seq,) int array (traced ok)."""
+    position-dependent angles.  Relative positions then enter attention
+    through the q·k product itself, so no learned positional table is
+    needed, and decode caches hold PRE-rotated keys (each position's
+    rotation is final).  ``positions``: (seq,) int array (traced ok)."""
     dh = x.shape[-1]
     half = dh // 2
     freqs = theta ** (-jnp.arange(0, half, dtype=x.dtype) / half)

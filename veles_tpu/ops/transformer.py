@@ -8,7 +8,10 @@ core carries attention models unchanged.
 
 Long-sequence paths: ``block_size`` switches attention to the flash-style
 blockwise kernel (single chip); ``ring`` runs sequence-parallel ring
-attention over a mesh (veles_tpu.parallel.ring).
+attention over a mesh (veles_tpu.parallel.ring); ``rope``/``n_kv_heads``/
+``window``/``attn_sinks`` give rotary positions, grouped-query caches,
+sliding windows and StreamingLLM sinks; ``generate_rolling`` decodes
+without bound in O(window) memory.
 """
 
 from __future__ import annotations
