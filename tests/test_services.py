@@ -612,3 +612,43 @@ def test_confluence_backend_and_upload():
             got["payload"]["body"]["storage"]["value"]
     finally:
         srv.shutdown()
+
+
+def test_serve_lm_full_option_stack():
+    """HTTP serving composes the whole long-context option set: a
+    rope+GQA+window+sinks trainer behind serve_lm with prompt
+    bucketing — continuation starts with the prompt and stays in
+    vocab."""
+    from veles_tpu import prng
+    from veles_tpu.config import root
+    from veles_tpu.restful_api import serve_lm
+    prng.reset(); prng.seed_all(6)
+    root.__dict__.pop("char_lm", None)
+    root.char_lm.update({
+        "loader": {"minibatch_size": 32, "n_train": 128, "n_valid": 64,
+                   "seq_len": 32, "vocab": 16},
+        "trainer": {"vocab": 16, "d_model": 32, "n_heads": 4,
+                    "n_layers": 1, "max_len": 32,
+                    "learning_rate": 3e-3, "n_experts": 0,
+                    "pipeline_stages": 0, "remat": False,
+                    "rope": True, "n_kv_heads": 2, "window": 8,
+                    "attn_sinks": 2},
+        "decision": {"max_epochs": 2, "fail_iterations": 10},
+    })
+    from veles_tpu.samples import char_lm
+    wf = char_lm.train()
+    api = serve_lm(wf, port=0, max_new=8)
+    try:
+        for prompt in ([[1, 2, 3]], [[2, 4, 6, 8, 10, 12, 1]]):
+            req = urllib.request.Request(
+                "http://127.0.0.1:%d/predict" % api.port,
+                data=json.dumps({"input": prompt, "n_new": 5}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                out = json.loads(resp.read())
+            row = out["tokens"][0]
+            assert len(row) == len(prompt[0]) + 5
+            assert row[:len(prompt[0])] == prompt[0]
+            assert all(0 <= t < 16 for t in row)
+    finally:
+        api.stop()
