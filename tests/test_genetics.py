@@ -239,3 +239,31 @@ class TestEnsemble:
             numpy.testing.assert_array_equal(
                 numpy.asarray(seq_wf.forwards[0].weights.mem),
                 numpy.asarray(par_wf.forwards[0].weights.mem))
+
+
+def test_optimizes_char_lm_learning_rate():
+    """The GA generalizes to the transformer family: Tune over the
+    char-LM trainer's learning rate, fitness = validation loss from
+    TransformerDecision.best_metric (lower is better)."""
+    from veles_tpu import prng
+    from veles_tpu.genetics import optimize_workflow
+    prng.reset()
+    prng.seed_all(1)
+    root.__dict__.pop("char_lm", None)
+    root.char_lm.update({
+        "loader": {"minibatch_size": 32, "n_train": 128, "n_valid": 64,
+                   "seq_len": 32, "vocab": 16},
+        "trainer": {"vocab": 16, "d_model": 32, "n_heads": 2,
+                    "n_layers": 1, "max_len": 32,
+                    "learning_rate": Tune(1e-3, 1e-4, 1e-2),
+                    "n_experts": 0, "pipeline_stages": 0,
+                    "remat": False},
+        "decision": {"max_epochs": 2, "fail_iterations": 10},
+    })
+    from veles_tpu.samples import char_lm
+    best_fit, best_genes, _ = optimize_workflow(
+        char_lm, generations=2, population=3, seed=1)
+    assert numpy.isfinite(best_fit)
+    (path, value), = best_genes.items()
+    assert "learning_rate" in path
+    assert 1e-4 <= value <= 1e-2
