@@ -1250,20 +1250,22 @@ def emit_summary(results):
             "vs_baseline": None,
             "configs": results,
         }))
+    elif "records_pipeline" in results:
+        # preferred over native_runner: always carries a real value
+        # (the native record may be selfcheck-only on a dead tunnel)
+        print(json.dumps({
+            "metric": "records_pipeline_samples_per_sec",
+            "value": results["records_pipeline"]["samples_per_sec"],
+            "unit": "samples/sec",
+            "vs_baseline": None,
+            "configs": results,
+        }))
     elif "native_runner" in results:
         print(json.dumps({
             "metric": "native_runner_compile_plus_infer_wall_s",
             "value": results["native_runner"].get(
                 "compile_plus_infer_wall_s"),
             "unit": "s",
-            "vs_baseline": None,
-            "configs": results,
-        }))
-    elif "records_pipeline" in results:
-        print(json.dumps({
-            "metric": "records_pipeline_samples_per_sec",
-            "value": results["records_pipeline"]["samples_per_sec"],
-            "unit": "samples/sec",
             "vs_baseline": None,
             "configs": results,
         }))
