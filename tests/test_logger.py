@@ -1,0 +1,148 @@
+"""Logger mixin + structured event sinks.
+
+Ref: veles/logger.py::Logger [H] (SURVEY §2.1) — per-class channels and the
+optional MongoDB event sink (gated here on pymongo; the JSON-lines file sink
+is the dependency-free equivalent writing the same event schema).
+"""
+
+import json
+import logging
+import sys
+import time
+import types
+
+import pytest
+
+from veles_tpu import logger as vlog
+
+
+@pytest.fixture()
+def fresh_logging():
+    """Snapshot and restore the veles logger namespace around each test."""
+    base = logging.getLogger(vlog.NAMESPACE)
+    saved = (list(base.handlers), base.level, base.propagate,
+             vlog._configured, list(vlog._installed))
+    yield base
+    for h in base.handlers:
+        if h not in saved[0]:
+            h.close()
+    base.handlers, base.level, base.propagate = saved[0], saved[1], saved[2]
+    vlog._configured = saved[3]
+    vlog._installed = saved[4]
+
+
+class TestLoggerMixin:
+    def test_channel_name_includes_instance_name(self, fresh_logging):
+        class Thing(vlog.Logger):
+            name = "alpha"
+
+        t = Thing()
+        assert t.logger.name == "veles.Thing.alpha"
+
+    def test_convenience_methods_emit(self, fresh_logging, capsys):
+        vlog.setup_logging(level=logging.DEBUG)
+
+        class Thing(vlog.Logger):
+            pass
+
+        t = Thing()
+        t.info("hello %d", 7)
+        assert "hello 7" in capsys.readouterr().err
+
+
+class TestJsonLinesSink:
+    def test_events_written_as_json(self, fresh_logging, tmp_path):
+        path = tmp_path / "events.jsonl"
+        vlog.setup_logging(events_file=str(path))
+        logging.getLogger("veles.test").warning("disk %s full", "A")
+        lines = path.read_text().strip().splitlines()
+        event = json.loads(lines[-1])
+        assert event["level"] == "WARNING"
+        assert event["msg"] == "disk A full"
+        assert event["logger"] == "veles.test"
+        assert "t" in event
+
+
+class TestMongoSink:
+    def test_clear_error_without_pymongo(self, fresh_logging, monkeypatch):
+        monkeypatch.setitem(sys.modules, "pymongo", None)
+        with pytest.raises(RuntimeError, match="pymongo"):
+            vlog.MongoHandler("mongodb://localhost:27017")
+
+    def test_events_inserted_with_stub_client(self, fresh_logging,
+                                              monkeypatch):
+        inserted = []
+
+        class FakeColl:
+            def insert_one(self, doc):
+                inserted.append(doc)
+
+        class FakeDB(dict):
+            def __getitem__(self, name):
+                return FakeColl()
+
+        class FakeAdmin:
+            def command(self, name):
+                assert name == "ping"
+
+        class FakeClient:
+            def __init__(self, address, **kwargs):
+                self.address = address
+                assert kwargs.get("serverSelectionTimeoutMS", 0) <= 5000, \
+                    "unreachable servers must fail fast, not 30s per record"
+                self.admin = FakeAdmin()
+
+            def __getitem__(self, name):
+                return FakeDB()
+
+            def close(self):
+                pass
+
+        fake = types.ModuleType("pymongo")
+        fake.MongoClient = FakeClient
+        monkeypatch.setitem(sys.modules, "pymongo", fake)
+        vlog.setup_logging(events_mongo="mongodb://example:27017")
+        logging.getLogger("veles.test").error("boom")
+        deadline = time.time() + 2  # inserts drain on a background thread
+        while not inserted and time.time() < deadline:
+            time.sleep(0.01)
+        assert inserted and inserted[-1]["msg"] == "boom"
+        assert inserted[-1]["level"] == "ERROR"
+
+    def test_file_and_mongo_share_event_schema(self):
+        record = logging.LogRecord("veles.x", logging.INFO, __file__, 1,
+                                   "m", (), None)
+        event = vlog._event_dict(record)
+        assert set(event) == {"t", "level", "logger", "msg"}
+
+
+class TestReconfiguration:
+    def test_host_app_handlers_survive_setup(self, fresh_logging, tmp_path):
+        host = logging.FileHandler(str(tmp_path / "host.log"))
+        fresh_logging.addHandler(host)
+        try:
+            vlog.setup_logging()
+            assert host in fresh_logging.handlers
+            assert not host.stream.closed
+        finally:
+            fresh_logging.removeHandler(host)
+            host.close()
+
+    def test_reconfiguration_closes_our_previous_sinks(self, fresh_logging,
+                                                       tmp_path):
+        vlog.setup_logging(events_file=str(tmp_path / "a.jsonl"))
+        first = [h for h in vlog._installed
+                 if isinstance(h, vlog.JsonLinesHandler)][0]
+        vlog.setup_logging(events_file=str(tmp_path / "b.jsonl"))
+        assert first._file.closed
+        assert first not in fresh_logging.handlers
+
+
+class TestCliFlags:
+    def test_events_flags_parse(self):
+        from veles_tpu.__main__ import build_argparser
+        args = build_argparser().parse_args(
+            ["wf.py", "--events-file", "e.jsonl",
+             "--events-mongo", "mongodb://h:1"])
+        assert args.events_file == "e.jsonl"
+        assert args.events_mongo == "mongodb://h:1"

@@ -102,6 +102,13 @@ def build_argparser():
 
     parser.add_argument("--version", action=_Version, nargs=0,
                         help="print the framework version and exit")
+    parser.add_argument("--events-file", default=None, metavar="FILE",
+                        help="append structured log events (JSON lines) to "
+                             "FILE — the dependency-free form of the "
+                             "reference's mongo event sink")
+    parser.add_argument("--events-mongo", default=None, metavar="ADDR",
+                        help="stream structured log events to MongoDB at "
+                             "ADDR (mongodb://...; requires pymongo)")
     parser.add_argument("--evaluate", action="store_true",
                         help="evaluation-only: one pass over every "
                              "dataset split with weight updates gated "
@@ -175,6 +182,15 @@ def main(argv=None):
     from veles_tpu import prng
     from veles_tpu.config import root, parse_override
     from veles_tpu.launcher import Launcher
+
+    if args.events_file or args.events_mongo:
+        from veles_tpu.logger import setup_logging
+        try:
+            setup_logging(events_file=args.events_file,
+                          events_mongo=args.events_mongo)
+        except (RuntimeError, OSError) as e:
+            # missing pymongo / unreachable server / unwritable events file
+            parser.error(str(e))
 
     if args.random_seed is not None:
         prng.seed_all(args.random_seed)

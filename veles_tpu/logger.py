@@ -2,8 +2,10 @@
 
 Ref: veles/logger.py::Logger [H] (SURVEY §2.1): per-class log channels with
 ``self.info/debug/warning/error`` convenience methods and a colored console
-formatter.  The optional MongoDB event sink of the reference is replaced by an
-optional JSON-lines file sink (no mongo in this stack).
+formatter.  The reference's optional MongoDB event sink exists here too
+(``MongoHandler``, gated on ``pymongo`` being importable — it is not part of
+this image's stack, so the recommended structured sink is the dependency-free
+JSON-lines file sink; both record the same event dict).
 """
 
 from __future__ import annotations
@@ -32,8 +34,21 @@ class ColoredFormatter(logging.Formatter):
         return message
 
 
+def _event_dict(record):
+    """The one structured-event schema both sinks write.  ``t`` is the
+    moment the event was logged (record.created), not written — a slow
+    sink must not skew timestamps."""
+    return {
+        "t": record.created,
+        "level": record.levelname,
+        "logger": record.name,
+        "msg": record.getMessage(),
+    }
+
+
 class JsonLinesHandler(logging.Handler):
-    """Append-only structured event sink (stands in for the mongo sink)."""
+    """Append-only structured event sink (the recommended, dependency-free
+    stand-in for the reference's mongo sink)."""
 
     def __init__(self, path):
         super().__init__()
@@ -41,15 +56,86 @@ class JsonLinesHandler(logging.Handler):
 
     def emit(self, record):
         try:
-            self._file.write(json.dumps({
-                "t": time.time(),
-                "level": record.levelname,
-                "logger": record.name,
-                "msg": record.getMessage(),
-            }) + "\n")
+            self._file.write(json.dumps(_event_dict(record)) + "\n")
             self._file.flush()
         except Exception:  # pragma: no cover - never raise from logging
             self.handleError(record)
+
+    def close(self):
+        try:
+            self._file.close()
+        finally:
+            super().close()
+
+
+class MongoHandler(logging.Handler):
+    """MongoDB event sink — parity with the reference's optional mongo
+    backend (ref: veles/logger.py [H], ``--log-mongo`` style address).
+
+    Gated: requires ``pymongo`` (NOT in this image's baked stack — the
+    handler raises a clear error at construction, never at log time, if
+    the package is absent).  Events use the same dict schema as the
+    JSON-lines sink, inserted into ``<db>.events``.
+    """
+
+    def __init__(self, address, db="veles", collection="events",
+                 timeout_ms=2000):
+        super().__init__()
+        try:
+            import pymongo
+        except ImportError as e:
+            raise RuntimeError(
+                "MongoDB log sink requires the 'pymongo' package, which is "
+                "not installed in this environment; use the JSON-lines "
+                "events file sink instead (setup_logging(events_file=...))"
+            ) from e
+        # Short server-selection timeout: an unreachable server must not
+        # stall every log call for pymongo's 30 s default inside the
+        # logging lock.  The ping surfaces bad addresses here, where the
+        # docstring promises construction-time errors.
+        self._client = pymongo.MongoClient(
+            address, serverSelectionTimeoutMS=timeout_ms)
+        try:
+            self._client.admin.command("ping")
+        except Exception as e:
+            self._client.close()
+            raise RuntimeError(
+                "MongoDB log sink cannot reach %s: %s" % (address, e)) from e
+        self._coll = self._client[db][collection]
+        # Inserts drain on a daemon thread: a mid-run server outage must
+        # not block log calls (emit holds the logging handler lock).
+        import queue
+        import threading
+        self._queue = queue.SimpleQueue()
+        self._closed = False
+        self._drain = threading.Thread(target=self._drain_loop, daemon=True)
+        self._drain.start()
+
+    def _drain_loop(self):
+        while True:
+            event = self._queue.get()
+            if event is None:
+                return
+            try:
+                self._coll.insert_one(event)
+            except Exception:  # pragma: no cover - sink outage: drop event
+                pass
+
+    def emit(self, record):
+        try:
+            self._queue.put(_event_dict(record))
+        except Exception:  # pragma: no cover - never raise from logging
+            self.handleError(record)
+
+    def close(self):
+        try:
+            if not self._closed:
+                self._closed = True
+                self._queue.put(None)
+                self._drain.join(timeout=2)
+                self._client.close()
+        finally:
+            super().close()
 
 
 #: all framework loggers live under this namespace so configuring them never
@@ -57,18 +143,33 @@ class JsonLinesHandler(logging.Handler):
 NAMESPACE = "veles"
 
 _configured = False
+#: handlers setup_logging itself installed — the only ones it may close on
+#: reconfiguration (a host application's own handlers are never touched)
+_installed = []
 
 
-def setup_logging(level=logging.INFO, events_file=None):
-    """Configure the framework's logger namespace (NOT the root logger)."""
-    global _configured
+def setup_logging(level=logging.INFO, events_file=None, events_mongo=None):
+    """Configure the framework's logger namespace (NOT the root logger).
+
+    ``events_file`` adds the JSON-lines sink; ``events_mongo`` (a
+    ``mongodb://`` address) adds the gated Mongo sink — both may be given.
+    """
+    global _configured, _installed
     base = logging.getLogger(NAMESPACE)
+    for old in _installed:  # close OUR previous sinks, never the host
+        if old in base.handlers:  # app's own handlers on this namespace
+            base.removeHandler(old)
+            old.close()
     handler = logging.StreamHandler(sys.stderr)
     handler.setFormatter(ColoredFormatter(
         "%(asctime)s %(levelname).1s %(name)s: %(message)s", "%H:%M:%S"))
-    base.handlers = [handler]
+    _installed = [handler]
     if events_file:
-        base.addHandler(JsonLinesHandler(events_file))
+        _installed.append(JsonLinesHandler(events_file))
+    if events_mongo:
+        _installed.append(MongoHandler(events_mongo))
+    for h in _installed:
+        base.addHandler(h)
     base.setLevel(level)
     base.propagate = False
     _configured = True
