@@ -210,13 +210,28 @@ def timed_window(dispatch, target_seconds, initial=1):
         n = max(n * 2, int(n * 1.3 * target_seconds / max(elapsed, 1e-3)))
 
 
+#: epochs folded into ONE device program by the timing path — through a
+#: tunnel each jit call is a synchronous execute RPC (~0.1-1 s observed),
+#: so per-epoch dispatch would dominate small models' timings; the chunk
+#: pays that RPC once per CHUNK_EPOCHS epochs (compiled.epoch_chunk_fn).
+#: On CPU (--smoke) dispatch is ~free and fp32-HIGHEST convs are slow, so
+#: chunking would only multiply the warm-up cost — use 1 there.
+CHUNK_EPOCHS = 8
+
+
+def _chunk_epochs():
+    import jax
+    return 1 if jax.default_backend() == "cpu" else CHUNK_EPOCHS
+
+
 def bench_epoch_scan(wf, target_seconds=4.0):
-    """Steady-state samples/sec via the one-dispatch-per-epoch scan path.
+    """Steady-state samples/sec via the epoch-scan path, dispatched in
+    chunks of epochs so the per-execute round-trip amortizes.
 
     Returns (samples_per_sec, steps_per_epoch, step_time_us)."""
-    import jax
     runner = wf._fused_runner
-    train_epoch, _ = runner.epoch_fns()
+    chunk_epochs = _chunk_epochs()
+    chunk = runner.epoch_chunk_fn(chunk_epochs)
     loader = wf.loader
     data = loader.original_data.devmem
     labels = loader.original_labels.devmem
@@ -226,31 +241,29 @@ def bench_epoch_scan(wf, target_seconds=4.0):
     from veles_tpu import prng
     rng = prng.get("dropout").key() if runner._has_stochastic else None
 
-    def run_epochs(state, n, step0):
-        for e in range(n):
-            # distinct dropout stream per epoch: _epoch_train folds the key
-            # by LOCAL step only, so the base key must differ across epochs
-            epoch_rng = (jax.random.fold_in(rng, step0 + e * steps_per_epoch)
-                         if rng is not None else None)
-            state, totals = train_epoch(state, data, labels, idx, mask,
-                                        rng=epoch_rng,
-                                        step0=step0 + e * steps_per_epoch)
+    def run_chunks(state, n, step0):
+        for c in range(n):
+            state, totals = chunk(state, data, labels, idx, mask, rng=rng,
+                                  step0=step0 + c * chunk_epochs
+                                  * steps_per_epoch)
         return state, totals
 
-    # warm-up epoch (compile) — must also end in a fetch
+    # warm-up chunk (compile) — must also end in a fetch
     holder = {"state": runner.state}
-    state, totals = run_epochs(holder["state"], 1, 0)
+    state, totals = run_chunks(holder["state"], 1, 0)
     _sync(totals)
     holder["state"] = state
 
     def dispatch(n, done):
-        state, totals = run_epochs(holder["state"], n,
-                                   (done + 1) * steps_per_epoch)
+        state, totals = run_chunks(holder["state"], n,
+                                   (done + 1) * chunk_epochs
+                                   * steps_per_epoch)
         _sync(totals)
         holder["state"] = state
 
-    epochs, elapsed = timed_window(dispatch, target_seconds)
+    chunks, elapsed = timed_window(dispatch, target_seconds)
     runner.state = holder["state"]
+    epochs = chunks * chunk_epochs
     sps = epochs * n_samples / elapsed
     step_us = elapsed / (epochs * steps_per_epoch) * 1e6
     return sps, steps_per_epoch, step_us
@@ -925,6 +938,26 @@ def probe_device(timeout_s=None):
     return bool(probe_ok)
 
 
+class _StreamingResults(dict):
+    """Worker-side results dict that (when VELES_BENCH_STREAM=1, set by
+    the orchestrator) emits each completed record to stdout the moment it
+    lands, as a ``{"partial": {...}}`` JSON line.  Round-5 lesson: the
+    cifar worker measured cifar_conv, then hung on the bf16 leg, and the
+    watchdog kill discarded the good record with the bad — partials let
+    the orchestrator keep everything measured before a hang."""
+
+    def _stream(self, payload):
+        if os.environ.get("VELES_BENCH_STREAM") == "1":
+            print(json.dumps({"partial": payload}), flush=True)
+
+    def __setitem__(self, key, value):
+        super().__setitem__(key, value)
+        self._stream({key: value})
+
+    def stream_all(self):
+        self._stream(dict(self))
+
+
 def run_configs(wanted, args):
     """Run the wanted bench configs in THIS process; returns the results
     dict (per-config records and/or ``<name>_error`` entries)."""
@@ -956,13 +989,17 @@ def run_configs(wanted, args):
                          "TPU tunnel unreachable"}
 
     device_kind, peak = _peak_tflops()
-    results = {}
+    results = _StreamingResults()
 
     def guarded(section, fn):
         """One config blowing up must not zero the whole bench record."""
         import traceback
         try:
             fn()
+            # re-stream the whole dict: records grow in place after their
+            # first assignment (floors, parity sub-records), and the
+            # orchestrator's partial collection must see the final shape
+            results.stream_all()
         except Exception:
             traceback.print_exc()
             results[section + "_error"] = traceback.format_exc()[-800:]
@@ -1334,6 +1371,30 @@ def emit_summary(results):
     return 0
 
 
+def collect_worker_output(stdout_bytes):
+    """Merge every parseable worker stdout line: ``partial`` lines stream
+    in as records complete (kept even when the worker is later killed);
+    the final ``results`` line, when present, wins.  Returns
+    (records_dict, saw_final_line)."""
+    got = {}
+    final = None
+    for raw in (stdout_bytes or b"").decode(errors="replace").splitlines():
+        raw = raw.strip()
+        if not raw.startswith("{"):
+            continue
+        try:
+            obj = json.loads(raw)
+        except ValueError:
+            continue
+        if "partial" in obj:
+            got.update(obj["partial"])
+        elif "results" in obj:
+            final = obj["results"]
+    if final is not None:
+        got.update(final)
+    return got, final is not None
+
+
 def orchestrate(wanted, args, argv):
     """Run each config in its own subprocess under a hard deadline.
 
@@ -1349,6 +1410,10 @@ def orchestrate(wanted, args, argv):
     import subprocess
     per_config = float(os.environ.get(
         "VELES_BENCH_CONFIG_TIMEOUT_S", 300 if args.smoke else 1500))
+    # total seconds the run may spend WAITING for a wedged relay to
+    # release its claim (a killed-mid-claim client wedges it until the
+    # grant timeout) before remaining device configs are skipped
+    recover_budget = float(os.environ.get("VELES_BENCH_RECOVER_S", 1800))
     # configs that never touch the device (host pipeline; the native
     # runner pins its worker to cpu): they still run — and still produce
     # records — when the tunnel is dead, so a dead-tunnel bench degrades
@@ -1356,14 +1421,52 @@ def orchestrate(wanted, args, argv):
     host_only = {"records", "native"}
     results = {}
     tunnel_dead = False
+
+    def probe_ok():
+        """Probe in a subprocess (the parent never imports jax).  The
+        probe worker's deadline is pinned via the env var so the parent's
+        subprocess timeout is always the longer one, and any probe
+        failure mode just means 'treat the tunnel as dead'."""
+        try:
+            env = dict(os.environ, VELES_BENCH_PROBE_S="120")
+            probe = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--worker", "__probe__"] + argv,
+                stdout=subprocess.PIPE, timeout=180, env=env, check=False)
+            out = (probe.stdout.decode(errors="replace")
+                   .strip().splitlines())
+            return bool(out and json.loads(out[-1]).get("ok"))
+        except Exception:
+            return False
+
     for name in wanted:
+        if tunnel_dead and name not in host_only:
+            # wait out the relay grant timeout while budget remains —
+            # round-5 lesson: one hung config used to forfeit every
+            # remaining device record even though the relay recovers
+            while recover_budget > 0:
+                begin = time.time()
+                if probe_ok():
+                    recover_budget -= time.time() - begin
+                    tunnel_dead = False
+                    break
+                recover_budget -= time.time() - begin
+                pause = min(120.0, recover_budget)
+                if pause <= 0:
+                    break
+                print("[bench] relay wedged; retrying probe in %.0fs "
+                      "(%.0fs recovery budget left)" % (pause,
+                                                        recover_budget),
+                      file=sys.stderr)
+                time.sleep(pause)
+                recover_budget -= pause
         if tunnel_dead and name not in host_only:
             results[name + "_error"] = ("skipped: device unreachable "
                                         "after an earlier config hung")
             continue
         cmd = [sys.executable, os.path.abspath(__file__),
                "--worker", name] + argv
-        env = dict(os.environ)
+        env = dict(os.environ, VELES_BENCH_STREAM="1")
         if name in host_only:
             # cpu-pinned worker: the host-side config must not claim (or
             # hang on) the one-client-at-a-time tunnel — for 'native'
@@ -1378,36 +1481,21 @@ def orchestrate(wanted, args, argv):
         try:
             proc = subprocess.run(cmd, stdout=subprocess.PIPE,
                                   timeout=per_config, env=env)
-            line = proc.stdout.decode(errors="replace").strip().splitlines()
-            got = (json.loads(line[-1])["results"] if line
-                   else {name + "_error":
-                         "worker produced no output (rc=%s)"
-                         % proc.returncode})
+            got, complete = collect_worker_output(proc.stdout)
+            if not got and not complete:
+                got = {name + "_error":
+                       "worker produced no output (rc=%s)"
+                       % proc.returncode}
             if "error" in got:   # in-worker probe never came back
                 got = {name + "_error": got.pop("error"), **got}
                 tunnel_dead = True
             results.update(got)
-        except subprocess.TimeoutExpired:
+        except subprocess.TimeoutExpired as exc:
+            got, _ = collect_worker_output(exc.stdout)  # keep pre-hang records
+            results.update(got)
             results[name + "_error"] = ("killed after %.0fs (hung device "
                                         "dispatch/compile)" % per_config)
-            # a killed-mid-claim client can wedge the relay for a while;
-            # don't hang every remaining config behind the same wall.
-            # The probe worker's deadline is pinned via the env var so the
-            # parent's subprocess timeout is always the longer one (an
-            # operator-set VELES_BENCH_PROBE_S must not outlive it), and
-            # any probe failure mode just means "treat the tunnel as dead".
-            try:
-                env = dict(os.environ, VELES_BENCH_PROBE_S="120")
-                probe = subprocess.run(
-                    [sys.executable, os.path.abspath(__file__),
-                     "--worker", "__probe__"] + argv,
-                    stdout=subprocess.PIPE, timeout=180, env=env,
-                    check=False)
-                out = (probe.stdout.decode(errors="replace")
-                       .strip().splitlines())
-                tunnel_dead = not (out and json.loads(out[-1]).get("ok"))
-            except Exception:
-                tunnel_dead = True
+            tunnel_dead = True
         except Exception as exc:   # worker crash / bad output
             results[name + "_error"] = "worker failed: %r" % (exc,)
     return results

@@ -112,6 +112,38 @@ def test_emit_summary_priority_and_fallbacks():
     assert rc == 1 and rec["metric"] == "bench_failed"
 
 
+def test_worker_streams_partials_and_collect_merges():
+    """Workers stream each completed record as a {"partial": ...} line
+    (VELES_BENCH_STREAM=1) so a later watchdog kill cannot discard
+    already-measured records; collect_worker_output merges partials and
+    lets the final results line win."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", VELES_BENCH_STREAM="1")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    proc = subprocess.run(
+        [sys.executable, BENCH, "--worker", "records", "--smoke",
+         "--seconds", "0.2"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env,
+        cwd=REPO, timeout=300)
+    lines = [ln for ln in proc.stdout.decode().splitlines()
+             if ln.startswith("{")]
+    partials = [json.loads(ln) for ln in lines if "partial" in ln]
+    assert partials, "worker emitted no partial lines"
+    assert any("records_pipeline" in p["partial"] for p in partials)
+
+    import importlib.util
+    spec = importlib.util.spec_from_file_location("bench_mod3", BENCH)
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    # full output: the final results line wins
+    got, complete = bench.collect_worker_output(proc.stdout)
+    assert complete and got["records_pipeline"]["samples_per_sec"] > 0
+    # truncated output (simulated kill mid-worker): partials survive
+    cut = proc.stdout[:proc.stdout.rfind(b'{"worker"')]
+    got, complete = bench.collect_worker_output(cut)
+    assert not complete
+    assert got["records_pipeline"]["samples_per_sec"] > 0
+
+
 def test_dead_tunnel_degrades_to_host_records():
     """A dead tunnel must NOT zero the bench (round-4 failure mode):
     device configs record unreachable-errors, but host-side configs

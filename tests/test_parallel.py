@@ -199,6 +199,55 @@ def test_epoch_scan_matches_per_step_loop():
                 rtol=2e-5, atol=2e-6)
 
 
+def test_epoch_chunk_matches_sequential_epochs():
+    """epoch_chunk_fn(k) — k epochs in ONE device program (the dispatch
+    amortization the bench times through the tunnel) — must equal k
+    sequential train_epoch calls, including the per-epoch key folding by
+    global step offset."""
+    prng.reset(); prng.seed_all(13)
+    wf = _build(mb=64)
+    runner = wf._fused_runner
+    loader = wf.loader
+    data = loader.original_data.devmem
+    labels = loader.original_labels.devmem
+    from veles_tpu.loader.base import TRAIN
+    loader._plan_epoch()
+    idx = numpy.stack([c for cls, c, a in loader._order if cls == TRAIN])
+    mask = numpy.stack([
+        (numpy.arange(len(c)) < a).astype(numpy.float32)
+        for cls, c, a in loader._order if cls == TRAIN])
+    steps = idx.shape[0]
+    base = jax.random.PRNGKey(7)
+
+    # sequential: two train_epoch calls, base key folded by global offset
+    # (real copy: train_epoch donates, and the chunk leg needs the
+    # original buffers afterwards)
+    train_epoch, _ = runner.epoch_fns()
+    state_a = jax.tree.map(jax.numpy.array, runner.state)
+    for e in range(2):
+        off = e * steps
+        state_a, totals_a = train_epoch(
+            state_a, data, labels, idx, mask,
+            rng=jax.random.fold_in(base, off), step0=off)
+
+    # chunked: one dispatch, k=2
+    chunk = runner.epoch_chunk_fn(2)
+    state_b, stacked = chunk(runner.state, data, labels, idx, mask,
+                             rng=base, step0=0)
+    for ea, eb in zip(state_a, state_b):
+        for key in ea:
+            numpy.testing.assert_allclose(
+                numpy.asarray(ea[key]), numpy.asarray(eb[key]),
+                rtol=2e-5, atol=2e-6)
+    # stacked metrics: one row per epoch; row 1 equals the sequential
+    # second epoch's totals
+    for key in totals_a:
+        assert numpy.asarray(stacked[key]).shape[0] == 2
+        numpy.testing.assert_allclose(
+            numpy.asarray(stacked[key][1]), numpy.asarray(totals_a[key]),
+            rtol=2e-5, atol=2e-6)
+
+
 def test_loader_host_sharding_composes_with_mesh():
     """Multi-host story: each process takes a strided shard; union of shards
     covers the dataset exactly once (replaces index shipping)."""

@@ -16,12 +16,16 @@ def tiny_config():
     root.char_lm.update({
         "loader": {"minibatch_size": 32, "n_train": 128, "n_valid": 64,
                    "seq_len": 32, "vocab": 16},
-        # n_experts/pipeline_stages pinned to 0: root is process-global and
-        # update() merges — without explicit zeros, a previous test's MoE/PP
-        # settings would silently leak into later "dense sequential" runs
+        # every optional knob pinned to its default: root is process-global
+        # and update() merges — without explicit resets, a previous test's
+        # MoE/PP/rope/window settings would silently leak into later
+        # "dense sequential" runs (PP rejects rope/window, so a leaked
+        # rope=True breaks unrelated pipeline tests)
         "trainer": {"vocab": 16, "d_model": 32, "n_heads": 2, "n_layers": 1,
                     "max_len": 32, "learning_rate": 3e-3,
-                    "n_experts": 0, "pipeline_stages": 0, "remat": False},
+                    "n_experts": 0, "pipeline_stages": 0, "remat": False,
+                    "rope": False, "window": None, "attn_sinks": 0,
+                    "n_kv_heads": None},
         "decision": {"max_epochs": 4, "fail_iterations": 10},
     })
 
