@@ -331,6 +331,69 @@ def test_sharded_epoch_scan_matches_per_step_spmd():
                                       numpy.ravel(per[k]), rtol=1e-5)
 
 
+def test_sharded_train_epochs_chunk_matches_sequential():
+    """ShardedTrainer.train_epochs — k epochs with per-epoch shuffled
+    plans in ONE dispatch under the mesh (incl. a TP layer) — equals k
+    sequential train_epoch calls on the same plans."""
+    from veles_tpu.loader.base import TRAIN
+
+    def plan(loader):
+        loader._plan_epoch()
+        idx = numpy.stack([c for cls, c, a in loader._order
+                           if cls == TRAIN])
+        mask = numpy.stack([
+            (numpy.arange(len(c)) < a).astype(numpy.float32)
+            for cls, c, a in loader._order if cls == TRAIN])
+        return idx, mask
+
+    mesh = make_mesh(8, model_parallel=2)
+
+    def two_plans(loader):
+        i0, m0 = plan(loader)
+        i1, m1 = plan(loader)   # re-plan => independently shuffled epoch
+        assert not numpy.array_equal(i0, i1)
+        return (numpy.stack([i0, i1]), numpy.stack([m0, m1]))
+
+    # sequential: two train_epoch dispatches
+    prng.reset(); prng.seed_all(23)
+    wf_a = _build(mb=64)
+    trainer_a = ShardedTrainer(wf_a._fused_runner, mesh,
+                               model_shard_layers=(0,))
+    data = numpy.asarray(wf_a.loader.original_data.mem)
+    labels = numpy.asarray(wf_a.loader.original_labels.mem)
+    idx3, mask3 = two_plans(wf_a.loader)
+    steps = idx3.shape[1]
+    trainer_a.place_dataset(data, labels)
+    for e in range(2):
+        totals_a = trainer_a.train_epoch(idx3[e], mask3[e],
+                                         step0=e * steps)
+
+    # chunked: one dispatch with the same two plans
+    prng.reset(); prng.seed_all(23)
+    wf_b = _build(mb=64)
+    trainer_b = ShardedTrainer(wf_b._fused_runner, mesh,
+                               model_shard_layers=(0,))
+    idx3_b, mask3_b = two_plans(wf_b.loader)
+    numpy.testing.assert_array_equal(idx3, idx3_b)
+    trainer_b.place_dataset(data, labels)
+    stacked = trainer_b.train_epochs(idx3_b, mask3_b, step0=0)
+    assert trainer_b.step_count == 2 * steps
+
+    for ea, eb in zip(trainer_a.state, trainer_b.state):
+        for key in ea:
+            numpy.testing.assert_allclose(
+                numpy.asarray(ea[key]), numpy.asarray(eb[key]),
+                rtol=2e-5, atol=2e-6)
+    assert not trainer_b.state[0]["w"].sharding.is_fully_replicated
+    # stacked row 1 == the sequential second epoch's totals
+    host = ShardedTrainer.fetch(stacked)
+    host_a = ShardedTrainer.fetch(totals_a)
+    for k in host:
+        assert numpy.asarray(host[k]).shape[0] == 2
+        numpy.testing.assert_allclose(numpy.ravel(host[k][1]),
+                                      numpy.ravel(host_a[k]), rtol=1e-5)
+
+
 def test_epoch_scan_requires_divisible_minibatch():
     prng.reset(); prng.seed_all(17)
     wf = _build(mb=64)

@@ -274,20 +274,33 @@ class FusedRunner:
         global step, pinned by tests) while paying the host->device
         dispatch round-trip once per chunk instead of once per epoch —
         the knob that matters when the link to the device is a tunnel
-        with ~0.1-1 s per-execute latency."""
+        with ~0.1-1 s per-execute latency.
+
+        ``idx``/``mask`` of shape (B, mb) reuse ONE minibatch plan for
+        every epoch in the chunk; shape (k, B, mb) gives each epoch its
+        own plan (true per-epoch reshuffling, precomputed on the host),
+        so chunking does not have to trade away shuffle-per-epoch SGD
+        semantics."""
         import jax
         import jax.numpy as jnp
-        steps = idx.shape[0]
+        per_epoch_plan = idx.ndim == 3
+        steps = idx.shape[-2]
 
-        def body(carry, e):
+        def body(carry, xs):
+            if per_epoch_plan:
+                e, eidx, emask = xs
+            else:
+                e, eidx, emask = xs, idx, mask
             off = step0 + e * steps
             erng = (jax.random.fold_in(rng, off)
                     if rng is not None else None)
-            carry, totals = self._epoch_train(carry, data, labels, idx,
-                                              mask, erng, off)
+            carry, totals = self._epoch_train(carry, data, labels, eidx,
+                                              emask, erng, off)
             return carry, totals
 
-        state, stacked = jax.lax.scan(body, state, jnp.arange(k))
+        xs = ((jnp.arange(k), idx, mask) if per_epoch_plan
+              else jnp.arange(k))
+        state, stacked = jax.lax.scan(body, state, xs)
         return state, stacked
 
     def epoch_chunk_fn(self, k):
@@ -306,6 +319,10 @@ class FusedRunner:
             def chunk(state, data, labels, idx, mask, rng=None, step0=0):
                 import jax.numpy as jnp
                 self.require_epoch_rng(rng)
+                if idx.ndim == 3 and idx.shape[0] != k:
+                    raise ValueError(
+                        "per-epoch plan has %d epochs, chunk is %d"
+                        % (idx.shape[0], k))
                 return inner(state, data, labels, idx, mask, rng,
                              jnp.asarray(step0, jnp.int32))
 
