@@ -1,77 +1,82 @@
-"""Convergence runs for BASELINE.md rows 0-2: train MNIST-FC and CIFAR
-to Decision-complete with pinned seeds, record final val-acc + samples/s.
+"""Convergence runs for BASELINE.md rows 0-1: train MNIST-FC and CIFAR
+at FULL dataset size with pinned seeds, record final val-acc + wall.
 
-Usage: python tools/convergence.py [mnist] [cifar]
+Usage: python tools/convergence.py [mnist] [cifar] [cifar_bf16]
 Prints one summary line per config:
   <config>: best val_err <n>/<N> (<pct>%), ..., @<git-sha>
 
-Protocol (BASELINE.md): fixed seed; train to the sample's stopping
-criterion (Decision-complete); wall time covers the whole run.
+Protocol (BASELINE.md): fixed seed; train until no val improvement for
+``patience`` epochs (the sample Decision's criterion); wall time covers
+the whole run.  Runs the SAME pure step functions the Decision-driven
+unit graph runs, via bench.bench_convergence's epoch-scan path — through
+the TPU tunnel an execute RPC costs ~0.1-1 s, so the per-minibatch graph
+path (600 RPCs/epoch) would take hours where epoch-scan takes minutes;
+numerics are identical by construction (compiled.py composes one set of
+step fns for both paths, pinned by tests/test_parallel.py).
 """
 import argparse
 import os
 import subprocess
+import sys
 import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
 
 
 def git_sha():
     try:
         return subprocess.check_output(
             ["git", "rev-parse", "--short", "HEAD"],
-            cwd=os.path.dirname(os.path.dirname(
-                os.path.abspath(__file__)))).decode().strip()
+            cwd=REPO).decode().strip()
     except Exception:
         return "unknown"
 
 
-def run_config(name, seed=1):
-    from veles_tpu import prng
-    from veles_tpu.config import root
-    prng.reset()
-    prng.seed_all(seed)
+def run_config(name, seed=1, max_epochs=25, patience=8):
+    import bench
+
     if name == "mnist":
-        root.__dict__.pop("mnist", None)
-        root.mnist.update({
-            "loader": {"minibatch_size": 100, "n_train": 60000,
-                       "n_valid": 10000},
-            "decision": {"max_epochs": 25, "fail_iterations": 10},
-        })
-        from veles_tpu.samples import mnist as sample
+        build = lambda: bench.build_mnist(60000, 10000, 100)  # noqa: E731
     elif name == "cifar":
-        root.__dict__.pop("cifar", None)
-        root.cifar.update({
-            "loader": {"minibatch_size": 100, "n_train": 50000,
-                       "n_valid": 10000},
-            "decision": {"max_epochs": 25, "fail_iterations": 10},
-        })
-        from veles_tpu.samples import cifar as sample
+        build = lambda: bench.build_cifar(50000, 10000, 100)  # noqa: E731
+    elif name == "cifar_bf16":
+        def build():
+            from veles_tpu.ops import functional as F
+            F.set_matmul_precision("bfloat16")
+            return bench.build_cifar(50000, 10000, 100)
     else:
         raise SystemExit("unknown config %r" % name)
 
     begin = time.perf_counter()
-    wf = sample.train(fused=True)
+    try:
+        rec = bench.bench_convergence(build, max_epochs=max_epochs,
+                                      patience=patience)
+    finally:
+        if name.endswith("_bf16"):
+            from veles_tpu.ops import functional as F
+            F.set_matmul_precision("float32")
     wall = time.perf_counter() - begin
-    hist = [m["validation"] for m in wf.decision.epoch_metrics
-            if "validation" in m]
-    best = wf.decision.best_metric
-    count = hist[-1]["count"]
-    epochs = int(wf.loader.epoch_number)
-    n_train = wf.loader.class_lengths[2]
-    sps = epochs * n_train / wall   # incl. eval epochs: LOWER bound
     import jax
-    print("%s: best val_err %d/%d (%.2f%%), %d epochs, "
-          "%.0f samples/s overall, %.1fs wall, device=%s, seed=%d, @%s"
-          % (name, best, count, 100.0 * best / count, epochs, sps, wall,
+    print("%s: best val_err %s/%d (%.2f%%), best@%d of %d epochs, "
+          "%.1fs wall, device=%s, seed=%d, @%s"
+          % (name, rec.get("best_val_err"), rec["val_count"],
+             rec.get("best_val_err_pct", float("nan")),
+             rec["best_epoch"], rec["epochs_run"], wall,
              jax.devices()[0].device_kind, seed, git_sha()), flush=True)
-    return wf
+    return rec
 
 
 def main():
     parser = argparse.ArgumentParser()
-    parser.add_argument("configs", nargs="*", default=["mnist", "cifar"])
+    parser.add_argument("configs", nargs="*",
+                        default=["mnist", "cifar", "cifar_bf16"])
+    parser.add_argument("--max-epochs", type=int, default=25)
+    parser.add_argument("--patience", type=int, default=8)
     args = parser.parse_args()
-    for name in (args.configs or ["mnist", "cifar"]):
-        run_config(name)
+    for name in (args.configs or ["mnist", "cifar", "cifar_bf16"]):
+        run_config(name, max_epochs=args.max_epochs,
+                   patience=args.patience)
 
 
 if __name__ == "__main__":
