@@ -508,7 +508,8 @@ def bench_lm(smoke=False, iters=None):
     # attention-backend comparison: the bundled TPU Pallas flash kernel
     # vs XLA's fused attention on the SAME train step (TPU only — the
     # kernel has no CPU lowering); the winner would keep the default
-    if jax.default_backend() != "tpu":
+    from veles_tpu.ops.pallas_kernels import on_tpu
+    if not on_tpu():
         pass                                  # kernel has no CPU lowering
     elif seq % 128:
         # the bundled kernel's default blocks are 128-wide; a short

@@ -13,14 +13,20 @@ and first-compiles are 20-40s.
 
 import os
 
+#: VELES_TEST_TPU=1 leaves the platform alone so TPU-only tests (the
+#: Pallas PRNG kernels) can run against the real device once per round;
+#: everything else in the suite stays CPU-mesh as documented above.
+_tpu_mode = os.environ.get("VELES_TEST_TPU", "0") not in ("", "0")
+
 _flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
+if not _tpu_mode and "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if not _tpu_mode:
+    jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -159,14 +159,17 @@ class TestFlashPallasBackend:
         """No silent fallback: off-TPU the kernel must refuse, not
         quietly compute something else."""
         from veles_tpu.ops import attention as A
-        if jax.default_backend() == "tpu":
+        from veles_tpu.ops.pallas_kernels import on_tpu
+        if on_tpu():
             pytest.skip("on-TPU: covered by the parity test")
         q = jnp.zeros((1, 2, 128, 64), jnp.float32)
         with pytest.raises(RuntimeError, match="TPU"):
             A.flash_attention_tpu(q, q, q)
 
-    @pytest.mark.skipif(jax.default_backend() != "tpu",
-                        reason="the bundled kernel has no CPU lowering")
+    @pytest.mark.skipif(
+        not __import__("veles_tpu.ops.pallas_kernels",
+                       fromlist=["on_tpu"]).on_tpu(),
+        reason="the bundled kernel has no CPU lowering")
     def test_matches_xla_attention_on_tpu(self):
         from veles_tpu.ops import attention as A
         key = jax.random.PRNGKey(0)

@@ -204,7 +204,8 @@ class TestArtifactRunner:
         assert manifest["output_shape"] == [4, 10]
 
     @pytest.mark.skipif(
-        __import__("jax").default_backend() != "tpu",
+        not __import__("veles_tpu.ops.pallas_kernels",
+                       fromlist=["on_tpu"]).on_tpu(),
         reason="full compile+execute needs a real PJRT device")
     def test_execute_on_device(self, runner_bin, tmp_path):
         import subprocess

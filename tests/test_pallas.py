@@ -97,7 +97,7 @@ class TestPallasDropout:
         numpy.testing.assert_array_equal(numpy.asarray(PK.dropout(x, 1, 0.0)),
                                          numpy.asarray(x))
 
-    @pytest.mark.skipif(jax.default_backend() != "tpu",
+    @pytest.mark.skipif(not PK.on_tpu(),
                         reason="real-kernel path needs the TPU PRNG")
     @pytest.mark.parametrize("rate", [0.3, 0.5, 0.7])
     def test_real_kernel_statistics(self, rate):

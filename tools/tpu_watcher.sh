@@ -20,6 +20,13 @@ while true; do
         timeout 3600 python tools/convergence.py \
             >convergence_r5_tpu.out 2>convergence_r5_tpu.err
         echo "[watcher] convergence rc=$? at $(date -u +%FT%TZ)"
+        # the 3 TPU-only Pallas PRNG kernel tests (skip off-hardware):
+        # run them once on the real device (VERDICT r4 task 3).
+        # VELES_TEST_TPU=1 tells conftest to leave the platform alone.
+        VELES_TEST_TPU=1 timeout 1200 python -m pytest \
+            tests/test_pallas.py -q -rs \
+            >pallas_tpu_r5.out 2>&1
+        echo "[watcher] pallas-tpu rc=$? at $(date -u +%FT%TZ)"
         exit 0
     fi
     echo "[watcher] tunnel dead at $(date -u +%FT%TZ)"

@@ -166,7 +166,8 @@ def flash_attention_tpu(q, k, v, causal=True):
     convention (1/√dh) applied via sm_scale.  TPU-only — the kernel has
     no interpret-mode escape hatch, so off-TPU callers get a loud error
     instead of a silent fallback."""
-    if jax.default_backend() != "tpu":
+    from veles_tpu.ops.pallas_kernels import on_tpu
+    if not on_tpu():
         raise RuntimeError("flash_attention_tpu needs a TPU backend "
                            "(the bundled Pallas kernel has no CPU "
                            "lowering); use attention/blockwise_attention")

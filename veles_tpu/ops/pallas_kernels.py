@@ -23,10 +23,25 @@ import jax
 import jax.numpy as jnp
 
 
+def on_tpu():
+    """True when the default backend executes on TPU hardware.  Checks
+    the device kind as well as the platform name: under a tunneling PJRT
+    plugin (this image's 'axon') ``jax.default_backend()`` reports the
+    PLUGIN's name, not 'tpu', while the devices are real TPU chips —
+    gating on the platform name alone would silently run every Pallas
+    kernel in interpret mode on hardware."""
+    if jax.default_backend() == "tpu":
+        return True
+    try:
+        return "TPU" in jax.devices()[0].device_kind
+    except Exception:
+        return False
+
+
 def _interpret(flag):
     if flag is not None:
         return flag
-    return jax.default_backend() != "tpu"
+    return not on_tpu()
 
 
 # ---------------------------------------------------------- fused SGD update
