@@ -383,7 +383,6 @@ def bench_convergence(build_fn, max_epochs=15, patience=5):
     wf = build_fn()
     runner = wf._fused_runner
     metric = "mse" if runner._is_mse else "n_err"
-    train_epoch, eval_epoch = runner.epoch_fns()
     loader = wf.loader
     data = loader.original_data.devmem
     # MSE/AE workflows reconstruct the input: the scan's target is the
@@ -394,35 +393,51 @@ def bench_convergence(build_fn, max_epochs=15, patience=5):
     n_valid = int(vmask.sum())
     rng = prng.get("dropout").key() if runner._has_stochastic else None
 
+    # train-k-epochs + per-epoch eval in ONE program: through the tunnel
+    # each execute costs ~0.4 s, so the per-epoch (2 RPC/epoch) loop pays
+    # 2k RPCs where this pays 1 per chunk; per-epoch val metrics come
+    # back stacked so the early-stop decisions are IDENTICAL, just
+    # evaluated in k-epoch batches (at most k-1 extra epochs trained
+    # past the stopping point, never a different best)
+    k = _chunk_epochs()
+    chunk_eval = runner.epoch_chunk_eval_fn(k)
+
     state = runner.state
     best, best_epoch, since = None, 0, 0
     begin = time.perf_counter()
-    steps_per_epoch = None
-    for epoch in range(max_epochs):
-        idx, mask = epoch_plan_arrays(loader)   # fresh shuffle per epoch
-        steps_per_epoch = idx.shape[0]
-        epoch_rng = (jax.random.fold_in(rng, epoch)
-                     if rng is not None else None)
-        state, _ = train_epoch(state, data, labels, idx, mask,
-                               rng=epoch_rng,
-                               step0=epoch * steps_per_epoch)
-        totals = eval_epoch(state, data, labels, vidx, vmask)
+    epoch = 0
+    stop = False
+    while not stop and epoch < max_epochs:
+        plans = [epoch_plan_arrays(loader) for _ in range(k)]  # fresh
+        idx = numpy.stack([p[0] for p in plans])   # shuffle per epoch
+        mask = numpy.stack([p[1] for p in plans])
+        steps_per_epoch = idx.shape[-2]
+        # base key: _epoch_chunk_eval folds per epoch by global step
+        state, _, val_stack = chunk_eval(
+            state, data, labels, idx, mask, vidx, vmask, rng=rng,
+            step0=epoch * steps_per_epoch)
         if metric == "n_err":
-            val = int(numpy.asarray(totals["n_err"]))   # sync point
+            vals = numpy.asarray(val_stack["n_err"])        # sync point
         else:
-            val = float(numpy.asarray(totals["mse_sum"])) / max(n_valid, 1)
-        if best is None or val < best:
-            best, best_epoch, since = val, epoch + 1, 0
-        else:
-            since += 1
-        if since >= patience:
-            break
+            vals = (numpy.asarray(val_stack["mse_sum"])
+                    / max(n_valid, 1))
+        for row in range(k):
+            epoch += 1
+            val = (int(vals[row]) if metric == "n_err"
+                   else float(vals[row]))
+            if best is None or val < best:
+                best, best_epoch, since = val, epoch, 0
+            else:
+                since += 1
+            if since >= patience or epoch >= max_epochs:
+                stop = True
+                break
     wall = time.perf_counter() - begin
     runner.state = state
     rec = {
         "val_count": n_valid,
         "best_epoch": best_epoch,
-        "epochs_run": epoch + 1,
+        "epochs_run": epoch,
         "wall_s": round(wall, 1),
     }
     if metric == "n_err":

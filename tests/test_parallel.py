@@ -248,6 +248,57 @@ def test_epoch_chunk_matches_sequential_epochs():
             rtol=2e-5, atol=2e-6)
 
 
+def test_epoch_chunk_eval_matches_sequential_rounds():
+    """epoch_chunk_eval_fn(k) — k (train epoch -> val eval) rounds in one
+    program — returns exactly the per-epoch val totals the sequential
+    train_epoch/eval_epoch loop fetches, and the same final state."""
+    prng.reset(); prng.seed_all(29)
+    wf = _build(mb=64)
+    runner = wf._fused_runner
+    loader = wf.loader
+    data = loader.original_data.devmem
+    labels = loader.original_labels.devmem
+    from veles_tpu.loader.base import TRAIN, VALID
+    loader._plan_epoch()
+
+    def order(cls):
+        idx = numpy.stack([c for k_, c, a in loader._order if k_ == cls])
+        mask = numpy.stack([
+            (numpy.arange(len(c)) < a).astype(numpy.float32)
+            for k_, c, a in loader._order if k_ == cls])
+        return idx, mask
+
+    idx, mask = order(TRAIN)
+    vidx, vmask = order(VALID)
+    steps = idx.shape[0]
+    base = jax.random.PRNGKey(11)
+
+    # sequential reference (on a copy: the chunk leg donates)
+    train_epoch, eval_epoch = runner.epoch_fns()
+    state_a = jax.tree.map(jax.numpy.array, runner.state)
+    seq_vals = []
+    for e in range(2):
+        off = e * steps
+        state_a, _ = train_epoch(state_a, data, labels, idx, mask,
+                                 rng=jax.random.fold_in(base, off),
+                                 step0=off)
+        seq_vals.append(eval_epoch(state_a, data, labels, vidx, vmask))
+
+    chunk = runner.epoch_chunk_eval_fn(2)
+    state_b, _, val_stack = chunk(runner.state, data, labels, idx, mask,
+                                  vidx, vmask, rng=base, step0=0)
+    for ea, eb in zip(state_a, state_b):
+        for key in ea:
+            numpy.testing.assert_allclose(
+                numpy.asarray(ea[key]), numpy.asarray(eb[key]),
+                rtol=2e-5, atol=2e-6)
+    for e in range(2):
+        for key in seq_vals[e]:
+            numpy.testing.assert_allclose(
+                numpy.asarray(val_stack[key][e]),
+                numpy.asarray(seq_vals[e][key]), rtol=1e-5)
+
+
 def test_loader_host_sharding_composes_with_mesh():
     """Multi-host story: each process takes a strided shard; union of shards
     covers the dataset exactly once (replaces index shipping)."""
