@@ -164,21 +164,11 @@ def model_train_flops_per_sample(runner):
 
 # ------------------------------------------------------------------ timing
 def epoch_plan_arrays(loader, wanted_cls=None):
-    """(idx, mask) matrices of one set for the epoch-scan fast path
-    (train by default; pass loader.base.VALID for the validation set)."""
-    from veles_tpu.loader.base import TRAIN
-    if wanted_cls is None:
-        wanted_cls = TRAIN
+    """(idx, mask) matrices of one set for the epoch-scan fast path,
+    from a FRESH plan (train by default; pass loader.base.VALID for the
+    validation set).  Extraction lives on the Loader (plan_arrays)."""
     loader._plan_epoch()
-    idx, mask = [], []
-    for cls, chunk, actual in loader._order:
-        if cls != wanted_cls:
-            continue
-        idx.append(chunk)
-        m = numpy.zeros(len(chunk), numpy.float32)
-        m[:actual] = 1.0
-        mask.append(m)
-    return numpy.stack(idx), numpy.stack(mask)
+    return loader.plan_arrays(wanted_cls)
 
 
 def best_time(fn, reps=3):

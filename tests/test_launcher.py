@@ -72,6 +72,150 @@ class TestLauncherProfile:
             numpy.testing.assert_array_equal(a, b)
 
 
+class TestEpochScanDriver:
+    def test_chunk1_matches_graph_loop_exactly(self):
+        """--epoch-scan with chunk=1 on a deterministic (no-dropout)
+        model: per-epoch decision metrics AND final weights equal the
+        per-minibatch graph loop's bit-for-bit semantics (same plans,
+        same set ordering: validation before each epoch's training)."""
+        from veles_tpu.launcher import Launcher
+
+        wf_a = _build_tiny_mnist(seed=7, max_epochs=3)
+        Launcher(wf_a, stats=False).boot()
+
+        wf_b = _build_tiny_mnist(seed=7, max_epochs=3)
+        Launcher(wf_b, stats=False, epoch_scan=1).boot()
+
+        assert wf_b.is_finished and bool(wf_b.decision.complete)
+        assert len(wf_a.decision.epoch_metrics) == \
+            len(wf_b.decision.epoch_metrics)
+        for ma, mb in zip(wf_a.decision.epoch_metrics,
+                          wf_b.decision.epoch_metrics):
+            assert set(ma) == set(mb)
+            for set_name in ma:
+                for key in ("n_err", "count", "loss"):
+                    if key in ma[set_name]:
+                        va, vb = ma[set_name][key], mb[set_name][key]
+                        numpy.testing.assert_allclose(va, vb, rtol=1e-5)
+        assert wf_a.decision.best_metric == wf_b.decision.best_metric
+        assert wf_a.decision.best_epoch == wf_b.decision.best_epoch
+        for fa, fb in zip(wf_a.forwards, wf_b.forwards):
+            if fa.has_params:
+                numpy.testing.assert_allclose(
+                    numpy.asarray(fa.weights.mem),
+                    numpy.asarray(fb.weights.mem), rtol=2e-5, atol=2e-6)
+
+    def test_chunked_matches_chunk1(self):
+        """chunk=2 trains the same trajectory as chunk=1 (decisions at
+        coarser readback granularity, identical best tracking here
+        because no early stop triggers mid-chunk)."""
+        from veles_tpu.launcher import Launcher
+        wf_a = _build_tiny_mnist(seed=9, max_epochs=4)
+        Launcher(wf_a, stats=False, epoch_scan=1).boot()
+        wf_b = _build_tiny_mnist(seed=9, max_epochs=4)
+        Launcher(wf_b, stats=False, epoch_scan=2).boot()
+        assert len(wf_a.decision.epoch_metrics) == \
+            len(wf_b.decision.epoch_metrics)
+        assert wf_a.decision.best_metric == wf_b.decision.best_metric
+        for fa, fb in zip(wf_a.forwards, wf_b.forwards):
+            if fa.has_params:
+                numpy.testing.assert_allclose(
+                    numpy.asarray(fa.weights.mem),
+                    numpy.asarray(fb.weights.mem), rtol=2e-5, atol=2e-6)
+
+    def test_snapshots_written_and_resumable(self, tmp_path):
+        """The driver fires the snapshotter through its normal gates and
+        the snapshot restores through the normal path."""
+        from veles_tpu.launcher import Launcher
+        from veles_tpu.config import root
+        from veles_tpu import prng, snapshotter as snap_mod
+        prng.reset(); prng.seed_all(3)
+        root.__dict__.pop("mnist", None)
+        root.mnist.update({
+            "loader": {"minibatch_size": 50, "n_train": 200,
+                       "n_valid": 100},
+            "decision": {"max_epochs": 2, "fail_iterations": 5},
+            "snapshotter": {"directory": str(tmp_path), "interval": 1},
+            "layers": [
+                {"type": "softmax", "output_sample_shape": 10,
+                 "learning_rate": 0.05}],
+        })
+        from veles_tpu.samples import mnist
+        wf = mnist.build(fused=True)
+        Launcher(wf, stats=False, epoch_scan=1).boot()
+        latest = snap_mod.find_current(str(tmp_path), wf.snapshotter.prefix)
+        assert latest is not None
+        prng.reset(); prng.seed_all(3)
+        wf2 = mnist.build(fused=True)
+        wf2.initialize()
+        payload = snap_mod.restore(wf2, latest)
+        assert payload["epoch"] == 2
+
+    def test_resume_from_mid_run_snapshot_matches_uninterrupted(
+            self, tmp_path):
+        """Driver kill-and-resume parity: restoring the epoch-2 snapshot
+        and continuing reaches the same final weights as the
+        uninterrupted run (loader plan/_position and PRNG streams round-
+        trip, so the resumed run replans exactly like the original)."""
+        import glob
+        from veles_tpu.launcher import Launcher
+        from veles_tpu.config import root
+        from veles_tpu import prng
+
+        def build():
+            prng.reset(); prng.seed_all(21)
+            root.__dict__.pop("mnist", None)
+            root.mnist.update({
+                "loader": {"minibatch_size": 50, "n_train": 200,
+                           "n_valid": 100},
+                "decision": {"max_epochs": 4, "fail_iterations": 10},
+                "snapshotter": {"directory": str(tmp_path),
+                                "interval": 1},
+                "layers": [
+                    {"type": "all2all_tanh", "output_sample_shape": 16,
+                     "learning_rate": 0.03, "momentum": 0.9},
+                    {"type": "softmax", "output_sample_shape": 10,
+                     "learning_rate": 0.03, "momentum": 0.9}],
+            })
+            from veles_tpu.samples import mnist
+            return mnist.build(fused=True)
+
+        wf_full = build()
+        Launcher(wf_full, stats=False, epoch_scan=1).boot()
+        full_w = [numpy.asarray(f.weights.mem) for f in wf_full.forwards
+                  if f.has_params]
+        mid = glob.glob(str(tmp_path / "mnist_2_*.pickle*"))
+        assert mid, "no epoch-2 snapshot written"
+
+        wf_res = build()
+        Launcher(wf_res, stats=False, epoch_scan=1,
+                 snapshot=mid[0]).boot()
+        assert int(wf_res.loader.epoch_number) == 4
+        res_w = [numpy.asarray(f.weights.mem) for f in wf_res.forwards
+                 if f.has_params]
+        for a, b in zip(full_w, res_w):
+            numpy.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-6)
+
+    def test_rejects_unfused_workflows(self):
+        from veles_tpu.epoch_driver import EpochScanDriver
+        import pytest
+        from veles_tpu import prng
+        from veles_tpu.config import root
+        prng.reset(); prng.seed_all(1)
+        root.__dict__.pop("mnist", None)
+        root.mnist.update({
+            "loader": {"minibatch_size": 50, "n_train": 100,
+                       "n_valid": 50},
+            "decision": {"max_epochs": 1, "fail_iterations": 5},
+            "layers": [{"type": "softmax", "output_sample_shape": 10,
+                        "learning_rate": 0.05}],
+        })
+        from veles_tpu.samples import mnist
+        wf = mnist.build(fused=False)
+        with pytest.raises(ValueError, match="fused"):
+            EpochScanDriver(wf)
+
+
 def test_cli_serve_after_training(tmp_path):
     """--serve PORT: train, then serve the trained workflow over HTTP
     until interrupted (the reference's snapshot-to-serving ergonomics

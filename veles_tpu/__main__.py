@@ -48,6 +48,14 @@ def build_argparser():
     parser.add_argument("-d", "--device", default=None,
                         choices=("tpu", "cpu"),
                         help="JAX platform to run on (default: auto)")
+    parser.add_argument("--epoch-scan", type=int, default=0, nargs="?",
+                        const=1, metavar="CHUNK",
+                        help="train via the epoch-scan driver: each "
+                             "CHUNK epochs run as ONE device program "
+                             "(default CHUNK=1 when the flag is bare); "
+                             "identical decision/metrics semantics, "
+                             "snapshot granularity = CHUNK epochs — the "
+                             "fast path when dispatch latency is high")
     parser.add_argument("--no-fused", action="store_true",
                         help="run the unit graph without the fused "
                              "compiled step (debugging)")
@@ -283,7 +291,7 @@ def main(argv=None):
             coordinator_address=args.coordinator_address,
             num_processes=args.num_processes, process_id=args.process_id,
             stats=not args.no_stats, profile=args.profile,
-            evaluate=args.evaluate)
+            evaluate=args.evaluate, epoch_scan=args.epoch_scan)
         holder["launcher"] = launcher
         launcher.boot()
 

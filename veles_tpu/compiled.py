@@ -330,8 +330,9 @@ class FusedRunner:
         return cache[k]
 
     def _epoch_chunk_eval(self, k, state, data, labels, idx, mask,
-                          vidx, vmask, rng=None, step0=0):
-        """``k`` (train epoch → validation eval) rounds in ONE program:
+                          vidx, vmask, rng=None, step0=0,
+                          eval_first=False):
+        """``k`` (train epoch + validation eval) rounds in ONE program:
         the convergence loop's body, chunked.  Returns the updated state
         plus per-epoch TRAIN and VALID metric totals (k rows each), so a
         host-side early-stopping loop sees exactly the per-epoch values
@@ -339,7 +340,10 @@ class FusedRunner:
         epochs instead of 2k (the regime that matters through a ~0.4 s
         per-execute tunnel).  idx/mask as in ``_epoch_chunk`` ((B, mb)
         shared or (k, B, mb) per-epoch plans); vidx/vmask are the fixed
-        validation plan."""
+        validation plan.  ``eval_first`` evaluates valid BEFORE the
+        epoch's training — the unit-graph loop's set order (the loader
+        plans test → validation → train), which the epoch-scan CLI
+        driver mirrors; the convergence bench keeps eval-after."""
         import jax
         import jax.numpy as jnp
         per_epoch_plan = idx.ndim == 3
@@ -353,10 +357,14 @@ class FusedRunner:
             off = step0 + e * steps
             erng = (jax.random.fold_in(rng, off)
                     if rng is not None else None)
+            if eval_first:
+                val_totals = self._epoch_eval(carry, data, labels, vidx,
+                                              vmask)
             carry, train_totals = self._epoch_train(
                 carry, data, labels, eidx, emask, erng, off)
-            val_totals = self._epoch_eval(carry, data, labels, vidx,
-                                          vmask)
+            if not eval_first:
+                val_totals = self._epoch_eval(carry, data, labels, vidx,
+                                              vmask)
             return carry, (train_totals, val_totals)
 
         xs = ((jnp.arange(k), idx, mask) if per_epoch_plan
@@ -364,18 +372,23 @@ class FusedRunner:
         state, (train_stack, val_stack) = jax.lax.scan(body, state, xs)
         return state, train_stack, val_stack
 
-    def epoch_chunk_eval_fn(self, k):
+    def epoch_chunk_eval_fn(self, k, eval_first=False, donate=True):
         """Jitted ``(state, data, labels, idx, mask, vidx, vmask[, rng,
-        step0]) -> (state, train totals stacked, val totals stacked)``;
-        donates state.  Compiled once per distinct ``k``."""
+        step0]) -> (state, train totals stacked, val totals stacked)``.
+        Donates state unless ``donate=False`` (the epoch-scan CLI driver
+        keeps the chunk-input state alive so a completion inside the
+        chunk can be replayed exactly — see epoch_driver.py — without
+        paying per-leaf device copies).  Compiled once per distinct
+        ``(k, eval_first, donate)``."""
         import functools
         import jax
         cache = getattr(self, "_epoch_chunk_eval_jits", None)
         if cache is None:
             cache = self._epoch_chunk_eval_jits = {}
-        if k not in cache:
-            inner = jax.jit(functools.partial(self._epoch_chunk_eval, k),
-                            donate_argnums=(0,))
+        if (k, eval_first, donate) not in cache:
+            inner = jax.jit(functools.partial(self._epoch_chunk_eval, k,
+                                              eval_first=eval_first),
+                            donate_argnums=(0,) if donate else ())
 
             def chunk(state, data, labels, idx, mask, vidx, vmask,
                       rng=None, step0=0):
@@ -388,8 +401,8 @@ class FusedRunner:
                 return inner(state, data, labels, idx, mask, vidx,
                              vmask, rng, jnp.asarray(step0, jnp.int32))
 
-            cache[k] = chunk
-        return cache[k]
+            cache[(k, eval_first, donate)] = chunk
+        return cache[(k, eval_first, donate)]
 
     def require_epoch_rng(self, rng):
         """Stochastic layers (dropout) need an explicit epoch rng — shared

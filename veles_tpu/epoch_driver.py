@@ -1,0 +1,199 @@
+"""Epoch-scan CLI training driver — the TPU steady state as the MAIN loop.
+
+The unit-graph event loop (SURVEY §3.1's rebuild) dispatches one fused
+step per minibatch; this driver instead runs whole epochs — or k-epoch
+chunks — as ONE device program (``FusedRunner.epoch_chunk_eval_fn``),
+while keeping the workflow's host-side brains exactly as they are:
+
+- **Decision** sees the same per-epoch summed metrics it accumulates in
+  graph mode (validation evaluated BEFORE each epoch's training — the
+  loader plans test → validation → train — then the training pass's own
+  totals), via the same ``reduce_metrics``/``_on_epoch_end`` methods, so
+  improvement tracking, early stopping and logging are identical code.
+- **Snapshotter** fires at chunk boundaries through its normal
+  ``run()``/``stop()`` gates (the state inside a chunk is not
+  addressable — with ``chunk > 1`` snapshot granularity coarsens to the
+  chunk, documented).
+- **The completion gate artifact is reproduced exactly.**  In graph
+  mode, Decision setting ``complete`` gate-skips FusedCommit, so the
+  stopping epoch's LAST minibatch update is computed but DISCARDED
+  (the reference's ordering — GD units fire after Decision).  The scan
+  commits every update, so when completion lands at chunk row R the
+  driver replays rows 0..R from the (kept, non-donated) chunk-input
+  state with row R truncated to its first ``steps-1`` minibatches —
+  one extra dispatch, once per training run.
+
+With no stochastic layers the driver's epoch_metrics and final weights
+EQUAL the graph loop's at any chunk size (pinned by
+tests/test_launcher.py); dropout networks draw scan-path keys
+(documented divergence, same as every epoch-scan path).  Through a
+tunnel with ~0.4 s per-execute RPC this is the difference between
+minutes and hours (docs/PERF.md round 5).
+
+Ref: veles/launcher.py + veles/znicz/decision.py [H] — behavior parity
+with the reference's epoch bookkeeping, substrate redesigned.
+"""
+
+from __future__ import annotations
+
+import numpy
+
+from veles_tpu.logger import Logger
+from veles_tpu.loader.base import TRAIN, VALID, TEST
+
+
+class EpochScanDriver(Logger):
+    """Drives a fused StandardWorkflow through epoch-scan chunks."""
+
+    def __init__(self, wf, chunk=1):
+        from veles_tpu.ops.decision import DecisionGD, DecisionMSE
+        self.wf = wf
+        self.chunk = max(int(chunk), 1)
+        runner = getattr(wf, "_fused_runner", None)
+        if runner is None:
+            raise ValueError("--epoch-scan needs a fused workflow "
+                             "(drop --no-fused)")
+        loader = wf.loader
+        if getattr(loader, "original_data", None) is None or \
+                loader.original_data.is_empty:
+            raise ValueError("--epoch-scan needs a full-batch loader "
+                             "(dataset resident in device memory)")
+        decision = getattr(wf, "decision", None)
+        if not isinstance(decision, (DecisionGD, DecisionMSE)):
+            raise ValueError(
+                "--epoch-scan supports DecisionGD/DecisionMSE workflows; "
+                "%r drives training some other way — use the graph loop"
+                % type(decision).__name__)
+        if loader.class_lengths[TEST]:
+            raise ValueError("--epoch-scan does not evaluate TEST sets "
+                             "yet — use the graph loop")
+        if not loader.class_lengths[VALID]:
+            raise ValueError("--epoch-scan needs a validation set (the "
+                             "stopping rule evaluates it per epoch)")
+        self.runner = runner
+        self.loader = loader
+        self.decision = decision
+
+    # ------------------------------------------------------------------ run
+    def _feed_decision(self, train_row, val_row, n_train, n_valid):
+        """Hand one epoch's summed metrics to the decision through its
+        normal host-side path (reduce_metrics + _on_epoch_end)."""
+        dec = self.decision
+
+        def host(row, count):
+            out = {}
+            for key, value in row.items():
+                arr = numpy.asarray(value)
+                out[key] = float(arr) if arr.ndim == 0 else arr
+            out["count"] = count
+            return out
+
+        dec._current = {
+            "validation": dec.reduce_metrics(host(val_row, n_valid)),
+            "train": dec.reduce_metrics(host(train_row, n_train)),
+        }
+        dec._on_epoch_end()
+        dec._reset_epoch()
+
+    def run(self):
+        import jax
+        wf = self.wf
+        runner, loader, dec = self.runner, self.loader, self.decision
+        data = loader.original_data.devmem
+        labels = (None if runner._is_mse
+                  else loader.original_labels.devmem)
+        # fixed validation plan (valid never shuffles); the loader's
+        # CURRENT plan supplies epoch 1 IF it is still unconsumed
+        # (_position 0: fresh initialize) — the same plan the graph loop
+        # would consume — otherwise (snapshot resume: the restored plan
+        # was already trained) a fresh shuffle is drawn, exactly as the
+        # graph loop's next_minibatch would
+        vidx, vmask = loader.plan_arrays(VALID)
+        n_valid = int(vmask.sum())
+        rng_stream = None
+        if runner._has_stochastic:
+            from veles_tpu import prng
+            rng_stream = prng.get("dropout")
+        # non-donating: the chunk-input state must survive the dispatch so
+        # a completion inside the chunk can be replayed exactly (below)
+        chunk_fn = runner.epoch_chunk_eval_fn(self.chunk, eval_first=True,
+                                              donate=False)
+        first_plan_fresh = loader._position == 0
+        state = runner.state
+        snap = getattr(wf, "snapshotter", None)
+        while not bool(dec.complete):
+            plans = []
+            for _ in range(self.chunk):
+                if first_plan_fresh:
+                    first_plan_fresh = False
+                else:
+                    loader._plan_epoch()
+                plans.append(loader.plan_arrays(TRAIN))
+            # the plan is consumed: snapshots must restore like the graph
+            # loop's end-of-epoch state (next consumer replans)
+            loader._position = len(loader._order)
+            idx = numpy.stack([p[0] for p in plans])
+            mask = numpy.stack([p[1] for p in plans])
+            steps = idx.shape[-2]
+            n_train = int(mask[0].sum())
+            step0 = int(loader.epoch_number) * steps
+            rng = rng_stream.key() if rng_stream is not None else None
+            state_in = state
+            state, train_stack, val_stack = chunk_fn(
+                state, data, labels, idx, mask, vidx, vmask, rng=rng,
+                step0=step0)
+            train_rows = jax.tree.map(numpy.asarray, train_stack)
+            val_rows = jax.tree.map(numpy.asarray, val_stack)
+            done_row = None
+            for row in range(self.chunk):
+                loader.epoch_number = int(loader.epoch_number) + 1
+                self._feed_decision(
+                    {k: v[row] for k, v in train_rows.items()},
+                    {k: v[row] for k, v in val_rows.items()},
+                    n_train, n_valid)
+                fused = getattr(wf, "fused_step", None)
+                if fused is not None:
+                    fused.train_steps += steps
+                if bool(dec.complete):
+                    done_row = row
+                    break
+            if done_row is not None:
+                # graph-mode parity: Decision.complete gate-skips the
+                # commit of the stopping epoch's LAST minibatch — replay
+                # rows 0..done_row from the kept input state with the
+                # final epoch truncated to steps-1 minibatches
+                state = self._replay_to_completion(
+                    state_in, data, labels, idx, mask, rng, step0,
+                    done_row, steps)
+            # chunk boundary: state is addressable — snapshot gates fire
+            # (snapshot_state() syncs the runner itself when it writes)
+            runner.state = state
+            if snap is not None:
+                loader.epoch_ended = True   # plain attr, like the loader
+                snap.run()
+        runner.state = state
+        runner.sync_to_units()
+        if snap is not None:
+            snap.stop()
+        wf._finished = True
+
+    def _replay_to_completion(self, state, data, labels, idx, mask, rng,
+                              step0, done_row, steps):
+        """Exact final state: full epochs for chunk rows 0..done_row-1,
+        then the stopping epoch WITHOUT its last minibatch (whose update
+        graph mode discards).  One extra dispatch (plus one for the
+        leading rows when done_row > 0), once per training run."""
+        import jax
+        runner = self.runner
+        if done_row > 0:
+            head = runner.epoch_chunk_fn(done_row)
+            state, _ = head(state, data, labels, idx[:done_row],
+                            mask[:done_row], rng=rng, step0=step0)
+        off = step0 + done_row * steps
+        erng = (jax.random.fold_in(rng, off) if rng is not None else None)
+        train_epoch, _ = runner.epoch_fns()
+        state, _ = train_epoch(state, data, labels,
+                               idx[done_row][:steps - 1],
+                               mask[done_row][:steps - 1],
+                               rng=erng, step0=off)
+        return state

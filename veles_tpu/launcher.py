@@ -36,9 +36,13 @@ class Launcher(Logger):
     def __init__(self, workflow, snapshot=None, distributed=False,
                  coordinator_address=None, num_processes=None,
                  process_id=None, stats=True, profile=None,
-                 evaluate=False):
+                 evaluate=False, epoch_scan=0):
         self.workflow = workflow
         self.snapshot = snapshot
+        #: > 0: train via the epoch-scan driver (k-epoch chunks as one
+        #: device program each) instead of the per-minibatch graph loop —
+        #: see veles_tpu/epoch_driver.py for the exact semantics
+        self.epoch_scan = int(epoch_scan or 0)
         #: evaluation-only run (SURVEY §3.3 "resume/EVALUATE from
         #: snapshot"): one pass over every dataset split with ALL weight
         #: updates gated off — metrics come out, parameters don't move
@@ -114,14 +118,26 @@ class Launcher(Logger):
             dec.fail_iterations = None
             dec.freeze_best = True
             dec.complete.set(False)
+        if self.epoch_scan and self.evaluate:
+            raise ValueError("--epoch-scan is a TRAINING driver; "
+                             "--evaluate already runs one scoring pass")
+        if self.epoch_scan and self.distributed:
+            raise ValueError("--epoch-scan is single-process; multi-host "
+                             "epoch scans go through "
+                             "parallel.ShardedTrainer.train_epochs")
+        runner = None
+        if self.epoch_scan:
+            from veles_tpu.epoch_driver import EpochScanDriver
+            driver = EpochScanDriver(wf, chunk=self.epoch_scan)
+            runner = driver.run
         begin = time.perf_counter()
         if self.profile:
             import jax.profiler
             with jax.profiler.trace(self.profile):
-                wf.run()
+                (runner or wf.run)()
             self.info("profiler trace written to %s", self.profile)
         else:
-            wf.run()
+            (runner or wf.run)()
         self.run_seconds = time.perf_counter() - begin
         self.info("workflow %r finished in %.2fs", wf.name, self.run_seconds)
         if self.stats:

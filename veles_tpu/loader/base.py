@@ -173,6 +173,28 @@ class Loader(Unit):
             off += n
         return out
 
+    def plan_arrays(self, wanted_cls=None, order=None):
+        """(idx, mask) matrices of one set from a minibatch plan — the
+        epoch-scan fast path's input (bench, CLI driver, ShardedTrainer
+        callers).  Uses the loader's CURRENT plan by default; pass an
+        ``order`` to extract from a kept plan.  Returns (None, None)
+        when the set is empty."""
+        if wanted_cls is None:
+            wanted_cls = TRAIN
+        if order is None:
+            order = self._order
+        idx, mask = [], []
+        for cls, chunk, actual in order:
+            if cls != wanted_cls:
+                continue
+            idx.append(chunk)
+            m = numpy.zeros(len(chunk), numpy.float32)
+            m[:actual] = 1.0
+            mask.append(m)
+        if not idx:
+            return None, None
+        return numpy.stack(idx), numpy.stack(mask)
+
     # -- engine --------------------------------------------------------------
     def normalize_data(self):
         """Hook between load_data and minibatch allocation (see
