@@ -151,6 +151,33 @@ class TestEpochScanDriver:
         payload = snap_mod.restore(wf2, latest)
         assert payload["epoch"] == 2
 
+    def test_dropout_network_trains_and_improves(self):
+        """Stochastic layers go through the driver's rng path (scan-key
+        folding — the documented epoch-scan semantics) and the model
+        still learns."""
+        from veles_tpu.launcher import Launcher
+        from veles_tpu.config import root
+        from veles_tpu import prng
+        prng.reset(); prng.seed_all(5)
+        root.__dict__.pop("mnist", None)
+        root.mnist.update({
+            "loader": {"minibatch_size": 50, "n_train": 200,
+                       "n_valid": 100},
+            "decision": {"max_epochs": 4, "fail_iterations": 10},
+            "layers": [
+                {"type": "all2all_tanh", "output_sample_shape": 32,
+                 "learning_rate": 0.03, "momentum": 0.9},
+                {"type": "dropout", "dropout_ratio": 0.2},
+                {"type": "softmax", "output_sample_shape": 10,
+                 "learning_rate": 0.03, "momentum": 0.9}],
+        })
+        from veles_tpu.samples import mnist
+        wf = mnist.build(fused=True)
+        Launcher(wf, stats=False, epoch_scan=2).boot()
+        hist = [m["validation"]["n_err"]
+                for m in wf.decision.epoch_metrics if "validation" in m]
+        assert len(hist) >= 2 and hist[-1] < hist[0]
+
     def test_resume_from_mid_run_snapshot_matches_uninterrupted(
             self, tmp_path):
         """Driver kill-and-resume parity: restoring the epoch-2 snapshot
