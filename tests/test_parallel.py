@@ -445,6 +445,62 @@ def test_sharded_train_epochs_chunk_matches_sequential():
                                       numpy.ravel(host_a[k]), rtol=1e-5)
 
 
+def test_sharded_train_epochs_eval_matches_sequential():
+    """ShardedTrainer.train_epochs_eval == per-epoch train_epoch +
+    eval_epoch under the same mesh (per-epoch val totals, final state)."""
+    from veles_tpu.loader.base import TRAIN, VALID
+
+    def order(loader, cls):
+        return loader.plan_arrays(cls)
+
+    mesh = make_mesh(8, model_parallel=2)
+
+    prng.reset(); prng.seed_all(31)
+    wf_a = _build(mb=64)
+    trainer_a = ShardedTrainer(wf_a._fused_runner, mesh,
+                               model_shard_layers=(0,))
+    data = numpy.asarray(wf_a.loader.original_data.mem)
+    labels = numpy.asarray(wf_a.loader.original_labels.mem)
+    wf_a.loader._plan_epoch()
+    i0, m0 = order(wf_a.loader, TRAIN)
+    vidx, vmask = order(wf_a.loader, VALID)
+    wf_a.loader._plan_epoch()
+    i1, m1 = order(wf_a.loader, TRAIN)
+    steps = i0.shape[0]
+    trainer_a.place_dataset(data, labels)
+    seq_vals = []
+    for e, (ei, em) in enumerate([(i0, m0), (i1, m1)]):
+        trainer_a.train_epoch(ei, em, step0=e * steps)
+        seq_vals.append(ShardedTrainer.fetch(
+            trainer_a.eval_epoch(vidx, vmask)))
+
+    prng.reset(); prng.seed_all(31)
+    wf_b = _build(mb=64)
+    trainer_b = ShardedTrainer(wf_b._fused_runner, mesh,
+                               model_shard_layers=(0,))
+    wf_b.loader._plan_epoch()
+    i0b, m0b = order(wf_b.loader, TRAIN)
+    wf_b.loader._plan_epoch()
+    i1b, m1b = order(wf_b.loader, TRAIN)
+    numpy.testing.assert_array_equal(i0, i0b)
+    numpy.testing.assert_array_equal(i1, i1b)
+    trainer_b.place_dataset(data, labels)
+    _, val_stack = trainer_b.train_epochs_eval(
+        numpy.stack([i0b, i1b]), numpy.stack([m0b, m1b]), vidx, vmask,
+        step0=0)
+    host = ShardedTrainer.fetch(val_stack)
+    for e in range(2):
+        for key in seq_vals[e]:
+            numpy.testing.assert_allclose(
+                numpy.ravel(host[key][e]),
+                numpy.ravel(seq_vals[e][key]), rtol=1e-5)
+    for ea, eb in zip(trainer_a.state, trainer_b.state):
+        for key in ea:
+            numpy.testing.assert_allclose(
+                numpy.asarray(ea[key]), numpy.asarray(eb[key]),
+                rtol=2e-5, atol=2e-6)
+
+
 def test_epoch_scan_requires_divisible_minibatch():
     prng.reset(); prng.seed_all(17)
     wf = _build(mb=64)

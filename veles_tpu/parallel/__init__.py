@@ -306,6 +306,41 @@ class ShardedTrainer:
         self.step_count = int(step0) + k * idx.shape[-2]
         return stacked
 
+    def train_epochs_eval(self, idx, mask, vidx, vmask, rng=None,
+                          step0=None, eval_first=False):
+        """``k`` (train epoch + validation eval) rounds in ONE dispatch
+        under the mesh (FusedRunner._epoch_chunk_eval) — the convergence
+        loop's body at 1 execute per k epochs, SPMD.  idx/mask are
+        (k, B, mb) per-epoch plans; vidx/vmask the fixed validation
+        plan.  Returns (train totals stacked, val totals stacked)."""
+        import functools
+        import jax
+        import jax.numpy as jnp
+        idx = numpy.asarray(idx)
+        if idx.ndim != 3:
+            raise ValueError("train_epochs_eval wants (k, B, mb) "
+                             "per-epoch plans")
+        k = idx.shape[0]
+        self.runner.require_epoch_rng(rng)
+        idx_g, mask_g = self._place_plan(idx, mask, rng)
+        vidx_g, vmask_g = self._place_plan(vidx, vmask)
+        cache = getattr(self, "_chunk_eval_jits", None)
+        if cache is None:
+            cache = self._chunk_eval_jits = {}
+        if (k, eval_first) not in cache:
+            cache[(k, eval_first)] = jax.jit(
+                functools.partial(self.runner._epoch_chunk_eval, k,
+                                  eval_first=eval_first),
+                donate_argnums=(0,),
+                out_shardings=(self.state_shardings, None, None))
+        if step0 is None:
+            step0 = self.step_count
+        self.state, train_stack, val_stack = cache[(k, eval_first)](
+            self.state, self._data, self._labels, idx_g, mask_g, vidx_g,
+            vmask_g, rng, jnp.asarray(step0, jnp.int32))
+        self.step_count = int(step0) + k * idx.shape[-2]
+        return train_stack, val_stack
+
     def _ensure_epoch_jits(self):
         import jax
         if not hasattr(self, "_epoch_train_jit"):
