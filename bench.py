@@ -403,7 +403,7 @@ def bench_convergence(build_fn, max_epochs=15, patience=5):
         mask = numpy.stack([p[1] for p in plans])
         steps_per_epoch = idx.shape[-2]
         # base key: _epoch_chunk_eval folds per epoch by global step
-        state, _, val_stack = chunk_eval(
+        state, _, val_stack, _ = chunk_eval(
             state, data, labels, idx, mask, vidx, vmask, rng=rng,
             step0=epoch * steps_per_epoch)
         if metric == "n_err":

@@ -151,6 +151,51 @@ class TestEpochScanDriver:
         payload = snap_mod.restore(wf2, latest)
         assert payload["epoch"] == 2
 
+    def test_test_set_metrics_match_graph_loop(self):
+        """Loaders with a TEST split: the driver evaluates it per epoch
+        (before valid, like the plan orders it) and the decision records
+        the same per-set metrics as the graph loop."""
+        from veles_tpu.launcher import Launcher
+        from veles_tpu.standard_workflow import StandardWorkflow
+        from veles_tpu.loader.fullbatch import FullBatchLoader
+        from veles_tpu import prng
+
+        class ThreeSetLoader(FullBatchLoader):
+            def load_data(self):
+                r = numpy.random.RandomState(4)
+                protos = r.uniform(-1, 1, (10, 20)).astype(numpy.float32)
+                labels = (numpy.arange(260) % 10).astype(numpy.int32)
+                data = (protos[labels]
+                        + r.normal(0, .5, (260, 20)).astype(numpy.float32))
+                self.original_data.reset(data)
+                self.original_labels.reset(labels)
+                self.class_lengths = [60, 80, 120]   # test|valid|train
+
+        def build():
+            prng.reset(); prng.seed_all(11)
+            return StandardWorkflow(
+                None, name="threeset", loader_factory=ThreeSetLoader,
+                loader_config={"minibatch_size": 20},
+                decision_config={"max_epochs": 2, "fail_iterations": 5},
+                layers=[{"type": "softmax", "output_sample_shape": 10,
+                         "learning_rate": 0.05}])
+
+        wf_a = build()
+        Launcher(wf_a, stats=False).boot()
+        wf_b = build()
+        Launcher(wf_b, stats=False, epoch_scan=1).boot()
+        assert len(wf_a.decision.epoch_metrics) == \
+            len(wf_b.decision.epoch_metrics)
+        for ma, mb in zip(wf_a.decision.epoch_metrics,
+                          wf_b.decision.epoch_metrics):
+            assert set(ma) == set(mb) == {"test", "validation", "train"}
+            for set_name in ma:
+                for key in ("n_err", "count", "loss"):
+                    if key in ma[set_name]:
+                        numpy.testing.assert_allclose(
+                            ma[set_name][key], mb[set_name][key],
+                            rtol=1e-5)
+
     def test_dropout_network_trains_and_improves(self):
         """Stochastic layers go through the driver's rng path (scan-key
         folding — the documented epoch-scan semantics) and the model

@@ -285,8 +285,10 @@ def test_epoch_chunk_eval_matches_sequential_rounds():
         seq_vals.append(eval_epoch(state_a, data, labels, vidx, vmask))
 
     chunk = runner.epoch_chunk_eval_fn(2)
-    state_b, _, val_stack = chunk(runner.state, data, labels, idx, mask,
-                                  vidx, vmask, rng=base, step0=0)
+    state_b, _, val_stack, test_stack = chunk(
+        runner.state, data, labels, idx, mask, vidx, vmask, rng=base,
+        step0=0)
+    assert test_stack is None   # no test plan given
     for ea, eb in zip(state_a, state_b):
         for key in ea:
             numpy.testing.assert_allclose(

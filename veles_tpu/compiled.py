@@ -331,7 +331,7 @@ class FusedRunner:
 
     def _epoch_chunk_eval(self, k, state, data, labels, idx, mask,
                           vidx, vmask, rng=None, step0=0,
-                          eval_first=False):
+                          eval_first=False, tidx=None, tmask=None):
         """``k`` (train epoch + validation eval) rounds in ONE program:
         the convergence loop's body, chunked.  Returns the updated state
         plus per-epoch TRAIN and VALID metric totals (k rows each), so a
@@ -343,11 +343,22 @@ class FusedRunner:
         validation plan.  ``eval_first`` evaluates valid BEFORE the
         epoch's training — the unit-graph loop's set order (the loader
         plans test → validation → train), which the epoch-scan CLI
-        driver mirrors; the convergence bench keeps eval-after."""
+        driver mirrors; the convergence bench keeps eval-after.
+        ``tidx``/``tmask`` add a per-epoch TEST-set eval (ordered before
+        valid, like the loader plans it); its stacked totals come back
+        as the fourth output (None when no test plan is given)."""
         import jax
         import jax.numpy as jnp
         per_epoch_plan = idx.ndim == 3
         steps = idx.shape[-2]
+        has_test = tidx is not None
+
+        def evals(carry):
+            test_totals = (self._epoch_eval(carry, data, labels, tidx,
+                                            tmask) if has_test else None)
+            val_totals = self._epoch_eval(carry, data, labels, vidx,
+                                          vmask)
+            return test_totals, val_totals
 
         def body(carry, xs):
             if per_epoch_plan:
@@ -358,28 +369,29 @@ class FusedRunner:
             erng = (jax.random.fold_in(rng, off)
                     if rng is not None else None)
             if eval_first:
-                val_totals = self._epoch_eval(carry, data, labels, vidx,
-                                              vmask)
+                test_totals, val_totals = evals(carry)
             carry, train_totals = self._epoch_train(
                 carry, data, labels, eidx, emask, erng, off)
             if not eval_first:
-                val_totals = self._epoch_eval(carry, data, labels, vidx,
-                                              vmask)
-            return carry, (train_totals, val_totals)
+                test_totals, val_totals = evals(carry)
+            return carry, (train_totals, val_totals, test_totals)
 
         xs = ((jnp.arange(k), idx, mask) if per_epoch_plan
               else jnp.arange(k))
-        state, (train_stack, val_stack) = jax.lax.scan(body, state, xs)
-        return state, train_stack, val_stack
+        state, (train_stack, val_stack, test_stack) = jax.lax.scan(
+            body, state, xs)
+        return state, train_stack, val_stack, test_stack
 
     def epoch_chunk_eval_fn(self, k, eval_first=False, donate=True):
         """Jitted ``(state, data, labels, idx, mask, vidx, vmask[, rng,
-        step0]) -> (state, train totals stacked, val totals stacked)``.
+        step0, tidx, tmask]) -> (state, train totals stacked, val totals
+        stacked, test totals stacked or None)``.
         Donates state unless ``donate=False`` (the epoch-scan CLI driver
         keeps the chunk-input state alive so a completion inside the
         chunk can be replayed exactly — see epoch_driver.py — without
         paying per-leaf device copies).  Compiled once per distinct
-        ``(k, eval_first, donate)``."""
+        ``(k, eval_first, donate)`` (plus a retrace when a test plan
+        appears)."""
         import functools
         import jax
         cache = getattr(self, "_epoch_chunk_eval_jits", None)
@@ -391,7 +403,7 @@ class FusedRunner:
                             donate_argnums=(0,) if donate else ())
 
             def chunk(state, data, labels, idx, mask, vidx, vmask,
-                      rng=None, step0=0):
+                      rng=None, step0=0, tidx=None, tmask=None):
                 import jax.numpy as jnp
                 self.require_epoch_rng(rng)
                 if idx.ndim == 3 and idx.shape[0] != k:
@@ -399,7 +411,8 @@ class FusedRunner:
                         "per-epoch plan has %d epochs, chunk is %d"
                         % (idx.shape[0], k))
                 return inner(state, data, labels, idx, mask, vidx,
-                             vmask, rng, jnp.asarray(step0, jnp.int32))
+                             vmask, rng, jnp.asarray(step0, jnp.int32),
+                             tidx=tidx, tmask=tmask)
 
             cache[(k, eval_first, donate)] = chunk
         return cache[(k, eval_first, donate)]

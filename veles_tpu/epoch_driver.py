@@ -64,9 +64,6 @@ class EpochScanDriver(Logger):
                 "--epoch-scan supports DecisionGD/DecisionMSE workflows; "
                 "%r drives training some other way — use the graph loop"
                 % type(decision).__name__)
-        if loader.class_lengths[TEST]:
-            raise ValueError("--epoch-scan does not evaluate TEST sets "
-                             "yet — use the graph loop")
         if not loader.class_lengths[VALID]:
             raise ValueError("--epoch-scan needs a validation set (the "
                              "stopping rule evaluates it per epoch)")
@@ -75,10 +72,11 @@ class EpochScanDriver(Logger):
         self.decision = decision
 
     # ------------------------------------------------------------------ run
-    def _feed_decision(self, train_row, val_row, n_train, n_valid):
+    def _feed_decision(self, train_row, val_row, test_row, counts):
         """Hand one epoch's summed metrics to the decision through its
         normal host-side path (reduce_metrics + _on_epoch_end)."""
         dec = self.decision
+        n_train, n_valid, n_test = counts
 
         def host(row, count):
             out = {}
@@ -88,10 +86,12 @@ class EpochScanDriver(Logger):
             out["count"] = count
             return out
 
-        dec._current = {
-            "validation": dec.reduce_metrics(host(val_row, n_valid)),
-            "train": dec.reduce_metrics(host(train_row, n_train)),
-        }
+        current = {}
+        if test_row is not None:
+            current["test"] = dec.reduce_metrics(host(test_row, n_test))
+        current["validation"] = dec.reduce_metrics(host(val_row, n_valid))
+        current["train"] = dec.reduce_metrics(host(train_row, n_train))
+        dec._current = current
         dec._on_epoch_end()
         dec._reset_epoch()
 
@@ -110,6 +110,8 @@ class EpochScanDriver(Logger):
         # graph loop's next_minibatch would
         vidx, vmask = loader.plan_arrays(VALID)
         n_valid = int(vmask.sum())
+        tidx, tmask = loader.plan_arrays(TEST)   # (None, None) if absent
+        n_test = int(tmask.sum()) if tmask is not None else 0
         rng_stream = None
         if runner._has_stochastic:
             from veles_tpu import prng
@@ -139,18 +141,22 @@ class EpochScanDriver(Logger):
             step0 = int(loader.epoch_number) * steps
             rng = rng_stream.key() if rng_stream is not None else None
             state_in = state
-            state, train_stack, val_stack = chunk_fn(
+            state, train_stack, val_stack, test_stack = chunk_fn(
                 state, data, labels, idx, mask, vidx, vmask, rng=rng,
-                step0=step0)
+                step0=step0, tidx=tidx, tmask=tmask)
             train_rows = jax.tree.map(numpy.asarray, train_stack)
             val_rows = jax.tree.map(numpy.asarray, val_stack)
+            test_rows = (jax.tree.map(numpy.asarray, test_stack)
+                         if test_stack is not None else None)
             done_row = None
             for row in range(self.chunk):
                 loader.epoch_number = int(loader.epoch_number) + 1
                 self._feed_decision(
                     {k: v[row] for k, v in train_rows.items()},
                     {k: v[row] for k, v in val_rows.items()},
-                    n_train, n_valid)
+                    ({k: v[row] for k, v in test_rows.items()}
+                     if test_rows is not None else None),
+                    (n_train, n_valid, n_test))
                 fused = getattr(wf, "fused_step", None)
                 if fused is not None:
                     fused.train_steps += steps

@@ -332,10 +332,10 @@ class ShardedTrainer:
                 functools.partial(self.runner._epoch_chunk_eval, k,
                                   eval_first=eval_first),
                 donate_argnums=(0,),
-                out_shardings=(self.state_shardings, None, None))
+                out_shardings=(self.state_shardings, None, None, None))
         if step0 is None:
             step0 = self.step_count
-        self.state, train_stack, val_stack = cache[(k, eval_first)](
+        self.state, train_stack, val_stack, _ = cache[(k, eval_first)](
             self.state, self._data, self._labels, idx_g, mask_g, vidx_g,
             vmask_g, rng, jnp.asarray(step0, jnp.int32))
         self.step_count = int(step0) + k * idx.shape[-2]
