@@ -1,0 +1,62 @@
+"""Worker for the CLI-level multi-host test (tests/test_multihost.py).
+
+Unlike multihost_worker.py (which drives ShardedTrainer directly), this
+goes through the PRODUCT path users get from ``--distributed``:
+``Launcher.boot(distributed=True)`` — SPMD loader sharding from the
+launcher-built mesh, FusedStep routing minibatches through
+ShardedTrainer.train_step_pending, Decision/FusedCommit unchanged.
+Prints the per-epoch decision metrics + final-weight checksum so the
+parent test can assert both processes agree AND match a plain
+single-process run (multi-host changes the wiring, not the math).
+"""
+
+import json
+import os
+import sys
+
+
+def main(coordinator, num_processes, process_id):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=4").strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy
+    from veles_tpu import prng
+    from veles_tpu.config import root
+    from veles_tpu.launcher import Launcher
+
+    prng.reset()
+    prng.seed_all(1)
+    root.mnist.update({
+        "loader": {"minibatch_size": 32, "n_train": 128, "n_valid": 32},
+        "decision": {"max_epochs": 2, "fail_iterations": 5},
+        "layers": [
+            {"type": "all2all_tanh", "output_sample_shape": 16,
+             "learning_rate": 0.05, "momentum": 0.9},
+            {"type": "softmax", "output_sample_shape": 10,
+             "learning_rate": 0.05, "momentum": 0.9},
+        ],
+    })
+    from veles_tpu.samples import mnist
+    wf = mnist.build(fused=True)
+    Launcher(wf, distributed=True, coordinator_address=coordinator,
+             num_processes=num_processes, process_id=process_id,
+             stats=False).boot()
+    assert getattr(wf, "_sharded_trainer", None) is not None
+    assert wf._sharded_trainer.multiprocess
+    assert wf.loader.local_minibatch_size < 32   # really sharded rows
+    epochs = [{s: {k: v for k, v in m.items()
+                   if isinstance(v, (int, float))}
+               for s, m in em.items()}
+              for em in wf.decision.epoch_metrics]
+    w0 = numpy.asarray(wf.forwards[0].weights.mem)
+    print("METRICS " + json.dumps({
+        "epochs": epochs,
+        "best": wf.decision.best_metric,
+        "wsum": float(numpy.abs(w0).sum()),
+    }))
+
+
+if __name__ == "__main__":
+    main(sys.argv[1], int(sys.argv[2]), int(sys.argv[3]))

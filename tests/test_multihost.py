@@ -149,6 +149,53 @@ def test_two_process_spmd_matches_single_process(mode):
                 mode, step, key, outs[0][step][key], val)
 
 
+def test_cli_distributed_trains_spmd_and_matches_single_process():
+    """The PRODUCT --distributed path (Launcher.boot(distributed=True)):
+    both processes train lock-step through the mesh (identical per-epoch
+    decision metrics and final weights), and the result matches a plain
+    single-process run of the same config — the documented 'gradient
+    averaging is the XLA all-reduce' semantics, now through the CLI
+    graph loop itself."""
+    outs = [_parse_metrics(out)
+            for out in _spawn_workers("multihost_cli_worker.py", [])]
+    assert outs[0] == outs[1]
+    assert len(outs[0]["epochs"]) == 2
+
+    # single-process reference: plain graph loop, same seed/config
+    from veles_tpu import prng
+    from veles_tpu.config import root
+    from veles_tpu.launcher import Launcher
+    prng.reset()
+    prng.seed_all(1)
+    root.__dict__.pop("mnist", None)
+    root.mnist.update({
+        "loader": {"minibatch_size": 32, "n_train": 128, "n_valid": 32},
+        "decision": {"max_epochs": 2, "fail_iterations": 5},
+        "layers": [
+            {"type": "all2all_tanh", "output_sample_shape": 16,
+             "learning_rate": 0.05, "momentum": 0.9},
+            {"type": "softmax", "output_sample_shape": 10,
+             "learning_rate": 0.05, "momentum": 0.9},
+        ],
+    })
+    from veles_tpu.samples import mnist
+    wf = mnist.build(fused=True)
+    Launcher(wf, stats=False).boot()
+    ref_epochs = wf.decision.epoch_metrics
+    assert len(ref_epochs) == len(outs[0]["epochs"])
+    for ref, got in zip(ref_epochs, outs[0]["epochs"]):
+        for set_name, metrics in ref.items():
+            for key, val in metrics.items():
+                if not isinstance(val, (int, float)):
+                    continue
+                g = got[set_name][key]
+                assert abs(g - val) <= 1e-4 * (1 + abs(val)), (
+                    set_name, key, g, val)
+    wsum = float(numpy.abs(
+        numpy.asarray(wf.forwards[0].weights.mem)).sum())
+    assert abs(outs[0]["wsum"] - wsum) <= 1e-3 * (1 + wsum)
+
+
 def test_two_process_divergent_init_detected():
     """ShardedTrainer assembles device shards from process-LOCAL host
     copies, so divergent init across processes must fail loudly at

@@ -497,6 +497,12 @@ class FusedStep(Unit):
         import jax.numpy as jnp
         runner = self.runner
         loader = runner.wf.loader
+        #: attached by the launcher under --distributed: minibatches
+        #: route through the mesh (local rows -> global batch, GSPMD
+        #: all-reduce on the sharded batch axis), same pending/commit
+        #: ordering (ref: SURVEY §5.8 — the reference's master-side
+        #: averaging, collapsed into the compiled step)
+        trainer = getattr(runner.wf, "_sharded_trainer", None)
         x = loader.minibatch_data.devmem
         labels = (loader.minibatch_labels.devmem
                   if not loader.minibatch_labels.is_empty else None)
@@ -512,20 +518,33 @@ class FusedStep(Unit):
                 rng = prng.get("dropout").key()
             else:
                 rng = None
-            args = (x, y_ref, mask,
-                    jnp.asarray(loader.minibatch_size, jnp.int32), rng,
-                    jnp.asarray(self.train_steps, jnp.int32))
-            self.pending_state, metrics = runner._train(runner.state, *args)
-            runner._last_train_args = args  # for measure_device_step_time
+            if trainer is not None:
+                self.pending_state, metrics = trainer.train_step_pending(
+                    x, y_ref, mask, loader.minibatch_size, rng,
+                    self.train_steps)
+            else:
+                args = (x, y_ref, mask,
+                        jnp.asarray(loader.minibatch_size, jnp.int32),
+                        rng, jnp.asarray(self.train_steps, jnp.int32))
+                self.pending_state, metrics = runner._train(runner.state,
+                                                            *args)
+                runner._last_train_args = args  # measure_device_step_time
             self.train_steps += 1
         else:
             self.pending_state = None
-            metrics = runner._eval(runner.state, x, y_ref, mask)
+            if trainer is not None:
+                metrics = trainer.eval_step(x, y_ref, mask)
+            else:
+                metrics = runner._eval(runner.state, x, y_ref, mask)
         # decision reads these through its link_attrs alias on the evaluator
         runner.evaluator.metrics = metrics
 
     def stop(self):
-        self.runner.sync_to_units()
+        trainer = getattr(self.runner.wf, "_sharded_trainer", None)
+        if trainer is not None:
+            trainer.sync_to_runner()
+        else:
+            self.runner.sync_to_units()
 
 
 class FusedCommit(Unit):
@@ -542,5 +561,9 @@ class FusedCommit(Unit):
     def run(self):
         fused = self.runner.wf.fused_step
         if fused.pending_state is not None:
-            self.runner.state = fused.pending_state
+            trainer = getattr(self.runner.wf, "_sharded_trainer", None)
+            if trainer is not None:
+                trainer.state = fused.pending_state
+            else:
+                self.runner.state = fused.pending_state
             fused.pending_state = None

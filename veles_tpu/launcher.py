@@ -27,9 +27,14 @@ class Launcher(Logger):
     ----------
     workflow: a built (not yet initialized) Workflow.
     snapshot: optional path — restore state after initialize (resume).
-    distributed: join a multi-host run via ``jax.distributed`` and shard the
-        loader by process index (the reference's ``--master``/``--slave``
-        pair, collapsed).
+    distributed: join a multi-host run via ``jax.distributed`` and train
+        lock-step SPMD over the global mesh — the loader yields each
+        process's rows of the same global minibatch sequence
+        (``shard_spmd``) and FusedStep routes through ShardedTrainer, so
+        gradient averaging is the GSPMD all-reduce (the reference's
+        ``--master``/``--slave`` pair, collapsed; the strided
+        independent-shard mode stays available via ``Loader.shard`` for
+        screening workloads).
     stats: print the per-unit run-time table at the end.
     """
 
@@ -61,16 +66,35 @@ class Launcher(Logger):
     def boot(self, **kwargs):
         """The reference's Launcher.boot(): bring everything up and run."""
         wf = self.workflow
+        mesh = None
         if self.distributed:
-            from veles_tpu.parallel import initialize_multihost
+            from veles_tpu.parallel import (initialize_multihost,
+                                            make_mesh, spmd_loader_shard)
             index, count = initialize_multihost(
                 self.coordinator_address, self.num_processes,
                 self.process_id)
+            # lock-step SPMD over ALL devices of the run: every process
+            # plans the same global minibatch sequence and feeds its
+            # local rows; gradient averaging is the all-reduce GSPMD
+            # inserts over the sharded batch axis (the documented
+            # --distributed semantics; the strided independent-shard
+            # mode stays available programmatically via Loader.shard
+            # for screening workloads)
+            mesh = make_mesh()
             loader = getattr(wf, "loader", None)
             if loader is not None:
-                loader.shard(index, count)
-            self.info("joined distributed run as process %d/%d", index, count)
+                loader.shard_spmd(*spmd_loader_shard(mesh))
+            self.info("joined distributed run as process %d/%d "
+                      "(%d-device mesh)", index, count,
+                      mesh.devices.size)
         wf.initialize(**kwargs)
+        if mesh is not None:
+            runner = getattr(wf, "_fused_runner", None)
+            if runner is None:
+                raise ValueError("--distributed training needs a fused "
+                                 "workflow (drop --no-fused)")
+            from veles_tpu.parallel import ShardedTrainer
+            wf._sharded_trainer = ShardedTrainer(runner, mesh)
         snapshot = self.snapshot
         if snapshot == "auto":
             # resume from the latest published snapshot of this workflow's
@@ -92,6 +116,11 @@ class Launcher(Logger):
             self.restored_payload = snapshotter.restore(wf, snapshot)
             self.info("resumed from %s (epoch %s)", snapshot,
                       self.restored_payload.get("epoch"))
+            trainer = getattr(wf, "_sharded_trainer", None)
+            if trainer is not None:
+                # restore rewrote the unit Vectors + runner state on the
+                # host; push it back out over the mesh
+                trainer.reload_from_runner()
         if self.evaluate:
             from veles_tpu.mutable import Bool
             always = Bool(True)

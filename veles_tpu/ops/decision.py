@@ -88,9 +88,17 @@ class DecisionBase(Unit):
         totals = batches[0]
         for metrics in batches[1:]:
             totals = jax.tree.map(lambda a, b: a + b, totals, metrics)
-        host = {k: (float(v) if getattr(v, "ndim", 0) == 0
-                    else numpy.asarray(v))
-                for k, v in totals.items()}
+
+        def to_host(v):
+            # multi-host SPMD: metrics are replicated over a mesh that
+            # spans processes — read the local replica (a global
+            # replicated array is not fully addressable from one host)
+            if isinstance(v, jax.Array) and not v.is_fully_addressable:
+                v = v.addressable_data(0)
+            arr = numpy.asarray(v)
+            return float(arr) if arr.ndim == 0 else arr
+
+        host = {k: to_host(v) for k, v in totals.items()}
         host["count"] = self._seen.get(cls, 0)
         self._current[CLASS_NAME[cls]] = self.reduce_metrics(host)
 

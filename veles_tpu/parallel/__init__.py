@@ -182,9 +182,48 @@ class ShardedTrainer:
         self.step_count = int(step) + 1
         return metrics
 
+    def train_step_pending(self, x, labels, mask, batch_size, rng=None,
+                           step=0):
+        """Graph-mode (FusedStep) variant of :meth:`train_step`: computes
+        the updated state WITHOUT committing it, so FusedCommit can adopt
+        or discard it after Decision gates — the same pending/commit
+        dance the single-device path does.  Non-donating (the current
+        state must survive a discarded update)."""
+        import jax
+        import jax.numpy as jnp
+        if not hasattr(self, "_train_pending"):
+            self._train_pending = jax.jit(
+                self.runner._step_fn,
+                out_shardings=(self.state_shardings, None))
+        x, labels, mask = self.put_batch(x, labels, mask)
+        return self._train_pending(
+            self.state, x, labels, mask,
+            jnp.asarray(batch_size, jnp.int32), rng,
+            jnp.asarray(step, jnp.int32))
+
     def eval_step(self, x, labels, mask):
         x, labels, mask = self.put_batch(x, labels, mask)
         return self._eval(self.state, x, labels, mask)
+
+    def reload_from_runner(self):
+        """Re-place device state from the runner's host-side state —
+        the restore-side inverse of :meth:`sync_to_runner` (snapshot
+        restore rewrites the unit Vectors and refreshes runner.state;
+        this pushes it back out over the mesh, digest-guarded in
+        multi-process mode like __init__)."""
+        import jax
+        if self.multiprocess:
+            import zlib
+            from jax.experimental import multihost_utils
+            digest = [zlib.crc32(numpy.ascontiguousarray(
+                numpy.asarray(leaf)).tobytes())
+                for leaf in jax.tree.leaves(self.runner.state)]
+            multihost_utils.assert_equal(
+                numpy.asarray(digest, numpy.uint32),
+                "restored runner state differs across processes — every "
+                "process must restore the same snapshot")
+        self.state = jax.tree.map(self._put, self.runner.state,
+                                  self.state_shardings)
 
     # ------------------------------------------------- epoch-scan (SPMD)
     # GLOBAL-plan API: every process passes the SAME full dataset and the
