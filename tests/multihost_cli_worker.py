@@ -15,7 +15,7 @@ import os
 import sys
 
 
-def main(coordinator, num_processes, process_id):
+def main(coordinator, num_processes, process_id, epoch_scan=0):
     os.environ["XLA_FLAGS"] = (
         os.environ.get("XLA_FLAGS", "")
         + " --xla_force_host_platform_device_count=4").strip()
@@ -42,7 +42,7 @@ def main(coordinator, num_processes, process_id):
     wf = mnist.build(fused=True)
     Launcher(wf, distributed=True, coordinator_address=coordinator,
              num_processes=num_processes, process_id=process_id,
-             stats=False).boot()
+             stats=False, epoch_scan=epoch_scan).boot()
     assert getattr(wf, "_sharded_trainer", None) is not None
     assert wf._sharded_trainer.multiprocess
     assert wf.loader.local_minibatch_size < 32   # really sharded rows
@@ -59,4 +59,5 @@ def main(coordinator, num_processes, process_id):
 
 
 if __name__ == "__main__":
-    main(sys.argv[1], int(sys.argv[2]), int(sys.argv[3]))
+    main(sys.argv[1], int(sys.argv[2]), int(sys.argv[3]),
+         int(sys.argv[4]) if len(sys.argv) > 4 else 0)

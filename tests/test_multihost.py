@@ -196,6 +196,27 @@ def test_cli_distributed_trains_spmd_and_matches_single_process():
     assert abs(outs[0]["wsum"] - wsum) <= 1e-3 * (1 + wsum)
 
 
+def test_cli_distributed_epoch_scan_matches_graph_loop():
+    """--distributed --epoch-scan composed: 2 processes run k-epoch
+    chunks as single programs under the global mesh and reach the same
+    per-epoch metrics and weights as the 2-process per-minibatch path
+    (which itself equals single-process — previous test)."""
+    outs = [_parse_metrics(out)
+            for out in _spawn_workers("multihost_cli_worker.py", ["2"])]
+    assert outs[0] == outs[1]
+    base = [_parse_metrics(out)
+            for out in _spawn_workers("multihost_cli_worker.py", [])]
+    assert len(outs[0]["epochs"]) == len(base[0]["epochs"])
+    for ref, got in zip(base[0]["epochs"], outs[0]["epochs"]):
+        for set_name, metrics in ref.items():
+            for key, val in metrics.items():
+                g = got[set_name][key]
+                assert abs(g - val) <= 1e-4 * (1 + abs(val)), (
+                    set_name, key, g, val)
+    assert abs(outs[0]["wsum"] - base[0]["wsum"]) <= 1e-3 * (
+        1 + base[0]["wsum"])
+
+
 def test_two_process_divergent_init_detected():
     """ShardedTrainer assembles device shards from process-LOCAL host
     copies, so divergent init across processes must fail loudly at

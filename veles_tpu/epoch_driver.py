@@ -99,9 +99,23 @@ class EpochScanDriver(Logger):
         import jax
         wf = self.wf
         runner, loader, dec = self.runner, self.loader, self.decision
-        data = loader.original_data.devmem
-        labels = (None if runner._is_mse
-                  else loader.original_labels.devmem)
+        #: --distributed: the launcher attached a ShardedTrainer — chunks
+        #: run under the global mesh (dataset replicated, plan matrices
+        #: sharded over 'data', GSPMD all-reduce per step), with the same
+        #: host-side flow; metric rows read the local replica
+        trainer = getattr(wf, "_sharded_trainer", None)
+        if trainer is not None:
+            trainer.place_dataset(
+                numpy.asarray(loader.original_data.mem),
+                None if runner._is_mse
+                else numpy.asarray(loader.original_labels.mem))
+            data = labels = None        # live in trainer._data/_labels
+            fetch = trainer.fetch
+        else:
+            data = loader.original_data.devmem
+            labels = (None if runner._is_mse
+                      else loader.original_labels.devmem)
+            fetch = lambda tree: jax.tree.map(numpy.asarray, tree)  # noqa: E731
         # fixed validation plan (valid never shuffles); the loader's
         # CURRENT plan supplies epoch 1 IF it is still unconsumed
         # (_position 0: fresh initialize) — the same plan the graph loop
@@ -118,10 +132,23 @@ class EpochScanDriver(Logger):
             rng_stream = prng.get("dropout")
         # non-donating: the chunk-input state must survive the dispatch so
         # a completion inside the chunk can be replayed exactly (below)
-        chunk_fn = runner.epoch_chunk_eval_fn(self.chunk, eval_first=True,
-                                              donate=False)
+        if trainer is not None:
+            def chunk_fn(unused_state, unused_data, unused_labels, idx,
+                         mask, vidx_, vmask_, rng, step0, tidx, tmask):
+                return trainer.chunk_eval_pending(
+                    idx, mask, vidx_, vmask_, rng=rng, step0=step0,
+                    eval_first=True, tidx=tidx, tmask=tmask)
+        else:
+            inner_chunk = runner.epoch_chunk_eval_fn(
+                self.chunk, eval_first=True, donate=False)
+
+            def chunk_fn(state_, data_, labels_, idx, mask, vidx_,
+                         vmask_, rng, step0, tidx_, tmask_):
+                return inner_chunk(state_, data_, labels_, idx, mask,
+                                   vidx_, vmask_, rng=rng, step0=step0,
+                                   tidx=tidx_, tmask=tmask_)
         first_plan_fresh = loader._position == 0
-        state = runner.state
+        state = trainer.state if trainer is not None else runner.state
         snap = getattr(wf, "snapshotter", None)
         while not bool(dec.complete):
             plans = []
@@ -142,11 +169,11 @@ class EpochScanDriver(Logger):
             rng = rng_stream.key() if rng_stream is not None else None
             state_in = state
             state, train_stack, val_stack, test_stack = chunk_fn(
-                state, data, labels, idx, mask, vidx, vmask, rng=rng,
-                step0=step0, tidx=tidx, tmask=tmask)
-            train_rows = jax.tree.map(numpy.asarray, train_stack)
-            val_rows = jax.tree.map(numpy.asarray, val_stack)
-            test_rows = (jax.tree.map(numpy.asarray, test_stack)
+                state, data, labels, idx, mask, vidx, vmask, rng,
+                step0, tidx, tmask)
+            train_rows = fetch(train_stack)
+            val_rows = fetch(val_stack)
+            test_rows = (fetch(test_stack)
                          if test_stack is not None else None)
             done_row = None
             for row in range(self.chunk):
@@ -168,20 +195,51 @@ class EpochScanDriver(Logger):
                 # commit of the stopping epoch's LAST minibatch — replay
                 # rows 0..done_row from the kept input state with the
                 # final epoch truncated to steps-1 minibatches
-                state = self._replay_to_completion(
-                    state_in, data, labels, idx, mask, rng, step0,
-                    done_row, steps)
-            # chunk boundary: state is addressable — snapshot gates fire
-            # (snapshot_state() syncs the runner itself when it writes)
-            runner.state = state
+                if trainer is not None:
+                    state = self._replay_spmd(trainer, idx, mask, rng,
+                                              step0, done_row, steps)
+                else:
+                    state = self._replay_to_completion(
+                        state_in, data, labels, idx, mask, rng, step0,
+                        done_row, steps)
+            # chunk boundary: state is addressable — commit, then the
+            # snapshot gates fire (snapshot_state() syncs the runner
+            # itself when it writes)
+            if trainer is not None:
+                trainer.state = state
+                if done_row is None:
+                    trainer.step_count = step0 + self.chunk * steps
+            else:
+                runner.state = state
             if snap is not None:
                 loader.epoch_ended = True   # plain attr, like the loader
                 snap.run()
-        runner.state = state
-        runner.sync_to_units()
+        if trainer is not None:
+            trainer.state = state
+            trainer.sync_to_runner()
+        else:
+            runner.state = state
+            runner.sync_to_units()
         if snap is not None:
             snap.stop()
         wf._finished = True
+
+    def _replay_spmd(self, trainer, idx, mask, rng, step0, done_row,
+                     steps):
+        """SPMD form of :meth:`_replay_to_completion`: trainer.state is
+        still the chunk input (chunk_eval_pending never commits), so the
+        committing train_epochs/train_epoch calls replay rows 0..done_row
+        with the final epoch truncated — same key folding as the chunk."""
+        import jax
+        if done_row > 0:
+            trainer.train_epochs(idx[:done_row], mask[:done_row],
+                                 rng=rng, step0=step0)
+        off = step0 + done_row * steps
+        erng = (jax.random.fold_in(rng, off) if rng is not None else None)
+        trainer.train_epoch(idx[done_row][:steps - 1],
+                            mask[done_row][:steps - 1],
+                            rng=erng, step0=off)
+        return trainer.state
 
     def _replay_to_completion(self, state, data, labels, idx, mask, rng,
                               step0, done_row, steps):

@@ -150,10 +150,6 @@ class Launcher(Logger):
         if self.epoch_scan and self.evaluate:
             raise ValueError("--epoch-scan is a TRAINING driver; "
                              "--evaluate already runs one scoring pass")
-        if self.epoch_scan and self.distributed:
-            raise ValueError("--epoch-scan is single-process; multi-host "
-                             "epoch scans go through "
-                             "parallel.ShardedTrainer.train_epochs")
         runner = None
         if self.epoch_scan:
             from veles_tpu.epoch_driver import EpochScanDriver

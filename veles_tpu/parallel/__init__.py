@@ -380,6 +380,46 @@ class ShardedTrainer:
         self.step_count = int(step0) + k * idx.shape[-2]
         return train_stack, val_stack
 
+    def chunk_eval_pending(self, idx, mask, vidx, vmask, rng=None,
+                           step0=None, eval_first=False, tidx=None,
+                           tmask=None):
+        """Driver-facing variant of :meth:`train_epochs_eval`: k epochs
+        with per-epoch (k, B, mb) plans plus per-epoch valid (and
+        optional test) evals in one dispatch — NON-donating and
+        NON-committing.  ``self.state`` stays at the chunk input so the
+        epoch-scan driver can replay a mid-chunk completion exactly
+        (see epoch_driver.py); commit with ``self.state = new_state``.
+        Returns (new_state, train stacked, val stacked, test stacked or
+        None)."""
+        import functools
+        import jax
+        import jax.numpy as jnp
+        idx = numpy.asarray(idx)
+        if idx.ndim != 3:
+            raise ValueError("chunk_eval_pending wants (k, B, mb) "
+                             "per-epoch plans")
+        k = idx.shape[0]
+        self.runner.require_epoch_rng(rng)
+        idx_g, mask_g = self._place_plan(idx, mask, rng)
+        vidx_g, vmask_g = self._place_plan(vidx, vmask)
+        tidx_g = tmask_g = None
+        if tidx is not None:
+            tidx_g, tmask_g = self._place_plan(tidx, tmask)
+        cache = getattr(self, "_chunk_pending_jits", None)
+        if cache is None:
+            cache = self._chunk_pending_jits = {}
+        if (k, eval_first) not in cache:
+            cache[(k, eval_first)] = jax.jit(
+                functools.partial(self.runner._epoch_chunk_eval, k,
+                                  eval_first=eval_first),
+                out_shardings=(self.state_shardings, None, None, None))
+        if step0 is None:
+            step0 = self.step_count
+        return cache[(k, eval_first)](
+            self.state, self._data, self._labels, idx_g, mask_g, vidx_g,
+            vmask_g, rng, jnp.asarray(step0, jnp.int32), tidx=tidx_g,
+            tmask=tmask_g)
+
     def _ensure_epoch_jits(self):
         import jax
         if not hasattr(self, "_epoch_train_jit"):
