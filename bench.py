@@ -51,6 +51,32 @@ PEAK_BF16_TFLOPS = {
 }
 
 
+def enable_compile_cache():
+    """Arm jax's persistent compilation cache (best-effort).
+
+    Through the TPU tunnel a conv-program compile is a 20-40 s RPC and
+    the relay has wedged DURING such an RPC in 3/3 hardware sessions —
+    a warm cache removes the recompile (and with it most of the wedge
+    exposure) for every worker subprocess after the first, and across
+    bench/convergence sessions entirely.  Wrapped: if the axon PJRT
+    plugin cannot serialize executables, jax logs and skips caching —
+    never an error.  VELES_JAX_CACHE_DIR overrides the location;
+    VELES_JAX_CACHE=0 disables."""
+    if os.environ.get("VELES_JAX_CACHE", "1") in ("", "0"):
+        return
+    path = os.environ.get(
+        "VELES_JAX_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".jax_cache"))
+    try:
+        import jax
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+    except Exception as exc:                      # pragma: no cover
+        print("[bench] compile cache unavailable: %r" % (exc,),
+              file=sys.stderr)
+
+
 def _peak_tflops():
     import jax
     kind = jax.devices()[0].device_kind
@@ -990,6 +1016,7 @@ def run_configs(wanted, args):
         not in ("", "0")
         and not args.smoke
         and os.environ.get("JAX_PLATFORMS") != "cpu")
+    enable_compile_cache()
     if simulated_dead or not probe_device():
         return {"error": "device probe did not complete — "
                          "TPU tunnel unreachable"}

@@ -80,6 +80,32 @@ def test_convergence_sub_config_addressable():
     assert bench.expand_configs(["mnist", "lm"]) == ["mnist", "lm"]
 
 
+def test_compile_cache_armed_and_disableable(tmp_path, monkeypatch):
+    """enable_compile_cache points jax at the repo cache dir (wedge
+    mitigation: a warm cache removes the 20-40s conv-compile RPC for
+    every worker after the first); VELES_JAX_CACHE=0 disables."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location("bench_mod3", BENCH)
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    import jax
+    cache_dir = str(tmp_path / "jc")
+    monkeypatch.setenv("VELES_JAX_CACHE_DIR", cache_dir)
+    before = jax.config.jax_compilation_cache_dir
+    try:
+        bench.enable_compile_cache()
+        assert jax.config.jax_compilation_cache_dir == cache_dir
+        assert os.path.isdir(cache_dir)
+        other = str(tmp_path / "jc2")
+        monkeypatch.setenv("VELES_JAX_CACHE_DIR", other)
+        monkeypatch.setenv("VELES_JAX_CACHE", "0")
+        bench.enable_compile_cache()        # disabled: must not re-point
+        assert jax.config.jax_compilation_cache_dir == cache_dir
+        assert not os.path.isdir(other)
+    finally:
+        jax.config.update("jax_compilation_cache_dir", before)
+
+
 def test_emit_summary_priority_and_fallbacks():
     import importlib.util
     spec = importlib.util.spec_from_file_location("bench_mod2", BENCH)

@@ -35,6 +35,7 @@ def git_sha():
 
 def run_config(name, seed=1, max_epochs=25, patience=8):
     import bench
+    bench.enable_compile_cache()
 
     if name == "mnist":
         build = lambda: bench.build_mnist(60000, 10000, 100)  # noqa: E731
