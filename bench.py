@@ -465,7 +465,7 @@ def bench_convergence(build_fn, max_epochs=15, patience=5):
 
 
 # -------------------------------------------------------- transformer LM
-def bench_lm(smoke=False, iters=None):
+def bench_lm(smoke=False, iters=None, publish=None):
     """Char-LM transformer training throughput (the beyond-parity
     long-context family): tokens/sec of THE product train step
     (transformer.make_adam_train_step — the same function
@@ -473,6 +473,10 @@ def bench_lm(smoke=False, iters=None):
     (lax.scan) so the tunnel's per-dispatch latency cancels.  TFLOP/s
     uses the standard 6·N·T convention (N = param count, T = tokens;
     attention term excluded) — approximate but comparable across rounds.
+
+    ``publish`` (optional) is called with the partial record after each
+    sub-leg (train / remat / flash / decode) so the orchestrator keeps
+    completed legs if a later leg's compile hangs the worker.
     """
     import jax
     import jax.numpy as jnp
@@ -530,11 +534,15 @@ def bench_lm(smoke=False, iters=None):
         "approx_tflops": round(6.0 * n_params * toks / step_s / 1e12, 2),
         "flops_convention": "6*N*T, attention excluded",
     }
+    if publish:
+        publish(rec)
     # the HBM-for-FLOPs trade, priced: same step with per-block
     # jax.checkpoint (recompute ~1 extra fwd in the bwd pass)
     remat_s = measure(remat=True)
     rec["tokens_per_sec_remat"] = round(toks / remat_s, 1)
     rec["remat_overhead_pct"] = round(100.0 * (remat_s / step_s - 1.0), 1)
+    if publish:
+        publish(rec)
 
     # attention-backend comparison: the bundled TPU Pallas flash kernel
     # vs XLA's fused attention on the SAME train step (TPU only — the
@@ -557,6 +565,8 @@ def bench_lm(smoke=False, iters=None):
             rec["flash_pallas_error"] = repr(exc)[-300:]
         finally:
             A.set_attention_backend("xla")
+    if publish:
+        publish(rec)
 
     # serving side: KV-cached greedy decode throughput.  generate() is
     # one jit call (prefill + scan); both timings PIN the same max_len
@@ -580,6 +590,8 @@ def bench_lm(smoke=False, iters=None):
     rec["decode_tokens_per_sec"] = round(dec_mb / per_tok, 1)
     rec["decode_ms_per_token"] = round(per_tok * 1e3, 3)
     rec["decode_batch"] = dec_mb
+    if publish:
+        publish(rec)
 
     # GQA serving lever: same model shape with 1 kv head — the decode
     # delta vs the record above is what grouped-query attention buys
@@ -1222,7 +1234,9 @@ def run_configs(wanted, args):
             guarded("convergence_" + name, _bench_conv)
 
     def _bench_lm():
-        results["char_lm"] = bench_lm(smoke=args.smoke)
+        results["char_lm"] = bench_lm(
+            smoke=args.smoke,
+            publish=lambda r: results.__setitem__("char_lm", dict(r)))
         print("char_lm: %s" % results["char_lm"], file=sys.stderr)
 
     if "lm" in wanted:
