@@ -662,11 +662,14 @@ def bench_scaling(smoke=False, seconds=2.0):
 
 
 # ------------------------------------------------- sgd backend (XLA/Pallas)
-def bench_sgd_backends(n=4 * 1024 * 1024, iters=20, smoke=False):
+def bench_sgd_backends(n=4 * 1024 * 1024, iters=20, smoke=False,
+                       publish=None):
     """XLA-vs-Pallas fused-SGD-update comparison (SURVEY §2.4 custom-kernel
     row): per-update device time on an AlexNet-FC-sized fp32 tensor,
     measured by in-jit repetition (K-vs-1 difference — dispatch overhead
-    cancels).  The winner keeps the default (functional._SGD_BACKEND)."""
+    cancels).  The winner keeps the default (functional._SGD_BACKEND).
+    ``publish`` streams the partial record after each backend so a hang
+    in the pallas leg cannot discard the measured xla number."""
     import jax
     import jax.numpy as jnp
     from veles_tpu.ops import functional as F
@@ -694,6 +697,8 @@ def bench_sgd_backends(n=4 * 1024 * 1024, iters=20, smoke=False):
             record[backend + "_us"] = round(
                 (best_time(lambda: fk(p0, v0, g0))
                  - best_time(lambda: f1(p0, v0, g0))) / iters * 1e6, 2)
+            if publish:
+                publish(record)
         finally:
             F.set_sgd_backend("xla")
     if "xla_us" in record and "pallas_us" in record:
@@ -804,12 +809,13 @@ def bench_native_runner(smoke=False):
 
 
 # --------------------------------------------------- lrn backend (XLA/Pallas)
-def bench_lrn_backends(iters=8, smoke=False):
+def bench_lrn_backends(iters=8, smoke=False, publish=None):
     """XLA-vs-Pallas LRN comparison at the AlexNet-LRN1 train shape
     (fwd+bwd — the top memory-bound item of the post-bf16 step,
     docs/PERF.md round-5 analysis): per-application device time by
     in-jit K-vs-1 repetition.  The winner keeps the default
-    (functional._LRN_BACKEND)."""
+    (functional._LRN_BACKEND).  ``publish`` streams the partial record
+    after each backend (see bench_sgd_backends)."""
     import jax
     import jax.numpy as jnp
     from veles_tpu.ops import functional as F
@@ -838,6 +844,8 @@ def bench_lrn_backends(iters=8, smoke=False):
             record[backend + "_us"] = round(
                 (best_time(lambda: fk(x0, dy0))
                  - best_time(lambda: f1(x0, dy0))) / iters * 1e6, 2)
+            if publish:
+                publish(record)
         finally:
             F.set_lrn_backend("xla")
     if "xla_us" in record and "pallas_us" in record:
@@ -1233,10 +1241,15 @@ def run_configs(wanted, args):
                       file=sys.stderr)
             guarded("convergence_" + name, _bench_conv)
 
+    def _publisher(key):
+        """Stream a copy of a growing record under ``key`` (partials
+        survive a later-leg hang; copies keep streamed snapshots
+        immune to in-place mutation)."""
+        return lambda r: results.__setitem__(key, dict(r))
+
     def _bench_lm():
         results["char_lm"] = bench_lm(
-            smoke=args.smoke,
-            publish=lambda r: results.__setitem__("char_lm", dict(r)))
+            smoke=args.smoke, publish=_publisher("char_lm"))
         print("char_lm: %s" % results["char_lm"], file=sys.stderr)
 
     if "lm" in wanted:
@@ -1250,14 +1263,16 @@ def run_configs(wanted, args):
         guarded("scaling", _bench_scaling)
 
     def _bench_sgd():
-        results["sgd_update"] = bench_sgd_backends(smoke=args.smoke)
+        results["sgd_update"] = bench_sgd_backends(
+            smoke=args.smoke, publish=_publisher("sgd_update"))
         print("sgd_update: %s" % results["sgd_update"], file=sys.stderr)
 
     if "sgd" in wanted:
         guarded("sgd", _bench_sgd)
 
     def _bench_lrn():
-        results["lrn_fwd_bwd"] = bench_lrn_backends(smoke=args.smoke)
+        results["lrn_fwd_bwd"] = bench_lrn_backends(
+            smoke=args.smoke, publish=_publisher("lrn_fwd_bwd"))
         print("lrn_fwd_bwd: %s" % results["lrn_fwd_bwd"],
               file=sys.stderr)
 
