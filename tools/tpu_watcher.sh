@@ -27,6 +27,13 @@ while true; do
             tests/test_pallas.py -q -rs \
             >pallas_tpu_r5.out 2>&1
         echo "[watcher] pallas-tpu rc=$? at $(date -u +%FT%TZ)"
+        # post-bf16 AlexNet trace: the committed round-4 artifact is
+        # fp32-HIGHEST; this one is the evidence for the bf16 default
+        # (predicted ~18 ms/step in docs/PERF.md).
+        timeout 1800 python tools/trace_step.py alexnet_bf16 \
+            /tmp/veles_trace_alexnet_bf16 \
+            >trace_alexnet_bf16_r5.out 2>&1
+        echo "[watcher] bf16-trace rc=$? at $(date -u +%FT%TZ)"
         exit 0
     fi
     echo "[watcher] tunnel dead at $(date -u +%FT%TZ)"

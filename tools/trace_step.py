@@ -1,7 +1,13 @@
 """Capture a jax.profiler trace of a bench config's train step and print a
 per-op cost breakdown (top XLA ops by total device time).
 
-Usage: python tools/trace_step.py [mnist|cifar|alexnet] [outdir]
+Usage: python tools/trace_step.py [mnist|cifar|alexnet][_bf16] [outdir]
+
+A ``_bf16`` suffix applies the measured conv-net fast path
+(``functional.set_matmul_precision("bfloat16")`` — operand casts, fp32
+accumulation) before building, so the captured trace matches the
+``alexnet_bf16`` bench record (docs/PERF.md round-5 analysis predicted
+~18 ms/step; the trace is the evidence).
 """
 import glob
 import gzip
@@ -12,6 +18,11 @@ import time
 from collections import defaultdict
 
 import numpy
+
+# runnable as `python tools/trace_step.py` from anywhere: the repo root
+# (where bench.py and veles_tpu/ live) is not on sys.path when the
+# script dir is tools/
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def _sync(x):
@@ -25,6 +36,10 @@ def main():
     import jax
     import bench
 
+    if config.endswith("_bf16"):
+        from veles_tpu.ops import functional as F
+        F.set_matmul_precision("bfloat16")
+        config = config[:-len("_bf16")]
     if config == "mnist":
         wf = bench.build_mnist(60000, 10000, 100)
     elif config == "cifar":
