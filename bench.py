@@ -94,11 +94,11 @@ def _sync(tree):
 
 
 # --------------------------------------------------------------- workflows
-def build_mnist(n_train, n_valid, mb):
+def build_mnist(n_train, n_valid, mb, seed=1):
     from veles_tpu import prng
     from veles_tpu.config import root
     prng.reset()
-    prng.seed_all(1)
+    prng.seed_all(seed)
     root.mnist.update({
         "loader": {"minibatch_size": mb, "n_train": n_train,
                    "n_valid": n_valid},
@@ -120,11 +120,11 @@ def build_mnist(n_train, n_valid, mb):
 build_workflow = build_mnist
 
 
-def build_cifar(n_train, n_valid, mb):
+def build_cifar(n_train, n_valid, mb, seed=1):
     from veles_tpu import prng
     from veles_tpu.config import root
     prng.reset()
-    prng.seed_all(1)
+    prng.seed_all(seed)
     root.__dict__.pop("cifar", None)
     root.cifar.update({
         "loader": {"minibatch_size": mb, "n_train": n_train,

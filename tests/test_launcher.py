@@ -105,6 +105,41 @@ class TestEpochScanDriver:
                     numpy.asarray(fa.weights.mem),
                     numpy.asarray(fb.weights.mem), rtol=2e-5, atol=2e-6)
 
+    def test_spmd_driver_step_count_matches_graph_loop(self):
+        """Mid-chunk completion under --distributed: the replay trains
+        the stopping epoch truncated to steps-1, but graph mode
+        DISPATCHES (and counts in train_steps) the discarded last
+        minibatch too — the driver must leave trainer.step_count at the
+        graph-loop value so a resumed lr policy starts at the same step
+        (round-5 review finding)."""
+        import jax
+        from veles_tpu.launcher import Launcher
+        from veles_tpu.epoch_driver import EpochScanDriver
+        from veles_tpu.parallel import make_mesh, ShardedTrainer
+
+        wf_a = _build_tiny_mnist(seed=11, max_epochs=3)
+        Launcher(wf_a, stats=False).boot()
+        graph_steps = wf_a.fused_step.train_steps
+        assert graph_steps > 0
+
+        wf_b = _build_tiny_mnist(seed=11, max_epochs=3)
+        wf_b.initialize()
+        # 2 devices: the helper's minibatch of 50 must divide the data axis
+        mesh = make_mesh(2, devices=jax.devices("cpu")[:2])
+        trainer = ShardedTrainer(wf_b._fused_runner, mesh)
+        wf_b._sharded_trainer = trainer
+        EpochScanDriver(wf_b, chunk=1).run()
+        assert bool(wf_b.decision.complete)
+        assert trainer.step_count == graph_steps
+        # and the replayed weights still match the graph loop exactly
+        trainer.sync_to_runner()
+        wf_b._fused_runner.sync_to_units()
+        for fa, fb in zip(wf_a.forwards, wf_b.forwards):
+            if fa.has_params:
+                numpy.testing.assert_allclose(
+                    numpy.asarray(fa.weights.mem),
+                    numpy.asarray(fb.weights.mem), rtol=2e-5, atol=2e-6)
+
     def test_chunked_matches_chunk1(self):
         """chunk=2 trains the same trajectory as chunk=1 (decisions at
         coarser readback granularity, identical best tracking here

@@ -37,15 +37,20 @@ def run_config(name, seed=1, max_epochs=25, patience=8):
     import bench
     bench.enable_compile_cache()
 
+    # the builders thread the seed through to prng.seed_all, so the
+    # printed ``seed=%d`` is the seed that actually governed init and
+    # shuffle order (it was silently dead before)
     if name == "mnist":
-        build = lambda: bench.build_mnist(60000, 10000, 100)  # noqa: E731
+        build = lambda: bench.build_mnist(60000, 10000, 100,  # noqa: E731
+                                          seed=seed)
     elif name == "cifar":
-        build = lambda: bench.build_cifar(50000, 10000, 100)  # noqa: E731
+        build = lambda: bench.build_cifar(50000, 10000, 100,  # noqa: E731
+                                          seed=seed)
     elif name == "cifar_bf16":
         def build():
             from veles_tpu.ops import functional as F
             F.set_matmul_precision("bfloat16")
-            return bench.build_cifar(50000, 10000, 100)
+            return bench.build_cifar(50000, 10000, 100, seed=seed)
     else:
         raise SystemExit("unknown config %r" % name)
 
@@ -74,9 +79,10 @@ def main():
                         default=["mnist", "cifar", "cifar_bf16"])
     parser.add_argument("--max-epochs", type=int, default=25)
     parser.add_argument("--patience", type=int, default=8)
+    parser.add_argument("--seed", type=int, default=1)
     args = parser.parse_args()
     for name in (args.configs or ["mnist", "cifar", "cifar_bf16"]):
-        run_config(name, max_epochs=args.max_epochs,
+        run_config(name, seed=args.seed, max_epochs=args.max_epochs,
                    patience=args.patience)
 
 

@@ -209,6 +209,14 @@ class EpochScanDriver(Logger):
                 trainer.state = state
                 if done_row is None:
                     trainer.step_count = step0 + self.chunk * steps
+                else:
+                    # graph-mode parity for the COUNTER too: the graph
+                    # loop dispatches (and counts in train_steps) the
+                    # stopping epoch's last minibatch even though its
+                    # commit is discarded; the replay trains steps-1, so
+                    # set the counter to the full-epoch value — a
+                    # resumed lr policy must start at the same step
+                    trainer.step_count = step0 + (done_row + 1) * steps
             else:
                 runner.state = state
             if snap is not None:

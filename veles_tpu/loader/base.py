@@ -214,8 +214,11 @@ class Loader(Unit):
         """Build this epoch's minibatch plan: test → validation → train.
 
         SPMD mode plans over the GLOBAL index space (identical on every
-        process) and stores each process's contiguous slice of the padded
-        global chunk, keeping the global live count."""
+        process) and stores the padded GLOBAL chunk itself, with the
+        global live count; each process takes its contiguous slice only
+        at consumption time (run() / local_chunk).  Keeping the plan
+        shard-identity-independent is what lets a process-0 snapshot
+        resume bit-exactly on every process (load_state_dict)."""
         stream = prng.get(self.prng_stream)
         pi, pc = self._shard
         spmd = self._spmd_shard

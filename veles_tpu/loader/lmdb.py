@@ -37,6 +37,9 @@ def _open_env(path):
         def items(self):
             with self._env.begin() as txn:
                 yield from txn.cursor()
+
+        def close(self):
+            self._env.close()
     return _PkgEnv(path)
 
 
@@ -125,16 +128,20 @@ def lmdb_to_records(lmdb_path, out_path, class_lengths=None):
     import struct
     from veles_tpu.loader.records import MAGIC
     env = _open_env(lmdb_path)
-    n = env.stat()["entries"]
-    if class_lengths is None:
-        class_lengths = [0, 0, n]
-    if sum(class_lengths) != n:
-        raise ValueError("class_lengths %s don't sum to %d"
-                         % (class_lengths, n))
-    if n == 0:
-        raise ValueError("empty LMDB %r: nothing to convert (a record "
-                         "file needs at least one sample to fix the "
-                         "header shape)" % lmdb_path)
+    try:
+        n = env.stat()["entries"]
+        if class_lengths is None:
+            class_lengths = [0, 0, n]
+        if sum(class_lengths) != n:
+            raise ValueError("class_lengths %s don't sum to %d"
+                             % (class_lengths, n))
+        if n == 0:
+            raise ValueError("empty LMDB %r: nothing to convert (a "
+                             "record file needs at least one sample to "
+                             "fix the header shape)" % lmdb_path)
+    except Exception:
+        env.close()               # validation errors must not leak the map
+        raise
     labels = numpy.zeros(n, numpy.int32)
     written = 0
     sample_shape = None
@@ -172,6 +179,7 @@ def lmdb_to_records(lmdb_path, out_path, class_lengths=None):
             f.write(labels.tobytes())
         os.replace(tmp_path, out_path)
     finally:
+        env.close()               # release the mmap/fd promptly
         if os.path.exists(tmp_path):
             os.unlink(tmp_path)
     return out_path
@@ -191,11 +199,14 @@ class LMDBLoader(Loader):
         """uint8 HWC arrays — float conversion happens per minibatch (a
         float32 copy of an ImageNet split would 4x the resident set)."""
         env = _open_env(path)
-        xs, ys = [], []
-        for _, chw, label in _iter_datums(env):
-            xs.append(chw.transpose(1, 2, 0))
-            ys.append(label)
-        return numpy.stack(xs), numpy.asarray(ys, numpy.int32)
+        try:
+            xs, ys = [], []
+            for _, chw, label in _iter_datums(env):
+                xs.append(chw.transpose(1, 2, 0))
+                ys.append(label)
+            return numpy.stack(xs), numpy.asarray(ys, numpy.int32)
+        finally:
+            env.close()           # splits are copied out; drop the map
 
     def load_data(self):
         valid = ((self._load_split(self.validation_path))

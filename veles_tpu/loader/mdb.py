@@ -62,32 +62,55 @@ class Env:
         import mmap
         self._file = open(_data_path(path), "rb")
         try:
-            # memory-map, exactly like liblmdb: an ImageNet-scale env
-            # must not be slurped into RAM to read its first Datum
-            self._map = mmap.mmap(self._file.fileno(), 0,
-                                  access=mmap.ACCESS_READ)
-        except ValueError:        # empty file: mmap(0) is illegal
-            self._map = b""
-        if len(self._map) < 2 * PAGE_SIZE:
-            raise MDBFormatError("file too small for LMDB meta pages")
-        metas = []
-        for i in (0, 1):
-            base = i * PAGE_SIZE + PAGEHDRSZ
-            magic, version = struct.unpack_from("<II", self._map, base)
-            if magic != MAGIC:
-                continue
-            if version != DATA_VERSION:
-                raise MDBFormatError("unsupported MDB data version %d"
-                                     % version)
-            main_db = base + 24 + 48    # skip address+mapsize, FREE db
-            (entries,) = struct.unpack_from("<Q", self._map, main_db + 32)
-            (root,) = struct.unpack_from("<Q", self._map, main_db + 40)
-            (txnid,) = struct.unpack_from("<Q", self._map,
-                                          base + 24 + 2 * 48 + 8)
-            metas.append((txnid, root, entries))
-        if not metas:
-            raise MDBFormatError("no valid LMDB meta page (bad magic)")
-        _, self._root, self.entries = max(metas)
+            try:
+                # memory-map, exactly like liblmdb: an ImageNet-scale env
+                # must not be slurped into RAM to read its first Datum
+                self._map = mmap.mmap(self._file.fileno(), 0,
+                                      access=mmap.ACCESS_READ)
+            except ValueError:    # empty file: mmap(0) is illegal
+                self._map = b""
+            if len(self._map) < 2 * PAGE_SIZE:
+                raise MDBFormatError("file too small for LMDB meta pages")
+            metas = []
+            for i in (0, 1):
+                base = i * PAGE_SIZE + PAGEHDRSZ
+                magic, version = struct.unpack_from("<II", self._map, base)
+                if magic != MAGIC:
+                    continue
+                if version != DATA_VERSION:
+                    raise MDBFormatError("unsupported MDB data version %d"
+                                         % version)
+                main_db = base + 24 + 48  # skip address+mapsize, FREE db
+                (entries,) = struct.unpack_from("<Q", self._map,
+                                                main_db + 32)
+                (root,) = struct.unpack_from("<Q", self._map, main_db + 40)
+                (txnid,) = struct.unpack_from("<Q", self._map,
+                                              base + 24 + 2 * 48 + 8)
+                metas.append((txnid, root, entries))
+            if not metas:
+                raise MDBFormatError("no valid LMDB meta page (bad magic)")
+            _, self._root, self.entries = max(metas)
+        except Exception:
+            self.close()          # a failed open must not leak the fd
+            raise
+
+    def close(self):
+        """Release the mmap and file handle (mirrors
+        ``lmdb.Environment.close``); safe to call twice.  A long-lived
+        training process should not pin an ImageNet-scale map after the
+        splits are copied out."""
+        m, f = getattr(self, "_map", b""), getattr(self, "_file", None)
+        self._map, self._file = b"", None
+        if not isinstance(m, bytes):
+            m.close()
+        if f is not None:
+            f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
 
     def stat(self):
         return {"entries": self.entries}

@@ -189,8 +189,11 @@ def rolling_slot_update(slot_pos, pos, window, sinks=0):
     holds (-1 = never written).  Returns (write slot, updated slot_pos,
     live mask): a slot is live iff it holds a real position that is a
     sink or inside the window."""
-    in_ring = pos >= sinks
-    slot = jnp.where(in_ring, sinks + (pos - sinks) % window, pos)         if sinks else pos % window
+    if sinks:
+        in_ring = pos >= sinks
+        slot = jnp.where(in_ring, sinks + (pos - sinks) % window, pos)
+    else:
+        slot = pos % window
     slot_pos = jax.lax.dynamic_update_slice(
         slot_pos, jnp.asarray(pos, slot_pos.dtype)[None], (slot,))
     live = (slot_pos >= 0) & (slot_pos <= pos)

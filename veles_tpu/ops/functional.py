@@ -43,17 +43,22 @@ def set_matmul_precision(mode):
 
     The mode is read at TRACE time, so already-jitted functions would keep
     their old precision; jax caches are cleared here to force a retrace on
-    the next call.
+    the next call — but only on an actual change: a restore-to-current
+    no-op must not wipe every compiled program in the process (a recompile
+    is a 20-40 s RPC per conv program through the TPU tunnel).
     """
     global _PRECISION, _CAST_BF16
     if mode == "float32":
-        _PRECISION, _CAST_BF16 = jax.lax.Precision.HIGHEST, False
+        new = (jax.lax.Precision.HIGHEST, False)
     elif mode == "default":
-        _PRECISION, _CAST_BF16 = jax.lax.Precision.DEFAULT, False
+        new = (jax.lax.Precision.DEFAULT, False)
     elif mode == "bfloat16":
-        _PRECISION, _CAST_BF16 = jax.lax.Precision.DEFAULT, True
+        new = (jax.lax.Precision.DEFAULT, True)
     else:
         raise ValueError("unknown matmul precision mode %r" % (mode,))
+    if new == (_PRECISION, _CAST_BF16):
+        return
+    _PRECISION, _CAST_BF16 = new
     jax.clear_caches()
 
 
@@ -431,10 +436,13 @@ _LRN_BACKEND = "xla"
 
 
 def set_lrn_backend(mode):
-    """mode: 'xla' | 'pallas'.  Clears jit caches (trace-time flag)."""
+    """mode: 'xla' | 'pallas'.  Clears jit caches (trace-time flag) —
+    only on an actual change (see set_matmul_precision)."""
     global _LRN_BACKEND
     if mode not in ("xla", "pallas"):
         raise ValueError("unknown lrn backend %r" % (mode,))
+    if mode == _LRN_BACKEND:
+        return
     _LRN_BACKEND = mode
     jax.clear_caches()
 
@@ -602,10 +610,13 @@ _SGD_BACKEND = "xla"
 
 
 def set_sgd_backend(mode):
-    """mode: 'xla' | 'pallas'.  Clears jit caches (trace-time flag)."""
+    """mode: 'xla' | 'pallas'.  Clears jit caches (trace-time flag) —
+    only on an actual change (see set_matmul_precision)."""
     global _SGD_BACKEND
     if mode not in ("xla", "pallas"):
         raise ValueError("unknown sgd backend %r" % (mode,))
+    if mode == _SGD_BACKEND:
+        return
     _SGD_BACKEND = mode
     jax.clear_caches()
 

@@ -64,6 +64,16 @@ class MarkdownBackend:
         return "\n".join(lines) + "\n"
 
 
+def _xml_cell(row, key):
+    """One escaped table cell: floats formatted, everything else
+    html-escaped — an unescaped & or < malforms an HTML report and
+    400s a Confluence storage-format POST (shared by both backends)."""
+    value = row.get(key)
+    if isinstance(value, float):
+        return "%.6g" % value
+    return html.escape(str(value if value is not None else ""))
+
+
 class HTMLBackend:
     suffix = ".html"
 
@@ -75,9 +85,7 @@ class HTMLBackend:
             body = ""
             for row in facts["epochs"]:
                 body += "<tr>" + "".join(
-                    "<td>%s</td>" % (("%.6g" % row[k])
-                                     if isinstance(row.get(k), float)
-                                     else row.get(k, "")) for k in keys) + \
+                    "<td>%s</td>" % _xml_cell(row, k) for k in keys) + \
                     "</tr>"
             rows = "<table><tr>%s</tr>%s</table>" % (head, body)
         imgs = ""
@@ -95,9 +103,9 @@ class HTMLBackend:
                 "%(rows)s%(imgs)s</body></html>") % {
             "name": html.escape(str(facts["workflow"])),
             "cls": html.escape(str(facts["workflow_class"])),
-            "at": facts["generated_at"],
-            "best": facts["best_metric"],
-            "epoch": facts["best_epoch"],
+            "at": html.escape(str(facts["generated_at"])),
+            "best": html.escape(str(facts["best_metric"])),
+            "epoch": html.escape(str(facts["best_epoch"])),
             "rows": rows,
             "imgs": imgs,
         }
@@ -126,10 +134,8 @@ class ConfluenceBackend:
             body = ""
             for row in facts["epochs"]:
                 body += "<tr>" + "".join(
-                    "<td>%s</td>" % (("%.6g" % row[k])
-                                     if isinstance(row.get(k), float)
-                                     else row.get(k, "")) for k in keys) \
-                    + "</tr>"
+                    "<td>%s</td>" % _xml_cell(row, k)
+                    for k in keys) + "</tr>"
             rows = "<table><tbody><tr>%s</tr>%s</tbody></table>" % (
                 head, body)
         return ("<h1>Training report: %(name)s</h1>"
@@ -142,9 +148,9 @@ class ConfluenceBackend:
                 "%(rows)s") % {
             "name": html.escape(str(facts["workflow"])),
             "cls": html.escape(str(facts["workflow_class"])),
-            "at": facts["generated_at"],
-            "best": facts["best_metric"],
-            "epoch": facts["best_epoch"],
+            "at": html.escape(str(facts["generated_at"])),
+            "best": html.escape(str(facts["best_metric"])),
+            "epoch": html.escape(str(facts["best_epoch"])),
             "units": ", ".join(facts["units"]),
             "rows": rows,
         }

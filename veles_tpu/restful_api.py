@@ -150,7 +150,11 @@ def serve_lm(workflow, host="127.0.0.1", port=8180, max_new=256):
     # trainers pay the block unstack here, not per request)
     params = trainer._to_portable(trainer.params)
     cache_len = int(trainer.max_len)
-    tiers = sorted({t for t in (8, 32, max_new) if t <= max_new})
+    # geometric ladder bounds BOTH the compile count (one generate
+    # program per tier) and the decode overshoot (≤4× the requested
+    # n_new; {8,32,max} alone made an n_new=40 request pay a full
+    # max_new=256 decode)
+    tiers = sorted({t for t in (8, 32, 128, max_new) if t <= max_new})
 
     def handler(request):
         prompt = numpy.asarray(request["input"], numpy.int32)
