@@ -34,6 +34,31 @@ while true; do
             /tmp/veles_trace_alexnet_bf16 \
             >trace_alexnet_bf16_r5.out 2>&1
         echo "[watcher] bf16-trace rc=$? at $(date -u +%FT%TZ)"
+        # pass 2: re-run ONLY the configs pass 1 failed (wedge-killed or
+        # skipped-behind-a-wedge).  By now the relay has had the whole
+        # convergence+pallas+trace interval to shed a wedged claim, and
+        # configs that did complete earlier populated the compile cache,
+        # so their programs are off the relay's critical path entirely.
+        # Nothing failed -> no pass 2 (don't double device time).
+        FAILED=$(python - "$OUT.out" <<'PYEOF'
+import json, sys
+try:
+    line = [l for l in open(sys.argv[1]) if l.startswith("{")][-1]
+    cfgs = json.loads(line).get("configs", {})
+except Exception:
+    sys.exit(0)
+names = sorted({k[:-len("_error")] for k in cfgs if k.endswith("_error")})
+print(",".join(names))
+PYEOF
+)
+        if [ -n "$FAILED" ]; then
+            echo "[watcher] pass2 re-running failed configs: $FAILED"
+            python bench.py --configs "$FAILED" \
+                >"${OUT}_pass2.out" 2>"${OUT}_pass2.err"
+            echo "[watcher] bench pass2 rc=$? at $(date -u +%FT%TZ)"
+        else
+            echo "[watcher] pass2 not needed (all configs landed)"
+        fi
         exit 0
     fi
     echo "[watcher] tunnel dead at $(date -u +%FT%TZ)"
