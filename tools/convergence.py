@@ -80,10 +80,45 @@ def main():
     parser.add_argument("--max-epochs", type=int, default=25)
     parser.add_argument("--patience", type=int, default=8)
     parser.add_argument("--seed", type=int, default=1)
+    parser.add_argument("--worker", default=None, metavar="CONFIG",
+                        help=argparse.SUPPRESS)   # internal: one config
+    parser.add_argument("--in-process", action="store_true",
+                        help="no per-config watchdog subprocesses")
     args = parser.parse_args()
-    for name in (args.configs or ["mnist", "cifar", "cifar_bf16"]):
-        run_config(name, seed=args.seed, max_epochs=args.max_epochs,
-                   patience=args.patience)
+    configs = args.configs or ["mnist", "cifar", "cifar_bf16"]
+    if args.worker is not None:
+        run_config(args.worker, seed=args.seed,
+                   max_epochs=args.max_epochs, patience=args.patience)
+        return
+    if args.in_process:
+        for name in configs:
+            run_config(name, seed=args.seed, max_epochs=args.max_epochs,
+                       patience=args.patience)
+        return
+    # per-config watchdog subprocesses, like bench.py's orchestrator: a
+    # TPU-tunnel wedge mid-config costs that config, not the ones behind
+    # it (each summary line prints from the worker the moment it lands)
+    per_config = float(os.environ.get("VELES_CONV_CONFIG_TIMEOUT_S",
+                                      3600))
+    failed = 0
+    for name in configs:
+        cmd = [sys.executable, os.path.abspath(__file__), "--worker",
+               name, "--seed", str(args.seed),
+               "--max-epochs", str(args.max_epochs),
+               "--patience", str(args.patience)]
+        try:
+            rc = subprocess.call(cmd, timeout=per_config)
+            if rc:
+                failed += 1
+                print("%s: worker failed (rc=%d)" % (name, rc),
+                      flush=True)
+        except subprocess.TimeoutExpired:
+            failed += 1
+            print("%s: killed after %.0fs (hung device dispatch/compile)"
+                  % (name, per_config), flush=True)
+    # a failed/hung leg must surface in the exit code — the watcher log's
+    # "convergence rc=" is how automation judges whether the rows landed
+    sys.exit(1 if failed else 0)
 
 
 if __name__ == "__main__":

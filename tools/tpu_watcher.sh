@@ -17,7 +17,8 @@ while true; do
         echo "[watcher] tunnel ALIVE at $(date -u +%FT%TZ) — running bench"
         python bench.py >"${OUT}.out" 2>"${OUT}.err"
         echo "[watcher] bench rc=$? at $(date -u +%FT%TZ)"
-        timeout 3600 python tools/convergence.py \
+        VELES_CONV_CONFIG_TIMEOUT_S=1500 timeout 7200 \
+            python tools/convergence.py \
             >convergence_r5_tpu.out 2>convergence_r5_tpu.err
         echo "[watcher] convergence rc=$? at $(date -u +%FT%TZ)"
         # the 3 TPU-only Pallas PRNG kernel tests (skip off-hardware):
