@@ -7,11 +7,16 @@
 # Usage: bash tools/tpu_watcher.sh [interval_seconds]
 set -u
 cd "$(dirname "$0")/.."
-INTERVAL="${1:-600}"
+INTERVAL="${1:-900}"
 OUT=bench_r5_tpu
 echo "[watcher] started $(date -u +%FT%TZ), probing every ${INTERVAL}s"
 while true; do
-    probe=$(VELES_BENCH_PROBE_S=120 timeout 180 \
+    # patient probe: a probe that gives up and exits right as the relay
+    # finally grants its claim is itself a client dying mid-claim — the
+    # wedge-arming event.  300 s of patience means a slow-recovering
+    # relay's grant gets USED (the probe completes) instead of abandoned,
+    # and the long interval keeps abandoned-claim pressure low.
+    probe=$(VELES_BENCH_PROBE_S=300 timeout 420 \
             python bench.py --worker __probe__ 2>/dev/null | tail -1)
     if echo "$probe" | grep -q '"ok": true'; then
         echo "[watcher] tunnel ALIVE at $(date -u +%FT%TZ) — running bench"
