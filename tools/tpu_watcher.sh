@@ -7,7 +7,11 @@
 # Usage: bash tools/tpu_watcher.sh [interval_seconds]
 set -u
 cd "$(dirname "$0")/.."
-INTERVAL="${1:-900}"
+# Default interval is deliberately SPARSE: the round-5 sessions showed
+# that sub-10-minute probe cycles can keep a wedged relay wedged (every
+# abandoned probe claim is a client dying mid-claim), while every
+# observed recovery landed during a probe-quiet gap.  Quiet beats eager.
+INTERVAL="${1:-3600}"
 OUT=bench_r5_tpu
 echo "[watcher] started $(date -u +%FT%TZ), probing every ${INTERVAL}s"
 while true; do
