@@ -35,6 +35,27 @@ class TestLRPolicies:
         assert float(fn(1.0, self.t(50))) == pytest.approx(0.5, rel=1e-5)
         assert float(fn(1.0, self.t(1000))) == pytest.approx(0.0, abs=1e-7)
 
+    def test_warmup_cosine(self):
+        fn = make_policy({"policy": "warmup_cosine", "warmup": 10,
+                          "steps": 110, "final_scale": 0.1})
+        assert float(fn(1.0, self.t(0))) == pytest.approx(0.0)
+        assert float(fn(1.0, self.t(5))) == pytest.approx(0.5, rel=1e-5)
+        # peak at the warmup boundary, half-decayed at the midpoint,
+        # floor at final_scale after `steps`
+        assert float(fn(1.0, self.t(10))) == pytest.approx(1.0, rel=1e-5)
+        assert float(fn(1.0, self.t(60))) == pytest.approx(0.55, rel=1e-4)
+        assert float(fn(1.0, self.t(110))) == pytest.approx(0.1, abs=1e-6)
+        assert float(fn(1.0, self.t(500))) == pytest.approx(0.1, abs=1e-6)
+        with pytest.raises(ValueError, match="warmup"):
+            make_policy({"policy": "warmup_cosine", "warmup": 10,
+                         "steps": 10})
+
+    def test_warmup_rsqrt(self):
+        fn = make_policy({"policy": "warmup_rsqrt", "warmup": 100})
+        assert float(fn(1.0, self.t(50))) == pytest.approx(0.5, rel=1e-5)
+        assert float(fn(1.0, self.t(100))) == pytest.approx(1.0, rel=1e-5)
+        assert float(fn(1.0, self.t(400))) == pytest.approx(0.5, rel=1e-5)
+
     def test_arbitrary(self):
         fn = make_policy({"policy": "arbitrary",
                           "points": [(0, 1.0), (10, 0.1), (20, 0.01)]})

@@ -84,6 +84,41 @@ def linear(final=0.0, steps=10000):
     return fn
 
 
+@_register("warmup_cosine")
+def warmup_cosine(warmup=1000, steps=10000, final_scale=0.0):
+    """Linear warmup 0 -> lr0 over ``warmup`` steps, then cosine decay to
+    ``final_scale * lr0`` by step ``steps`` (flat after).  The standard
+    transformer-family schedule (beyond parity — the reference predates
+    it); composes with the LM family's adam step like every policy here:
+    pure in the traced global step, zero per-iteration cost."""
+    if not 0 <= warmup < steps:
+        raise ValueError("warmup %d must be in [0, total steps %d)"
+                         % (warmup, steps))
+
+    def fn(lr0, t):
+        import jax.numpy as jnp
+        tf = t.astype(jnp.float32)
+        ramp = tf / max(float(warmup), 1.0)
+        frac = jnp.clip((tf - warmup) / float(steps - warmup), 0.0, 1.0)
+        cos = final_scale + (1.0 - final_scale) * 0.5 * (
+            1.0 + jnp.cos(jnp.pi * frac))
+        return lr0 * jnp.where(tf < warmup, ramp, cos)
+    return fn
+
+
+@_register("warmup_rsqrt")
+def warmup_rsqrt(warmup=4000):
+    """The original Transformer ("Noam") schedule: linear warmup then
+    inverse-square-root decay, normalized so lr peaks at lr0 at step
+    ``warmup`` (beyond parity)."""
+    def fn(lr0, t):
+        import jax.numpy as jnp
+        tf = jnp.maximum(t.astype(jnp.float32), 1.0)
+        w = float(max(warmup, 1))
+        return lr0 * jnp.minimum(tf / w, jnp.sqrt(w / tf))
+    return fn
+
+
 @_register("arbitrary")
 def arbitrary(points=()):
     """Piecewise-constant: ``points`` is a sequence of (t_from, lr_scale);
