@@ -956,6 +956,16 @@ def bench_numpy_floor(wf, min_seconds=3.0):
 KNOWN_CONFIGS = ("mnist", "cifar", "alexnet", "alexnet_records", "sgd",
                  "lrn", "records", "convergence", "lm", "scaling",
                  "native")
+#: record name -> the worker config that produces it (the config whose
+#: ``<name>_error`` explains the record's absence); tools/bench_report.py
+#: renders failures from this vocabulary, so keep it next to the configs
+RECORD_WORKERS = {"mnist_fc": "mnist", "cifar_conv": "cifar",
+                  "cifar_conv_bf16": "cifar", "alexnet": "alexnet",
+                  "alexnet_bf16": "alexnet", "alexnet_fast": "alexnet",
+                  "alexnet_records": "alexnet_records",
+                  "char_lm": "lm", "sgd_update": "sgd",
+                  "lrn_fwd_bwd": "lrn", "records_pipeline": "records",
+                  "dp_scaling": "scaling", "native_runner": "native"}
 #: "convergence" expands to one watchdog worker per sub-bench, so a hang
 #: in one (e.g. a tunnel death mid-compile) cannot discard the others
 CONVERGENCE_SUBS = ("kohonen", "mnist_fc", "cifar_conv",

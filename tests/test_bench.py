@@ -106,6 +106,21 @@ def test_compile_cache_armed_and_disableable(tmp_path, monkeypatch):
         jax.config.update("jax_compilation_cache_dir", before)
 
 
+def test_bench_report_renders_rounds():
+    """tools/bench_report.py renders the BENCH_r*.json history as one
+    markdown table (round columns, config rows, failures marked)."""
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(BENCH), "tools", "bench_report.py")],
+        stdout=subprocess.PIPE, timeout=60, check=True)
+    text = out.stdout.decode()
+    assert text.startswith("| config |")
+    assert "| mnist_fc |" in text
+    assert "r03" in text.splitlines()[0]
+    # configs that never succeeded still get a (failed) row
+    assert "| lm |" in text or "| char_lm |" in text
+
+
 def test_emit_summary_priority_and_fallbacks():
     import importlib.util
     spec = importlib.util.spec_from_file_location("bench_mod2", BENCH)

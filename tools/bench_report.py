@@ -1,0 +1,115 @@
+"""Render the round-over-round benchmark story as one markdown table.
+
+Reads the driver-recorded ``BENCH_r*.json`` files at the repo root (shape:
+``{"n": round, "rc": exit, "parsed": {"configs": {...}}}``) plus any
+session-captured raw records under ``docs/bench_sessions/*.json`` (shape:
+the bench's own one-line JSON, ``{"configs": {...}}``), and prints per
+config × round: samples/sec (or the config's native headline metric) with
+step time, so progress and regressions are visible at a glance.
+
+Usage: python tools/bench_report.py [--metric samples_per_sec]
+"""
+import argparse
+import glob
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load_records():
+    """[(label, configs dict)]: driver rounds in order, then every
+    session capture (alphabetical) appended as extra columns — a session
+    column is labeled with its filename, not merged into a round."""
+    out = []
+    for path in sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json"))):
+        try:
+            rec = json.load(open(path))
+        except Exception:
+            continue
+        parsed = rec.get("parsed") or {}
+        label = "r%02d" % rec.get("n", 0)
+        if rec.get("rc"):
+            label += "(rc=%s)" % rec["rc"]
+        out.append((label, parsed.get("configs") or {}))
+    for path in sorted(glob.glob(
+            os.path.join(REPO, "docs", "bench_sessions", "*.json"))):
+        try:
+            rec = json.load(open(path))
+        except Exception:
+            continue
+        out.append((os.path.basename(path).replace(".json", ""),
+                    rec.get("configs") or {}))
+    return out
+
+
+def cell(cfg, metric):
+    """One table cell for a config record: headline value + step time."""
+    if not isinstance(cfg, dict):
+        return ""
+    if metric in cfg:
+        value = "{:,.0f}".format(cfg[metric])
+        if cfg.get("step_time_us") is not None:
+            value += " ({:,.0f} us)".format(cfg["step_time_us"])
+        return value
+    # aux configs carry their own headline fields
+    for key in ("tokens_per_sec", "xla_us", "read_mb_per_sec",
+                "best_val_err_pct", "best_val_mse", "best_qe",
+                "selfcheck"):
+        if key in cfg:
+            return "%s=%s" % (key, cfg[key])
+    return "ok"
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--metric", default="samples_per_sec")
+    args = parser.parse_args()
+    records = load_records()
+    if not records:
+        print("no BENCH_r*.json records found", file=sys.stderr)
+        return 1
+    sys.path.insert(0, REPO)
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(REPO, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    worker_of = bench.RECORD_WORKERS   # bench.py owns the vocabulary
+    names = []
+    for _, cfgs in records:
+        for name in cfgs:
+            if name.endswith("_error"):
+                continue
+            if name not in names:
+                names.append(name)
+    # configs that NEVER succeeded would otherwise vanish from the table
+    # — surface them as a row named after the failing worker config
+    covered = {worker_of.get(n, n) for n in names}
+    for _, cfgs in records:
+        for key in cfgs:
+            if key.endswith("_error"):
+                base = key[:-len("_error")]
+                if base not in covered and base not in names:
+                    names.append(base)
+                    covered.add(base)
+    labels = [label for label, _ in records]
+    print("| config | " + " | ".join(labels) + " |")
+    print("|---" * (len(labels) + 1) + "|")
+    for name in names:
+        row = []
+        for _, cfgs in records:
+            if name in cfgs:
+                row.append(cell(cfgs[name], args.metric))
+            elif (name + "_error" in cfgs
+                  or worker_of.get(name, name) + "_error" in cfgs):
+                row.append("failed")
+            else:
+                row.append("")
+        print("| %s | %s |" % (name, " | ".join(row)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
