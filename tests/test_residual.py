@@ -1,0 +1,124 @@
+"""Residual (skip) connections in the fused chain — beyond-parity DAG
+support (veles_tpu/ops/residual.py; the reference's StandardWorkflow was
+strictly linear)."""
+
+import numpy
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from veles_tpu import prng
+from veles_tpu.config import root
+
+
+def _build_residual_mnist(skip=2, fused=True, seed=3):
+    """784 -> 32 -> (dense 32 -> dense 32 -> +skip) -> softmax."""
+    prng.reset()
+    prng.seed_all(seed)
+    root.__dict__.pop("mnist", None)
+    root.mnist.update({
+        "loader": {"minibatch_size": 50, "n_train": 200, "n_valid": 100},
+        "decision": {"max_epochs": 3, "fail_iterations": 10},
+        "layers": [
+            {"type": "all2all_tanh", "output_sample_shape": 32,
+             "learning_rate": 0.05, "momentum": 0.9},
+            {"type": "all2all_tanh", "output_sample_shape": 32,
+             "learning_rate": 0.05, "momentum": 0.9},
+            {"type": "all2all", "output_sample_shape": 32,
+             "learning_rate": 0.05, "momentum": 0.9},
+            {"type": "residual", "skip": skip},
+            {"type": "softmax", "output_sample_shape": 10,
+             "learning_rate": 0.05, "momentum": 0.9},
+        ],
+    })
+    from veles_tpu.samples import mnist
+    return mnist.build(fused=fused)
+
+
+class TestResidualForward:
+    def test_forward_adds_skip_source(self):
+        wf = _build_residual_mnist()
+        wf.initialize()
+        runner = wf._fused_runner
+        x = jnp.asarray(numpy.random.RandomState(0)
+                        .randn(4, 28, 28, 1), jnp.float32)
+        acts = runner._forward_chain(runner.state, x)
+        # layer 3 is the residual with skip=2: output = input + acts[1]
+        numpy.testing.assert_allclose(
+            numpy.asarray(acts[4]), numpy.asarray(acts[3] + acts[1]),
+            rtol=1e-6)
+
+    def test_shape_mismatch_raises(self):
+        wf = _build_residual_mnist(skip=3)   # acts[0] is 28x28x1: mismatch
+        wf.initialize()
+        runner = wf._fused_runner
+        x = jnp.zeros((4, 28, 28, 1), jnp.float32)
+        with pytest.raises(ValueError, match="equal shapes"):
+            runner._forward_chain(runner.state, x)
+
+    def test_unit_mode_rejected(self):
+        with pytest.raises(ValueError, match="fused"):
+            _build_residual_mnist(fused=False)
+
+
+class TestResidualBackward:
+    def test_grads_match_autodiff_oracle(self):
+        """The hand-derived backward with the pending-skip stash equals
+        jax.grad of the summed loss through the same chain — the
+        two-consumer fan-out is exact, not approximate."""
+        wf = _build_residual_mnist()
+        wf.initialize()
+        runner = wf._fused_runner
+        rs = numpy.random.RandomState(1)
+        x = jnp.asarray(rs.randn(8, 28, 28, 1), jnp.float32)
+        labels = jnp.asarray(rs.randint(0, 10, 8), jnp.int32)
+        mask = jnp.ones(8, jnp.float32)
+
+        got, _ = runner._grads_and_metrics(runner.state, x, labels, mask)
+
+        def loss_of(state):
+            acts = runner._forward_chain(state, x, rng=None, train=True)
+            _, metrics = runner._loss(acts[-1], labels, mask)
+            return metrics["loss_sum"]
+
+        want = jax.grad(loss_of)(runner.state)
+        checked = 0
+        for i, (g, w) in enumerate(zip(got, want)):
+            if g is None:
+                continue
+            grad_w, grad_b = g        # backward_fused's (gw, gb) pair
+            numpy.testing.assert_allclose(
+                numpy.asarray(grad_w), numpy.asarray(w["w"]),
+                rtol=2e-4, atol=2e-5, err_msg="layer %d grad w" % i)
+            numpy.testing.assert_allclose(
+                numpy.asarray(grad_b), numpy.asarray(w["b"]),
+                rtol=2e-4, atol=2e-5, err_msg="layer %d grad b" % i)
+            checked += 1
+        assert checked >= 4   # 4 parameterized layers
+
+    def test_residual_net_trains(self):
+        """End-to-end: the residual net runs the full fused loop through
+        the launcher and improves on the synthetic set."""
+        from veles_tpu.launcher import Launcher
+        wf = _build_residual_mnist()
+        Launcher(wf, stats=False).boot()
+        assert wf.is_finished
+        losses = [m["validation"]["loss"]
+                  for m in wf.decision.epoch_metrics]
+        assert losses[-1] < losses[0]
+        assert wf.decision.epoch_metrics[-1]["validation"]["n_err"] <= 5
+
+    def test_epoch_scan_matches_graph_loop(self):
+        """The residual backward rides the epoch-scan path identically
+        (same composed step functions)."""
+        from veles_tpu.launcher import Launcher
+        wf_a = _build_residual_mnist(seed=7)
+        Launcher(wf_a, stats=False).boot()
+        wf_b = _build_residual_mnist(seed=7)
+        Launcher(wf_b, stats=False, epoch_scan=1).boot()
+        for fa, fb in zip(wf_a.forwards, wf_b.forwards):
+            if fa.has_params:
+                numpy.testing.assert_allclose(
+                    numpy.asarray(fa.weights.mem),
+                    numpy.asarray(fb.weights.mem), rtol=2e-5, atol=2e-6)

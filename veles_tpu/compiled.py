@@ -82,7 +82,14 @@ class FusedRunner:
         acts = [x]
         h = x
         for i, (fwd, entry) in enumerate(zip(self.forwards, state)):
-            h = fwd.apply_fused(h, entry, self._layer_rng(rng, i), train)
+            if getattr(fwd, "IS_RESIDUAL", False):
+                # residual layer: output = input + skip source (the chain
+                # owns the activation list, so the add lives here; shape
+                # agreement is validated by the unit at trace time)
+                h = h + fwd.check_source(i, acts)
+            else:
+                h = fwd.apply_fused(h, entry, self._layer_rng(rng, i),
+                                    train)
             acts.append(h)
         return acts
 
@@ -105,11 +112,23 @@ class FusedRunner:
         acts = self._forward_chain(state, x, rng=rng, train=True)
         err, metrics = self._loss(acts[-1], y_ref, mask)
         all_grads = [None] * len(self.forwards)
+        # residual fan-out: a skip edge makes acts[src] TWO consumers'
+        # input, so its error has two contributions — the main chain's
+        # and the stashed skip error, merged when the walk reaches src
+        pending = {}
         for i in range(len(self.forwards) - 1, -1, -1):
+            if err is not None and (i + 1) in pending:
+                err = err + pending.pop(i + 1)
             if err is None:
                 # the first parameterized gd skipped err_input; everything
                 # below it is weightless (see link_gds) — nothing to do
                 break
+            fwd = self.forwards[i]
+            if getattr(fwd, "IS_RESIDUAL", False):
+                src = i - fwd.skip
+                pending[src] = (pending[src] + err if src in pending
+                                else err)
+                continue       # identity to the main path: err unchanged
             gd, entry = self.gds[i], state[i]
             err_in, grads = gd.backward_fused(
                 acts[i], acts[i + 1], err, entry, self._layer_rng(rng, i))

@@ -17,4 +17,5 @@ from veles_tpu.ops import kohonen, rbm, lr_adjust  # noqa: F401,E402
 from veles_tpu.ops import (weights_zerofilling, resizable_all2all,  # noqa: F401,E402
                            image_saver, mean_disp_normalizer)  # noqa: F401,E402
 from veles_tpu.ops import augmentation  # noqa: F401,E402
+from veles_tpu.ops import residual  # noqa: F401,E402
 

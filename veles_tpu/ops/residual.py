@@ -1,0 +1,87 @@
+"""Residual (skip) connections for the fused chain — beyond parity.
+
+The reference's StandardWorkflow builds strictly LINEAR forward chains
+(ref: veles/znicz/standard_workflow.py [H] — each unit links from the
+previous one); residual topologies (ResNet blocks, transformer-style
+skips for conv/dense stacks) postdate it.  The TPU-native engine adds
+them as a weightless ``residual`` layer: ``output = input +
+acts[this - skip]`` where ``acts`` is the fused chain's activation list
+(``acts[i]`` = the INPUT of layer ``i``), so a classic two-layer block is
+
+    {"type": "conv", ...}, {"type": "conv", ...},
+    {"type": "residual", "skip": 2}        # adds the first conv's input
+
+Backward is exact and stays inside the hand-derived chain: the unit's
+error passes through unchanged to the main path while the SAME error is
+stashed and added to the skip source's error when the backward walk
+reaches it (compiled.py::_grads_and_metrics) — the two-consumer fan-out
+a linear err chain cannot express.
+
+Fused mode only: the unit graph's one-err_input-per-unit linking cannot
+route the skip error, so ``fused=False`` builds reject the layer type
+(StandardWorkflowBase validates; Residual.run raises as a backstop).
+"""
+
+from __future__ import annotations
+
+from veles_tpu.ops.nn_units import (TransformUnit, TransformGD,
+                                    register_layer_type, register_gd_for)
+
+
+@register_layer_type("residual")
+class Residual(TransformUnit):
+    """output = input + acts[position - skip] (fused chain only)."""
+
+    #: compiled.py keys its forward/backward special case off this marker
+    IS_RESIDUAL = True
+
+    def __init__(self, workflow, skip=2, **kwargs):
+        super().__init__(workflow, **kwargs)
+        if int(skip) < 1:
+            raise ValueError("residual skip must be >= 1, got %r" % (skip,))
+        self.skip = int(skip)
+
+    def transform(self, x):
+        """Identity for shape inference; the fused chain performs the
+        actual add (it owns the activation list)."""
+        return x
+
+    def apply_fused(self, x, entry, rng, train):
+        """Never valid: a lone-unit application cannot see the skip
+        source.  _forward_chain branches on IS_RESIDUAL before this
+        hook; any other caller iterating ``apply_fused`` over forwards
+        (the restful fallback pattern) must fail loudly rather than
+        silently dropping the skip add."""
+        raise RuntimeError(
+            "Residual.apply_fused: the skip add needs the fused chain's "
+            "activation list (compiled.py handles IS_RESIDUAL layers); "
+            "route this workflow through the fused runner")
+
+    def check_source(self, position, acts):
+        """Validate the skip source exists and matches shapes; returns the
+        source activation.  Called at trace time by the fused chain."""
+        src = position - self.skip
+        if src < 0:
+            raise ValueError(
+                "residual at layer %d skips %d back — before the chain "
+                "input" % (position, self.skip))
+        if acts[src].shape != acts[position].shape:
+            raise ValueError(
+                "residual at layer %d: input shape %s != skip source "
+                "shape %s (acts[%d]) — residual needs equal shapes"
+                % (position, acts[position].shape, acts[src].shape, src))
+        return acts[src]
+
+    def run(self):
+        raise RuntimeError(
+            "the 'residual' layer needs the fused engine (its skip adds "
+            "a second data edge the per-unit graph cannot route) — build "
+            "the workflow with fused=True")
+
+
+@register_gd_for(Residual)
+class GDResidual(TransformGD):
+    """Pairing placeholder: the fused backward special-cases residual
+    layers (identity to the main path + stash to the skip source), so
+    this gd's own backward_fused is never consulted there; unit mode is
+    rejected by Residual.run."""

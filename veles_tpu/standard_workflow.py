@@ -102,6 +102,12 @@ class StandardWorkflowBase(NNWorkflow):
             self.warning("grad_accum=%s is inert in unit (non-fused) "
                          "mode — the per-unit path dispatches whole "
                          "minibatches", grad_accum)
+        if not fused and any(l.get("type") == "residual"
+                             for l in self.layers_config):
+            raise ValueError(
+                "the 'residual' layer type needs the fused engine (its "
+                "skip edge cannot ride the per-unit err chain) — build "
+                "with fused=True")
         self.snapshotter = None
         self._build(loader_factory, dict(loader_config or {}),
                     dict(decision_config or {}), snapshotter_config)
