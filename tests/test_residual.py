@@ -109,6 +109,28 @@ class TestResidualBackward:
         assert losses[-1] < losses[0]
         assert wf.decision.epoch_metrics[-1]["validation"]["n_err"] <= 5
 
+    def test_cifar_resnet_sample_trains(self):
+        """The zoo sample (two identity blocks on the CIFAR loader)
+        builds from config and improves on the synthetic set."""
+        from veles_tpu.launcher import Launcher
+        prng.reset()
+        prng.seed_all(5)
+        root.__dict__.pop("cifar_resnet", None)
+        root.cifar_resnet.update({
+            "loader": {"minibatch_size": 50, "n_train": 400,
+                       "n_valid": 100},
+            "decision": {"max_epochs": 3, "fail_iterations": 10},
+        })
+        from veles_tpu.samples import cifar_resnet
+        wf = cifar_resnet.build(fused=True)
+        # the residual layers made it into the chain
+        assert sum(getattr(f, "IS_RESIDUAL", False)
+                   for f in wf.forwards) == 2
+        Launcher(wf, stats=False).boot()
+        losses = [m["validation"]["loss"]
+                  for m in wf.decision.epoch_metrics]
+        assert losses[-1] < losses[0]
+
     def test_epoch_scan_matches_graph_loop(self):
         """The residual backward rides the epoch-scan path identically
         (same composed step functions)."""
