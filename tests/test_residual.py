@@ -131,6 +131,119 @@ class TestResidualBackward:
                   for m in wf.decision.epoch_metrics]
         assert losses[-1] < losses[0]
 
+    def test_projection_block_grads_match_autodiff(self):
+        """Downsampling block: conv(s=2) -> conv -> residual_proj(s=2).
+        The projection's weight grad AND the skip-source error both come
+        from one vjp — pinned against the jax.grad oracle."""
+        from veles_tpu.standard_workflow import StandardWorkflow
+        from veles_tpu.samples.cifar import CifarLoader
+        prng.reset()
+        prng.seed_all(9)
+        conv = {"type": "conv_str", "n_kernels": 16, "kx": 3, "ky": 3,
+                "padding": "SAME", "learning_rate": 0.02, "momentum": 0.9}
+        wf = StandardWorkflow(
+            None, name="resproj", loader_factory=CifarLoader,
+            loader_config={"minibatch_size": 25, "n_train": 100,
+                           "n_valid": 50},
+            layers=[
+                dict(conv),
+                dict(conv, sliding=2),          # main path downsamples
+                dict(conv),
+                {"type": "residual_proj", "skip": 2, "n_kernels": 16,
+                 "sliding": 2, "learning_rate": 0.02, "momentum": 0.9},
+                {"type": "softmax", "output_sample_shape": 10,
+                 "learning_rate": 0.02, "momentum": 0.9},
+            ],
+            decision_config={"max_epochs": 2, "fail_iterations": 5},
+            loss_function="softmax", fused=True)
+        wf.initialize()
+        runner = wf._fused_runner
+        proj = wf.forwards[3]
+        assert proj.IS_RESIDUAL_PROJ and proj.weights.shape == (1, 1, 16,
+                                                                16)
+        rs = numpy.random.RandomState(2)
+        x = jnp.asarray(rs.randn(8, 32, 32, 3), jnp.float32)
+        labels = jnp.asarray(rs.randint(0, 10, 8), jnp.int32)
+        mask = jnp.ones(8, jnp.float32)
+        got, _ = runner._grads_and_metrics(runner.state, x, labels, mask)
+
+        def loss_of(state):
+            acts = runner._forward_chain(state, x, rng=None, train=True)
+            return runner._loss(acts[-1], labels, mask)[1]["loss_sum"]
+
+        want = jax.grad(loss_of)(runner.state)
+        checked = 0
+        for i, (g, w) in enumerate(zip(got, want)):
+            if g is None:
+                continue
+            grad_w = g[0]
+            numpy.testing.assert_allclose(
+                numpy.asarray(grad_w), numpy.asarray(w["w"]),
+                rtol=5e-4, atol=5e-5, err_msg="layer %d grad w" % i)
+            checked += 1
+        assert checked == 5   # 4 convs (incl. projection) + softmax
+
+        # and the block trains end to end
+        from veles_tpu.launcher import Launcher
+        Launcher(wf, stats=False).boot()
+        losses = [m["validation"]["loss"]
+                  for m in wf.decision.epoch_metrics]
+        assert losses[-1] < losses[0]
+
+    def test_double_initialize_still_trains(self):
+        """initialize() followed by Launcher.boot() (which initializes
+        again) must NOT install a duplicate FusedStep — the stale
+        duplicate used to re-dispatch every minibatch with frozen
+        weights and clobber the metrics, silently freezing training
+        (dormant pre-round-5 bug, exposed by this file's oracle tests).
+        The extra initialize legitimately advances PRNG streams, so the
+        contract is "trains correctly", not bit-equality with a
+        single-init run."""
+        from veles_tpu.launcher import Launcher
+        wf = _build_residual_mnist(seed=13)
+        wf.initialize()              # the extra initialize
+        step_a = wf.fused_step
+        w0 = numpy.array(wf._fused_runner.state[0]["w"])
+        Launcher(wf, stats=False).boot()
+        assert wf.fused_step is step_a     # no duplicate install
+        w1 = numpy.array(wf._fused_runner.state[0]["w"])
+        assert numpy.abs(w1 - w0).max() > 0   # weights actually moved
+        losses = [m["validation"]["loss"]
+                  for m in wf.decision.epoch_metrics]
+        assert losses[-1] < losses[0]
+
+    def test_projection_rejects_fixed_keys(self):
+        from veles_tpu.ops.residual import ResidualProjection
+        with pytest.raises(ValueError, match="kx"):
+            ResidualProjection(None, skip=2, n_kernels=8, kx=3)
+        with pytest.raises(ValueError, match="bias-free"):
+            ResidualProjection(None, skip=2, n_kernels=8,
+                               include_bias=True)
+
+    def test_projection_shape_mismatch_raises(self):
+        from veles_tpu.standard_workflow import StandardWorkflow
+        from veles_tpu.samples.cifar import CifarLoader
+        prng.reset()
+        prng.seed_all(9)
+        wf = StandardWorkflow(
+            None, name="resproj_bad", loader_factory=CifarLoader,
+            loader_config={"minibatch_size": 25, "n_train": 100,
+                           "n_valid": 50},
+            layers=[
+                {"type": "conv_str", "n_kernels": 16, "kx": 3, "ky": 3,
+                 "padding": "SAME", "sliding": 2, "learning_rate": 0.02,
+                 "momentum": 0.9},
+                # stride-1 projection cannot match the downsampled path
+                {"type": "residual_proj", "skip": 1, "n_kernels": 16,
+                 "learning_rate": 0.02, "momentum": 0.9},
+                {"type": "softmax", "output_sample_shape": 10,
+                 "learning_rate": 0.02, "momentum": 0.9},
+            ],
+            decision_config={"max_epochs": 1, "fail_iterations": 5},
+            loss_function="softmax", fused=True)
+        with pytest.raises(ValueError, match="projected skip shape"):
+            wf.initialize()
+
     def test_epoch_scan_matches_graph_loop(self):
         """The residual backward rides the epoch-scan path identically
         (same composed step functions)."""

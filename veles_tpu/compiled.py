@@ -82,11 +82,12 @@ class FusedRunner:
         acts = [x]
         h = x
         for i, (fwd, entry) in enumerate(zip(self.forwards, state)):
-            if getattr(fwd, "IS_RESIDUAL", False):
-                # residual layer: output = input + skip source (the chain
-                # owns the activation list, so the add lives here; shape
-                # agreement is validated by the unit at trace time)
-                h = h + fwd.check_source(i, acts)
+            if getattr(fwd, "HAS_SKIP_EDGE", False):
+                # skip-edge layers (residual / residual_proj) see the
+                # whole activation list — the unit owns the math
+                # (ops/residual.py chain_forward), the chain owns acts
+                h = fwd.chain_forward(i, acts, entry,
+                                      self._layer_rng(rng, i), train)
             else:
                 h = fwd.apply_fused(h, entry, self._layer_rng(rng, i),
                                     train)
@@ -124,11 +125,15 @@ class FusedRunner:
                 # below it is weightless (see link_gds) — nothing to do
                 break
             fwd = self.forwards[i]
-            if getattr(fwd, "IS_RESIDUAL", False):
-                src = i - fwd.skip
-                pending[src] = (pending[src] + err if src in pending
-                                else err)
-                continue       # identity to the main path: err unchanged
+            if getattr(fwd, "HAS_SKIP_EDGE", False):
+                # the unit returns its main-path error, where to stash
+                # the skip error, and its own grads (None if weightless)
+                err, src, d_src, grads = fwd.chain_backward(
+                    i, acts, state[i], err, self._layer_rng(rng, i))
+                pending[src] = (pending[src] + d_src if src in pending
+                                else d_src)
+                all_grads[i] = grads
+                continue
             gd, entry = self.gds[i], state[i]
             err_in, grads = gd.backward_fused(
                 acts[i], acts[i + 1], err, entry, self._layer_rng(rng, i))

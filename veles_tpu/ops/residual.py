@@ -24,6 +24,7 @@ route the skip error, so ``fused=False`` builds reject the layer type
 
 from __future__ import annotations
 
+from veles_tpu.ops.conv import Conv
 from veles_tpu.ops.nn_units import (TransformUnit, TransformGD,
                                     register_layer_type, register_gd_for)
 
@@ -32,7 +33,11 @@ from veles_tpu.ops.nn_units import (TransformUnit, TransformGD,
 class Residual(TransformUnit):
     """output = input + acts[position - skip] (fused chain only)."""
 
-    #: compiled.py keys its forward/backward special case off this marker
+    #: compiled.py routes chain_forward/chain_backward through units
+    #: carrying this marker instead of apply_fused/backward_fused — the
+    #: skip edge needs the whole activation list (IS_RESIDUAL kept as an
+    #: alias for introspection/tests)
+    HAS_SKIP_EDGE = True
     IS_RESIDUAL = True
 
     def __init__(self, workflow, skip=2, **kwargs):
@@ -72,6 +77,16 @@ class Residual(TransformUnit):
                 % (position, acts[position].shape, acts[src].shape, src))
         return acts[src]
 
+    # -- fused-chain hooks (compiled.py HAS_SKIP_EDGE protocol) ----------
+    def chain_forward(self, position, acts, entry, rng, train):
+        """output = input + skip source."""
+        return acts[position] + self.check_source(position, acts)
+
+    def chain_backward(self, position, acts, entry, err, rng):
+        """(err to the main path, source index, error to stash there,
+        grads): both consumers see the identity cotangent."""
+        return err, position - self.skip, err, None
+
     def run(self):
         raise RuntimeError(
             "the 'residual' layer needs the fused engine (its skip adds "
@@ -85,3 +100,129 @@ class GDResidual(TransformGD):
     layers (identity to the main path + stash to the skip source), so
     this gd's own backward_fused is never consulted there; unit mode is
     rejected by Residual.run."""
+
+
+@register_layer_type("residual_proj")
+class ResidualProjection(Conv):
+    """output = input + conv1x1(acts[position - skip]) — the ResNet
+    DOWNSAMPLING block's skip path (projection shortcut).
+
+    When the main path changes spatial size or channel count, the
+    identity skip no longer type-checks; the classic fix is a 1×1
+    convolution (stride matching the main path's downsampling) on the
+    skip branch.  Config::
+
+        {"type": "conv_str", "n_kernels": 64, "kx": 3, "ky": 3,
+         "sliding": 2, "padding": "SAME", ...},
+        {"type": "conv_str", "n_kernels": 64, "kx": 3, "ky": 3,
+         "padding": "SAME", ...},
+        {"type": "residual_proj", "skip": 2, "n_kernels": 64,
+         "sliding": 2, "learning_rate": ...}
+
+    The projection weights are real parameters: they ride the same
+    per-layer solver/update machinery as any conv (the paired gd is
+    GradientDescentConv via the Conv mro), and the fused backward
+    computes BOTH their gradient and the skip-source error in one vjp
+    (compiled.py).  ``skip_input`` is wired by StandardWorkflow's
+    builder to the source unit's output, so weight shapes infer from
+    the true source — no config duplication.  Fused engine only, like
+    Residual.
+    """
+
+    HAS_SKIP_EDGE = True
+    IS_RESIDUAL_PROJ = True
+
+    def __init__(self, workflow, skip=2, n_kernels=32, sliding=(1, 1),
+                 **kwargs):
+        if kwargs.setdefault("include_bias", False):
+            # a biased projection would need a bias-grad path the fused
+            # special case doesn't produce — reject rather than train a
+            # silently-frozen bias (the classic shortcut is bias-free)
+            raise ValueError("residual_proj is bias-free "
+                             "(include_bias=True unsupported)")
+        fixed = {k: kwargs.pop(k) for k in ("kx", "ky", "padding")
+                 if k in kwargs}
+        if fixed:
+            # the Conv mro makes these routable config keys, but the
+            # projection is 1x1/VALID by definition — reject clearly
+            # instead of a TypeError from the double keyword below
+            raise ValueError(
+                "residual_proj fixes kx=ky=1 and padding=VALID (a 1x1 "
+                "projection); drop %s from the layer config"
+                % sorted(fixed))
+        super().__init__(workflow, n_kernels=n_kernels, kx=1, ky=1,
+                         sliding=sliding, padding="VALID", **kwargs)
+        if int(skip) < 1:
+            raise ValueError("residual_proj skip must be >= 1, got %r"
+                             % (skip,))
+        self.skip = int(skip)
+
+    def initialize(self, device=None, **kwargs):
+        from veles_tpu.workflow import DeferredInitError
+        import jax
+        import numpy
+        if not hasattr(self, "input") or self.input.is_empty or \
+                not hasattr(self, "skip_input") or self.skip_input.is_empty:
+            raise DeferredInitError(self.name)
+        src_c = self.skip_input.shape[-1]
+        if self.weights.is_empty:
+            self.weights.reset(self._init_weights(
+                (1, 1, src_c, self.n_kernels), src_c, self.n_kernels))
+        proj = jax.eval_shape(
+            lambda s, w: self.project(s, {"w": w}),
+            jax.ShapeDtypeStruct(self.skip_input.shape, self.dtype),
+            jax.ShapeDtypeStruct(self.weights.shape, self.dtype))
+        if tuple(proj.shape) != tuple(self.input.shape):
+            raise ValueError(
+                "residual_proj %r: projected skip shape %s != main-path "
+                "shape %s — match n_kernels/sliding to the main path's "
+                "downsampling" % (self.name, tuple(proj.shape),
+                                  tuple(self.input.shape)))
+        self.output_sample_shape = tuple(self.input.shape[1:])
+        self.output.reset(numpy.zeros(tuple(self.input.shape), self.dtype))
+        from veles_tpu.accel import AcceleratedUnit
+        AcceleratedUnit.initialize(self, device=device, **kwargs)
+
+    def project(self, src, entry):
+        """The skip-branch math: bias-free 1x1 conv (stride = sliding)
+        of the skip source.  Pure; the fused chain and its vjp both
+        call it."""
+        import veles_tpu.ops.functional as F
+        return F.conv2d_forward(src, entry["w"], None, self.sliding,
+                                "VALID", "linear")
+
+    def check_source(self, position, acts):
+        src = position - self.skip
+        if src < 0:
+            raise ValueError(
+                "residual_proj at layer %d skips %d back — before the "
+                "chain input" % (position, self.skip))
+        return acts[src]
+
+    # -- fused-chain hooks (compiled.py HAS_SKIP_EDGE protocol) ----------
+    def chain_forward(self, position, acts, entry, rng, train):
+        """output = input + conv1x1(skip source)."""
+        return acts[position] + self.project(
+            self.check_source(position, acts), entry)
+
+    def chain_backward(self, position, acts, entry, err, rng):
+        """One vjp yields BOTH the projection-weight gradient and the
+        skip-source error; the main path stays identity."""
+        import jax
+        src = position - self.skip
+        _, vjp = jax.vjp(
+            lambda s, w: self.project(s, {**entry, "w": w}),
+            acts[src], entry["w"])
+        d_src, d_w = vjp(err)
+        return err, src, d_src, (d_w, None)
+
+    def apply_fused(self, x, entry, rng, train):
+        raise RuntimeError(
+            "ResidualProjection.apply_fused: the skip branch needs the "
+            "fused chain's activation list (compiled.py handles "
+            "IS_RESIDUAL_PROJ layers)")
+
+    def run(self):
+        raise RuntimeError(
+            "the 'residual_proj' layer needs the fused engine — build "
+            "the workflow with fused=True")

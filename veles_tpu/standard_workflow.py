@@ -102,12 +102,14 @@ class StandardWorkflowBase(NNWorkflow):
             self.warning("grad_accum=%s is inert in unit (non-fused) "
                          "mode — the per-unit path dispatches whole "
                          "minibatches", grad_accum)
-        if not fused and any(l.get("type") == "residual"
-                             for l in self.layers_config):
+        skip_kinds = sorted({l.get("type") for l in self.layers_config
+                             if l.get("type") in ("residual",
+                                                  "residual_proj")})
+        if not fused and skip_kinds:
             raise ValueError(
-                "the 'residual' layer type needs the fused engine (its "
-                "skip edge cannot ride the per-unit err chain) — build "
-                "with fused=True")
+                "layer type(s) %s need the fused engine (a skip edge "
+                "cannot ride the per-unit err chain) — build with "
+                "fused=True" % ", ".join("'%s'" % k for k in skip_kinds))
         self.snapshotter = None
         self._build(loader_factory, dict(loader_config or {}),
                     dict(decision_config or {}), snapshotter_config)
@@ -142,6 +144,23 @@ class StandardWorkflowBase(NNWorkflow):
             else:
                 unit.link_from(prev)
                 unit.link_attrs(prev, ("input", "output"))
+            if getattr(unit, "IS_RESIDUAL_PROJ", False):
+                # the projection's weights shape infers from the SKIP
+                # source, not the main path: wire its output (acts[src]
+                # = input of layer src = output of layer src-1, or the
+                # loader data for src 0) as skip_input
+                src = len(self.forwards) - unit.skip
+                if src < 0:
+                    raise ValueError(
+                        "residual_proj at layer %d skips %d back — "
+                        "before the chain input"
+                        % (len(self.forwards), unit.skip))
+                if src == 0:
+                    unit.link_attrs(self.loader,
+                                    ("skip_input", "minibatch_data"))
+                else:
+                    unit.link_attrs(self.forwards[src - 1],
+                                    ("skip_input", "output"))
             self.forwards.append(unit)
             prev = unit
 
@@ -253,9 +272,20 @@ class StandardWorkflowBase(NNWorkflow):
     def initialize(self, device=None, **kwargs):
         super().initialize(device=device, **kwargs)
         if self.fused:
-            from veles_tpu.compiled import FusedRunner
-            self._fused_runner = FusedRunner(self, grad_accum=self.grad_accum)
-            self._fused_runner.install()
+            runner = getattr(self, "_fused_runner", None)
+            if runner is None:
+                from veles_tpu.compiled import FusedRunner
+                self._fused_runner = FusedRunner(
+                    self, grad_accum=self.grad_accum)
+                self._fused_runner.install()
+            else:
+                # re-initialize (e.g. initialize() then Launcher.boot):
+                # a second install() would add a DUPLICATE FusedStep
+                # whose stale runner re-dispatches every minibatch with
+                # frozen weights and clobbers the metrics — keep the
+                # installed graph and just refresh the device state
+                # from the (possibly re-initialized) unit Vectors
+                runner.state = runner._pull_state()
         return self
 
     def snapshot_state(self):
