@@ -123,9 +123,11 @@ class TestResidualBackward:
         })
         from veles_tpu.samples import cifar_resnet
         wf = cifar_resnet.build(fused=True)
-        # the residual layers made it into the chain
+        # two identity blocks AND the projected downsampling block
         assert sum(getattr(f, "IS_RESIDUAL", False)
                    for f in wf.forwards) == 2
+        assert sum(getattr(f, "IS_RESIDUAL_PROJ", False)
+                   for f in wf.forwards) == 1
         Launcher(wf, stats=False).boot()
         losses = [m["validation"]["loss"]
                   for m in wf.decision.epoch_metrics]

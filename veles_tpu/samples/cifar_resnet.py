@@ -21,12 +21,26 @@ class CifarResNetWorkflow(StandardWorkflow):
     """Small residual conv net (two identity blocks)."""
 
 
+def _conv(channels, lr, stride=1):
+    return {"type": "conv_str", "n_kernels": channels, "kx": 3, "ky": 3,
+            "sliding": stride, "padding": "SAME", "learning_rate": lr,
+            "momentum": 0.9, "weights_filling": "gaussian",
+            "weights_stddev": 0.05}
+
+
 def _block(channels, lr):
     """conv -> conv -> add-input: one identity residual block."""
-    conv = {"type": "conv_str", "n_kernels": channels, "kx": 3, "ky": 3,
-            "padding": "SAME", "learning_rate": lr, "momentum": 0.9,
-            "weights_filling": "gaussian", "weights_stddev": 0.05}
-    return [dict(conv), dict(conv), {"type": "residual", "skip": 2}]
+    return [_conv(channels, lr), _conv(channels, lr),
+            {"type": "residual", "skip": 2}]
+
+
+def _down_block(channels, lr):
+    """Downsampling block: the main path strides 2 and widens; the skip
+    path is a 1x1/stride-2 projection (`residual_proj`)."""
+    return [_conv(channels, lr, stride=2), _conv(channels, lr),
+            {"type": "residual_proj", "skip": 2, "n_kernels": channels,
+             "sliding": 2, "learning_rate": lr, "momentum": 0.9,
+             "weights_filling": "gaussian", "weights_stddev": 0.05}]
 
 
 def default_config():
@@ -36,14 +50,14 @@ def default_config():
                    "n_valid": 10000},
         "decision": {"max_epochs": 20, "fail_iterations": 100},
         "layers": [
-            # stem sets the channel width the blocks preserve
+            # stem sets the channel width the identity blocks preserve
             {"type": "conv_str", "n_kernels": 32, "kx": 5, "ky": 5,
              "padding": "SAME", "learning_rate": lr, "momentum": 0.9,
              "weights_filling": "gaussian", "weights_stddev": 0.05},
             {"type": "max_pooling", "kx": 2, "ky": 2},
             *_block(32, lr),
-            {"type": "avg_pooling", "kx": 2, "ky": 2},
-            *_block(32, lr),
+            *_down_block(64, lr),      # 16x16x32 -> 8x8x64, projected skip
+            *_block(64, lr),
             {"type": "avg_pooling", "kx": 2, "ky": 2},
             {"type": "softmax", "output_sample_shape": 10,
              "learning_rate": lr, "momentum": 0.9},
