@@ -31,6 +31,12 @@ if not _tpu_mode:
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: sustained/heavy tests excluded from tier-1 "
+                   "(deselected by -m 'not slow')")
+
+
 @pytest.fixture(autouse=True)
 def _fresh_prng():
     from veles_tpu import prng

@@ -1,0 +1,733 @@
+"""Serving subsystem (ISSUE 1): dynamic micro-batching, continuous LM
+decode, admission control, metrics — the traffic layer over the jitted
+forward/decode paths."""
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+from load_gen import run_load  # noqa: E402
+
+
+def _post(port, payload, timeout=30):
+    req = urllib.request.Request(
+        "http://127.0.0.1:%d/predict" % port,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+class TestMicroBatcher:
+    def test_coalesces_and_preserves_rows(self):
+        from veles_tpu.serving import MicroBatcher, ServingMetrics
+        dispatched = []
+
+        def forward(x):
+            dispatched.append(len(x))
+            time.sleep(0.004)      # a realistic dispatch the queue can
+            return x * 2.0         # fill behind
+
+        mb = MicroBatcher(forward, max_batch=8, batch_wait_s=0.01,
+                          sample_shape=(4,),
+                          metrics=ServingMetrics("mb_t1")).start()
+        errors = []
+
+        def client(ci):
+            try:
+                for j in range(5):
+                    x = numpy.full((1, 4), ci * 10 + j, numpy.float32)
+                    out = mb.submit(x)
+                    assert out.shape == (1, 4)
+                    numpy.testing.assert_array_equal(out, x * 2)
+            except Exception as e:   # noqa: BLE001 — reported below
+                errors.append(e)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        mb.stop()
+        assert errors == []
+        snap = mb.metrics.snapshot()
+        assert snap["requests"] == 40
+        # coalescing: measurably fewer dispatches than requests, mean
+        # dispatch batch size above 1 (the acceptance criterion)
+        assert snap["dispatches"] < snap["requests"]
+        assert snap["batch_size"]["mean"] > 1
+        # every dispatch was a power-of-two bucket (or max_batch)
+        assert set(dispatched) <= {1, 2, 4, 8}
+
+    def test_overload_rejects_instead_of_queueing(self):
+        from veles_tpu.serving import MicroBatcher, Overloaded
+
+        def slow_forward(x):
+            time.sleep(0.05)
+            return x
+
+        mb = MicroBatcher(slow_forward, max_batch=2, queue_depth=2,
+                          batch_wait_s=0.0, deadline_s=10.0,
+                          sample_shape=(3,), name="mb_t2").start()
+        outcomes = {"ok": 0, "over": 0}
+        lock = threading.Lock()
+
+        def client():
+            try:
+                mb.submit(numpy.zeros((1, 3), numpy.float32))
+                with lock:
+                    outcomes["ok"] += 1
+            except Overloaded as e:
+                assert e.retry_after > 0
+                with lock:
+                    outcomes["over"] += 1
+
+        threads = [threading.Thread(target=client) for _ in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        mb.stop()
+        assert outcomes["ok"] + outcomes["over"] == 16
+        assert outcomes["over"] > 0                 # bounded, not hung
+        assert mb.metrics.snapshot()["rejected"] == outcomes["over"]
+
+    def test_deadline_sheds_stale_requests(self):
+        from veles_tpu.serving import DeadlineExceeded, MicroBatcher
+
+        def slow_forward(x):
+            time.sleep(0.08)
+            return x
+
+        mb = MicroBatcher(slow_forward, max_batch=1, queue_depth=32,
+                          batch_wait_s=0.0, deadline_s=0.02,
+                          sample_shape=(2,), name="mb_t3").start()
+        shed = []
+
+        def client():
+            try:
+                mb.submit(numpy.zeros((1, 2), numpy.float32))
+            except DeadlineExceeded:
+                shed.append(1)
+
+        threads = [threading.Thread(target=client) for _ in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        mb.stop()
+        # the first request(s) dispatch; later ones aged out in queue
+        assert shed
+        assert mb.metrics.snapshot()["shed"] == len(shed)
+
+    def test_oversized_request_chunks(self):
+        from veles_tpu.serving import MicroBatcher
+        mb = MicroBatcher(lambda x: x + 1, max_batch=4,
+                          sample_shape=(2,), name="mb_t4").start()
+        out = mb.submit(numpy.zeros((10, 2), numpy.float32))
+        mb.stop()
+        assert out.shape == (10, 2)
+        assert (out == 1).all()
+
+    def test_bucket_ladder(self):
+        from veles_tpu.serving import batch_buckets, prompt_bucket
+        assert batch_buckets(8) == [1, 2, 4, 8]
+        assert batch_buckets(6) == [1, 2, 4, 6]
+        assert batch_buckets(1) == [1]
+        assert prompt_bucket(3, 64) == 16
+        assert prompt_bucket(17, 64) == 32
+        assert prompt_bucket(40, 48) == 48      # capped at the cache
+
+
+class TestBatchedHTTP:
+    def _api(self, forward, **knobs):
+        from veles_tpu.restful_api import RESTfulAPI
+        from veles_tpu.serving import ServingMetrics
+        api = RESTfulAPI(None, forward=forward)
+        api.enable_batching(metrics=ServingMetrics("http_t"), **knobs)
+        return api.start(port=0)
+
+    def test_threaded_load_correct_and_coalesced(self):
+        """≥8 concurrent clients: every reply is row-correct, dispatches
+        are measurably fewer than requests, mean batch size > 1 (the
+        acceptance criterion), /metrics.json reports it all."""
+        def forward(x):
+            time.sleep(0.004)
+            return x * 2.0
+
+        api = self._api(forward, max_batch=8, batch_wait_s=0.01,
+                        sample_shape=(4,))
+        try:
+            summary = run_load(
+                "http://127.0.0.1:%d/predict" % api.port,
+                payload=None, clients=8, requests_per_client=5,
+                payload_fn=lambda ci, n: {
+                    "input": [[float(ci * 10 + n)] * 4]})
+            assert summary["ok"] == summary["sent"] == 40
+            got = set()
+            for r in summary["responses"]:
+                # each reply is exactly 2× its own request's input row
+                assert r["output"][0] == [r["output"][0][0]] * 4
+                got.add(r["output"][0][0])
+            assert got == {2.0 * (ci * 10 + n)
+                           for ci in range(8) for n in range(5)}
+            assert summary["latency_s"]["p99"] > 0
+            with urllib.request.urlopen(
+                    "http://127.0.0.1:%d/metrics.json" % api.port,
+                    timeout=10) as resp:
+                snap = json.loads(resp.read())
+            assert snap["requests"] == 40
+            assert snap["dispatches"] < snap["requests"]
+            assert snap["batch_size"]["mean"] > 1
+            assert snap["responses"] == 40
+            assert snap["latency"]["p50"] > 0
+        finally:
+            api.stop()
+
+    def test_overload_yields_429_with_retry_after(self):
+        """A tiny queue under 16 concurrent clients sheds with HTTP 429
+        (structured body, Retry-After) instead of hanging."""
+        def slow_forward(x):
+            time.sleep(0.05)
+            return x
+
+        api = self._api(slow_forward, max_batch=2, queue_depth=2,
+                        batch_wait_s=0.0, deadline_s=10.0,
+                        sample_shape=(3,))
+        try:
+            summary = run_load(
+                "http://127.0.0.1:%d/predict" % api.port,
+                payload={"input": [[0.0, 0.0, 0.0]]}, clients=16,
+                requests_per_client=1, timeout=30)
+            assert summary["sent"] == 16
+            assert summary["by_status"].get("429", 0) > 0
+            assert summary["ok"] + summary["by_status"]["429"] == 16
+            rejected = [r for r in summary["responses"]
+                        if r and "retry_after" in r]
+            assert rejected and all(r["retry_after"] > 0
+                                    for r in rejected)
+        finally:
+            api.stop()
+
+    def test_malformed_request_fails_alone(self):
+        """A wrong-shaped request gets its own 400 — it must never
+        poison the coalesced batch it would have joined (other clients'
+        replies stay correct)."""
+        def forward(x):
+            time.sleep(0.005)
+            return x * 2.0
+
+        api = self._api(forward, max_batch=8, batch_wait_s=0.02,
+                        sample_shape=(4,))
+        try:
+            results = {"ok": [], "bad": []}
+            lock = threading.Lock()
+
+            def good(v):
+                out = _post(api.port, {"input": [[v] * 4]})
+                with lock:
+                    results["ok"].append(out["output"][0][0] == 2 * v)
+
+            def bad():
+                try:
+                    _post(api.port, {"input": [[1.0] * 5]})  # wrong width
+                except urllib.error.HTTPError as e:
+                    with lock:
+                        results["bad"].append(
+                            (e.code, json.loads(e.read())))
+
+            threads = [threading.Thread(target=good, args=(float(i),))
+                       for i in range(4)] + \
+                      [threading.Thread(target=bad) for _ in range(2)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert results["ok"] == [True] * 4
+            assert len(results["bad"]) == 2
+            for code, body in results["bad"]:
+                assert code == 400 and "sample shape" in body["error"]
+        finally:
+            api.stop()
+
+    def test_retry_after_is_integer_seconds(self):
+        """The Retry-After HEADER is RFC 9110 delta-seconds (integer);
+        the exact float rides in the JSON body."""
+        def slow_forward(x):
+            time.sleep(0.05)
+            return x
+
+        api = self._api(slow_forward, max_batch=1, queue_depth=1,
+                        batch_wait_s=0.0, sample_shape=(2,))
+        try:
+            headers = []
+
+            def client():
+                req = urllib.request.Request(
+                    "http://127.0.0.1:%d/predict" % api.port,
+                    data=json.dumps({"input": [[0.0, 0.0]]}).encode(),
+                    headers={"Content-Type": "application/json"})
+                try:
+                    urllib.request.urlopen(req, timeout=30).read()
+                except urllib.error.HTTPError as e:
+                    if e.code == 429:
+                        headers.append(e.headers.get("Retry-After"))
+                    e.read()
+
+            threads = [threading.Thread(target=client)
+                       for _ in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert headers                      # some 429s happened
+            for h in headers:
+                assert h is not None and h == str(int(h))   # integer
+                assert int(h) >= 1
+        finally:
+            api.stop()
+
+    def test_bad_first_request_does_not_poison_shape(self):
+        """No-warmup server: the canonical sample shape is adopted only
+        after a SUCCESSFUL dispatch, so a malformed first request fails
+        alone (500 from the forward) and later valid traffic serves."""
+        def forward(x):
+            if x.shape[1] != 4:
+                raise RuntimeError("bad width %d" % x.shape[1])
+            return x * 2.0
+
+        from veles_tpu.restful_api import RESTfulAPI
+        from veles_tpu.serving import MicroBatcher, ServingMetrics
+        api = RESTfulAPI(None, forward=forward)
+        api.batcher = MicroBatcher(forward, max_batch=4,
+                                   metrics=ServingMetrics("poison_t"))
+        api.metrics = api.batcher.metrics
+        api.start(port=0)
+        try:
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _post(api.port, {"input": [[1.0] * 5]})     # bad FIRST
+            assert err.value.code == 500
+            out = _post(api.port, {"input": [[3.0] * 4]})   # still fine
+            assert out["output"][0] == [6.0] * 4
+            # shape adopted from the successful dispatch: mismatches
+            # are now client errors, cheap and precise
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _post(api.port, {"input": [[1.0] * 5]})
+            assert err.value.code == 400
+            assert "sample shape" in json.loads(err.value.read())["error"]
+        finally:
+            api.stop()
+
+    def test_malformed_content_length_is_400(self):
+        import http.client
+        api = self._api(lambda x: x, max_batch=2, sample_shape=(2,))
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", api.port,
+                                              timeout=10)
+            conn.putrequest("POST", "/predict")
+            conn.putheader("Content-Length", "abc")
+            conn.putheader("Content-Type", "application/json")
+            conn.endheaders()
+            resp = conn.getresponse()
+            body = json.loads(resp.read())
+            assert resp.status == 400 and "Content-Length" in body["error"]
+            conn.close()
+        finally:
+            api.stop()
+
+    def test_structured_errors(self):
+        api = self._api(lambda x: x, max_batch=2, sample_shape=(2,))
+        api.max_body = 200
+        try:
+            port = api.port
+
+            def post_raw(body, path="/predict"):
+                req = urllib.request.Request(
+                    "http://127.0.0.1:%d%s" % (port, path), data=body,
+                    headers={"Content-Type": "application/json"})
+                with pytest.raises(urllib.error.HTTPError) as err:
+                    urllib.request.urlopen(req, timeout=10)
+                return err.value.code, json.loads(err.value.read())
+
+            code, body = post_raw(b"this is not json")
+            assert code == 400 and "error" in body
+            code, body = post_raw(b"{}")                # no "input"
+            assert code == 400 and "error" in body
+            code, body = post_raw(b'{"input": [[0.0, 0.0]]}',
+                                  path="/nope")
+            assert code == 404 and "error" in body
+            huge = json.dumps(
+                {"input": [[0.0, 0.0]] * 100}).encode()
+            assert len(huge) > api.max_body
+            code, body = post_raw(huge)
+            assert code == 413 and "error" in body
+        finally:
+            api.stop()
+
+
+def _tiny_params(max_len=48, vocab=16, n_heads=2, n_layers=2):
+    import jax
+    import jax.numpy as jnp
+    from veles_tpu import prng
+    from veles_tpu.ops.transformer import init_transformer_params
+    host = init_transformer_params(prng.get("init"), vocab, d_model=32,
+                                   n_heads=n_heads, n_layers=n_layers,
+                                   max_len=max_len)
+    return jax.tree.map(jnp.asarray, host)
+
+
+class TestLMEngine:
+    def test_greedy_matches_generate(self):
+        """Continuous batching is bit-identical to the sequential
+        KV-cached ``generate`` for the same prompts (the acceptance
+        criterion), including slot reuse when prompts outnumber
+        slots."""
+        import jax.numpy as jnp
+        from veles_tpu.ops.transformer import generate
+        from veles_tpu.serving import LMEngine
+        params = _tiny_params()
+        prompts = [[1, 2, 3], [2, 4, 6, 8, 10],
+                   [5, 1, 5, 1, 5, 1, 5, 1, 5], [7, 7], [0, 3, 9, 12]]
+        n_new = 6
+        expected = [numpy.asarray(generate(
+            params, jnp.asarray([p], jnp.int32), n_new, 2,
+            temperature=0.0, max_len=48))[0] for p in prompts]
+        engine = LMEngine(params, n_heads=2, max_len=48, slots=2,
+                          name="lm_t1").start()
+        try:
+            # submitted together: 5 prompts share 2 slots mid-flight
+            futures = [engine.submit(p, n_new) for p in prompts]
+            for p, f, exp in zip(prompts, futures, expected):
+                got = numpy.concatenate([p, f.result(timeout=60)])
+                numpy.testing.assert_array_equal(got, exp)
+            snap = engine.metrics.snapshot()
+            assert snap["requests"] == 5
+            assert snap["gauges"]["slots_total"] == 2
+        finally:
+            engine.stop()
+
+    def test_batch_generate_and_occupancy(self):
+        import jax.numpy as jnp
+        from veles_tpu.ops.transformer import generate
+        from veles_tpu.serving import LMEngine
+        params = _tiny_params()
+        prompts = numpy.asarray([[1, 2, 3, 4]] * 4, numpy.int32)
+        expected = numpy.asarray(generate(
+            params, jnp.asarray(prompts[:1], jnp.int32), 7, 2,
+            temperature=0.0, max_len=48))[0]
+        engine = LMEngine(params, n_heads=2, max_len=48, slots=4,
+                          name="lm_t2").start()
+        try:
+            out = engine.generate(prompts, 7)
+            assert out.shape == (4, 11)
+            for row in out:
+                numpy.testing.assert_array_equal(row, expected)
+            # identical prompts decoding concurrently: the step
+            # dispatches ran multiple lanes at once
+            assert engine.metrics.snapshot()["batch_size"]["mean"] > 1
+        finally:
+            engine.stop()
+
+    def test_batch_cancel_on_admission_failure(self):
+        """generate() with more rows than the queue admits: rows already
+        queued are withdrawn (no zombie decodes holding slots) and the
+        caller sees the refusal."""
+        from veles_tpu.serving import LMEngine, Overloaded
+        params = _tiny_params()
+        engine = LMEngine(params, n_heads=2, max_len=48, slots=1,
+                          queue_depth=2, name="lm_t4").start()
+        try:
+            prompts = numpy.asarray([[1, 2, 3]] * 8, numpy.int32)
+            with pytest.raises(Overloaded):
+                engine.generate(prompts, 40)     # 8 rows >> 1 slot + 2 queue
+            # the engine drains quickly: the withdrawn rows must not
+            # decode their full 40 tokens each
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                snap = engine.metrics.snapshot()
+                if snap["gauges"].get("slots_busy", 1) == 0 \
+                        and snap["gauges"].get("queue_depth", 1) == 0:
+                    break
+                time.sleep(0.05)
+            assert snap["gauges"]["queue_depth"] == 0
+            # a fresh request still works after the cancelled batch
+            out = engine.generate(prompts[:1], 4)
+            assert out.shape == (1, 7)
+        finally:
+            engine.stop()
+
+    def test_rejects_prompt_beyond_cache(self):
+        from veles_tpu.serving import LMEngine
+        params = _tiny_params(max_len=32)
+        engine = LMEngine(params, n_heads=2, max_len=32, slots=1,
+                          name="lm_t3").start()
+        try:
+            with pytest.raises(ValueError, match="exceeds the engine"):
+                engine.submit(list(range(30)), 8)
+        finally:
+            engine.stop()
+
+    def test_worker_survives_step_fault(self):
+        """A decode-step fault fails the in-flight lanes to their
+        clients and the engine keeps serving — it must never wedge
+        with futures nobody will resolve."""
+        import jax.numpy as jnp
+        from veles_tpu.ops.transformer import generate
+        from veles_tpu.serving import LMEngine
+        params = _tiny_params()
+        engine = LMEngine(params, n_heads=2, max_len=48, slots=2,
+                          name="lm_t5").start()
+        real_step = engine._step_jit
+        calls = {"n": 0}
+
+        def flaky_step(p, caches, last, pos):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("injected device fault")
+            return real_step(p, caches, last, pos)
+
+        engine._step_jit = flaky_step
+        try:
+            fut = engine.submit([1, 2, 3], 5)
+            with pytest.raises(RuntimeError, match="injected"):
+                fut.result(timeout=60)
+            # the engine recovered: the next request decodes correctly
+            out = engine.generate(numpy.asarray([[1, 2, 3]]), 5)
+            expected = numpy.asarray(generate(
+                params, jnp.asarray([[1, 2, 3]], jnp.int32), 5, 2,
+                temperature=0.0, max_len=48))[0]
+            numpy.testing.assert_array_equal(out[0], expected)
+            assert engine.metrics.snapshot()["errors"] == 1
+        finally:
+            engine.stop()
+
+
+class TestServeLMContinuous:
+    def test_http_engine_matches_direct(self):
+        """serve_lm(slots=2) over a (briefly) trained char_lm: engine
+        replies are exactly the direct greedy continuation, n_new is
+        honored exactly (no tier overshoot), and sampling requests
+        still work (direct-path fallback)."""
+        import jax.numpy as jnp
+        from veles_tpu import prng
+        from veles_tpu.config import root
+        from veles_tpu.ops.transformer import generate
+        from veles_tpu.restful_api import serve_lm
+        prng.reset()
+        prng.seed_all(5)
+        root.__dict__.pop("char_lm", None)
+        root.char_lm.update({
+            "loader": {"minibatch_size": 32, "n_train": 64, "n_valid": 32,
+                       "seq_len": 16, "vocab": 16},
+            "trainer": {"vocab": 16, "d_model": 32, "n_heads": 2,
+                        "n_layers": 1, "max_len": 32,
+                        "learning_rate": 3e-3, "n_experts": 0,
+                        "pipeline_stages": 0, "remat": False},
+            "decision": {"max_epochs": 1, "fail_iterations": 10},
+        })
+        from veles_tpu.samples import char_lm
+        wf = char_lm.train()
+        trainer = wf.trainer
+        params = trainer._to_portable(trainer.params)
+        api = serve_lm(wf, port=0, max_new=8, slots=2)
+        try:
+            for p in ([1, 2, 3], [2, 4, 6, 8, 10]):
+                out = _post(api.port, {"input": [p], "n_new": 5})
+                row = out["tokens"][0]
+                expected = numpy.asarray(generate(
+                    params, jnp.asarray([p], jnp.int32), 5,
+                    trainer.n_heads, temperature=0.0,
+                    max_len=int(trainer.max_len)))[0]
+                assert len(row) == len(p) + 5       # exact, no tier
+                numpy.testing.assert_array_equal(row, expected)
+            # sampling falls back to the direct path and still replies
+            out = _post(api.port, {"input": [[1, 2, 3]], "n_new": 4,
+                                   "temperature": 0.8, "seed": 3})
+            row = out["tokens"][0]
+            assert row[:3] == [1, 2, 3] and len(row) == 7
+            assert all(0 <= t < 16 for t in row)
+            # the engine's counters reached the serving port's metrics
+            with urllib.request.urlopen(
+                    "http://127.0.0.1:%d/metrics.json" % api.port,
+                    timeout=10) as resp:
+                snap = json.loads(resp.read())
+            assert snap["requests"] >= 2
+        finally:
+            api.stop()
+
+
+class TestMetrics:
+    def test_snapshot_and_percentiles(self):
+        from veles_tpu.serving import ServingMetrics
+        m = ServingMetrics("snap_t")
+        for i in range(100):
+            m.record_enqueue()
+            m.record_response(0.001 * (i + 1))
+        m.record_dispatch(8, queue_waits=[0.002, 0.004])
+        snap = m.snapshot()
+        assert snap["requests"] == snap["responses"] == 100
+        assert 0.045 < snap["latency"]["p50"] <= 0.06
+        assert 0.09 < snap["latency"]["p99"] <= 0.1
+        assert snap["batch_size"]["count"] == 1
+        assert snap["queue_wait"]["count"] == 2
+
+    def test_prometheus_rendering(self):
+        from veles_tpu.serving import ServingMetrics
+        m = ServingMetrics("prom_t")
+        m.record_enqueue()
+        m.record_dispatch(4, queue_waits=[0.003])
+        m.set_gauge("slots_busy", 3)
+        text = m.render_prometheus()
+        assert 'veles_serving_requests_total{engine="prom_t"} 1' in text
+        assert '# TYPE veles_serving_batch_size histogram' in text
+        # cumulative buckets: a 4-row dispatch counts at le=4 and above
+        assert 'veles_serving_batch_size_bucket{engine="prom_t",le="4"}'\
+            ' 1' in text
+        assert 'veles_serving_batch_size_bucket{engine="prom_t",le="2"}'\
+            ' 0' in text
+        assert 'veles_serving_batch_size_bucket{engine="prom_t",'\
+            'le="+Inf"} 1' in text
+        assert 'veles_serving_slots_busy{engine="prom_t"} 3' in text
+
+    def test_multi_engine_render_single_type_line_per_family(self):
+        """Two registered engines share ONE `# TYPE` line per family
+        (strict Prometheus parsers reject duplicates)."""
+        from veles_tpu.serving import metrics as metrics_mod
+        a, b = metrics_mod.new("eng_a"), metrics_mod.new("eng_b")
+        a.record_enqueue()
+        b.record_enqueue()
+        text = metrics_mod.render_prometheus()
+        assert text.count(
+            "# TYPE veles_serving_requests_total counter") == 1
+        assert text.count("# TYPE veles_serving_batch_size histogram") \
+            == 1
+        assert 'veles_serving_requests_total{engine="eng_a"} 1' in text
+        assert 'veles_serving_requests_total{engine="eng_b"} 1' in text
+
+    def test_new_replaces_registered_row(self):
+        """Engine restarts begin at zero — `new` replaces the row."""
+        from veles_tpu.serving import metrics as metrics_mod
+        m1 = metrics_mod.new("fresh_t")
+        m1.record_enqueue()
+        m2 = metrics_mod.new("fresh_t")
+        assert m2 is not m1
+        assert metrics_mod.get("fresh_t") is m2
+        assert m2.snapshot()["requests"] == 0
+
+    def test_web_status_metrics_endpoint(self):
+        """GET /metrics on the dashboard: registered serving engines +
+        workflow rows as gauges, one scrape surface."""
+        from veles_tpu.serving import metrics as metrics_mod
+        from veles_tpu.web_status import WebStatus
+        m = metrics_mod.get("ws_t")
+        m.record_enqueue()
+        m.record_dispatch(2, queue_waits=[0.001])
+        status = WebStatus().start(port=0)
+        try:
+            status.update("wf1", workflow="wf1", process=0, epoch=3,
+                          best=0.5, complete=True)
+            with urllib.request.urlopen(
+                    "http://127.0.0.1:%d/metrics" % status.port,
+                    timeout=10) as resp:
+                assert resp.headers["Content-Type"].startswith(
+                    "text/plain")
+                text = resp.read().decode()
+            assert 'veles_serving_requests_total{engine="ws_t"} 1' \
+                in text
+            assert 'veles_serving_queue_wait_bucket{engine="ws_t"' \
+                in text
+            assert 'veles_workflow_epoch{workflow="wf1",process="0"} 3' \
+                in text
+            assert 'veles_workflow_best_metric{workflow="wf1"' in text
+            assert 'veles_workflow_complete{workflow="wf1"' \
+                ',process="0"} 1' in text
+        finally:
+            status.stop()
+
+
+class TestTinyModelSmoke:
+    def test_two_clients_against_trained_workflow(self):
+        """Tier-1 smoke (satellite): a real (tiny) trained workflow
+        behind the batched endpoint, 2 concurrent clients, replies
+        match the direct path."""
+        from veles_tpu import prng
+        from veles_tpu.config import root
+        from veles_tpu.restful_api import RESTfulAPI
+        from veles_tpu.serving import ServingMetrics
+        prng.reset()
+        prng.seed_all(2)
+        root.mnist.update({
+            "loader": {"minibatch_size": 50, "n_train": 200,
+                       "n_valid": 100},
+            "decision": {"max_epochs": 1, "fail_iterations": 5},
+            "layers": [
+                {"type": "all2all_tanh", "output_sample_shape": 16,
+                 "learning_rate": 0.03, "momentum": 0.9},
+                {"type": "softmax", "output_sample_shape": 10,
+                 "learning_rate": 0.03, "momentum": 0.9},
+            ],
+        })
+        from veles_tpu.samples import mnist
+        wf = mnist.train()
+        api = RESTfulAPI(wf)
+        direct = api.predict(numpy.zeros((1, 784), numpy.float32))
+        api.enable_batching(max_batch=4, batch_wait_s=0.005,
+                            metrics=ServingMetrics("mnist_t"))
+        api.start(port=0)
+        try:
+            summary = run_load(
+                "http://127.0.0.1:%d/predict" % api.port,
+                payload={"input": numpy.zeros(
+                    (1, 784), numpy.float32).tolist()},
+                clients=2, requests_per_client=3)
+            assert summary["ok"] == summary["sent"] == 6
+            for r in summary["responses"]:
+                numpy.testing.assert_allclose(r["output"],
+                                              direct["output"],
+                                              atol=1e-5)
+        finally:
+            api.stop()
+
+
+@pytest.mark.slow
+class TestSustainedLoad:
+    def test_sustained_qps_with_histograms(self):
+        """Closed-loop sustained load (the slow-marked evidence run):
+        paced QPS for a fixed window, zero failures, coalescing and
+        full latency histograms on the server side."""
+        from veles_tpu.restful_api import RESTfulAPI
+        from veles_tpu.serving import ServingMetrics
+
+        def forward(x):
+            time.sleep(0.002)
+            return x * 3.0
+
+        api = RESTfulAPI(None, forward=forward)
+        api.enable_batching(max_batch=16, batch_wait_s=0.005,
+                            sample_shape=(8,),
+                            metrics=ServingMetrics("sustained_t"))
+        api.start(port=0)
+        try:
+            summary = run_load(
+                "http://127.0.0.1:%d/predict" % api.port,
+                payload={"input": [[1.0] * 8]}, clients=16,
+                qps=200, duration=5.0)
+            assert summary["ok"] == summary["sent"] > 100
+            assert summary["latency_s"]["p99"] < 5.0
+            snap = api.metrics.snapshot()
+            assert snap["dispatches"] < snap["requests"]
+            assert snap["batch_size"]["mean"] > 1
+            assert snap["latency"]["p99"] > 0
+        finally:
+            api.stop()

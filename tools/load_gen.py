@@ -1,0 +1,154 @@
+"""Closed-loop HTTP load generator for the serving endpoints.
+
+Drives N client threads against a veles_tpu serving port (restful_api's
+``/predict``), each looping POST → wait-for-reply → POST (closed loop),
+optionally paced to a target aggregate QPS.  Collects per-request
+latency and status counts and prints one JSON summary — the evidence
+side of the serving subsystem (ISSUE 1): mean dispatch batch size and
+429 behavior come from the server's ``/metrics.json``, client-side
+latency percentiles from here.
+
+Standalone::
+
+    python tools/load_gen.py --url http://127.0.0.1:8180/predict \
+        --payload '{"input": [[0.0, 0.0, 0.0, 0.0]]}' \
+        --clients 8 --requests 50 [--qps 100] [--duration 5]
+
+Importable: :func:`run_load` is used by the serving load tests
+(``tests/test_serving.py``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+
+def _percentile(sorted_vals, q):
+    if not sorted_vals:
+        return 0.0
+    return sorted_vals[min(len(sorted_vals) - 1,
+                           int(q * len(sorted_vals)))]
+
+
+def run_load(url, payload, clients=8, requests_per_client=20, qps=None,
+             duration=None, timeout=30.0, payload_fn=None):
+    """Run the closed-loop load; returns the summary dict.
+
+    ``payload`` is the JSON body every request posts; ``payload_fn``
+    (client_index, request_index) -> dict overrides it per request (so
+    correctness checks can give every request distinct input).
+    ``duration`` (seconds) replaces the per-client request count;
+    ``qps`` paces the AGGREGATE request rate across all clients.
+    """
+    interval = clients / qps if qps else 0.0
+    stop_at = None
+    results = []   # (status_code, latency_s, body_or_None)
+    lock = threading.Lock()
+
+    def client(ci):
+        n = 0
+        while True:
+            if stop_at is not None:
+                if time.monotonic() >= stop_at:
+                    return
+            elif n >= requests_per_client:
+                return
+            body = payload_fn(ci, n) if payload_fn is not None else payload
+            data = json.dumps(body).encode()
+            req = urllib.request.Request(
+                url, data=data,
+                headers={"Content-Type": "application/json"})
+            t0 = time.monotonic()
+            try:
+                with urllib.request.urlopen(req, timeout=timeout) as resp:
+                    out = json.loads(resp.read())
+                    code = resp.status
+            except urllib.error.HTTPError as e:
+                try:
+                    out = json.loads(e.read())
+                except Exception:   # noqa: BLE001 — non-JSON error body
+                    out = None
+                code = e.code
+            except Exception:   # noqa: BLE001 — connection-level failure
+                out, code = None, 0
+            dt = time.monotonic() - t0
+            with lock:
+                results.append((code, dt, out))
+            n += 1
+            if interval and dt < interval:
+                time.sleep(interval - dt)
+
+    if duration is not None:
+        stop_at = time.monotonic() + duration
+    t_start = time.monotonic()
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.monotonic() - t_start
+
+    by_status = {}
+    for code, _, _ in results:
+        by_status[str(code)] = by_status.get(str(code), 0) + 1
+    lats = sorted(dt for code, dt, _ in results if code == 200)
+    return {
+        "url": url,
+        "clients": clients,
+        "sent": len(results),
+        "ok": len(lats),
+        "by_status": by_status,
+        "wall_s": wall,
+        "achieved_qps": len(results) / wall if wall > 0 else 0.0,
+        "latency_s": {
+            "mean": sum(lats) / len(lats) if lats else 0.0,
+            "p50": _percentile(lats, 0.50),
+            "p95": _percentile(lats, 0.95),
+            "p99": _percentile(lats, 0.99),
+            "max": lats[-1] if lats else 0.0,
+        },
+        "responses": [r for _, _, r in results],
+    }
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--url", required=True,
+                        help="serving endpoint, e.g. "
+                             "http://127.0.0.1:8180/predict")
+    parser.add_argument("--payload", required=True,
+                        help="JSON request body (or @file to read one)")
+    parser.add_argument("--clients", type=int, default=8)
+    parser.add_argument("--requests", type=int, default=20,
+                        metavar="N", help="requests per client")
+    parser.add_argument("--qps", type=float, default=None,
+                        help="target aggregate request rate (default: "
+                             "unpaced closed loop)")
+    parser.add_argument("--duration", type=float, default=None,
+                        metavar="SECONDS",
+                        help="run for a wall-clock window instead of a "
+                             "fixed request count")
+    parser.add_argument("--timeout", type=float, default=30.0)
+    args = parser.parse_args(argv)
+    raw = args.payload
+    if raw.startswith("@"):
+        with open(raw[1:], encoding="utf-8") as f:
+            raw = f.read()
+    summary = run_load(args.url, json.loads(raw), clients=args.clients,
+                       requests_per_client=args.requests, qps=args.qps,
+                       duration=args.duration, timeout=args.timeout)
+    summary.pop("responses")     # bodies are for the tests, not the CLI
+    json.dump(summary, sys.stdout, indent=2)
+    print()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -143,6 +143,19 @@ def build_argparser():
                              "reference's snapshot-to-serving flow in one "
                              "command (train or --snapshot restore, then "
                              "serve)")
+    parser.add_argument("--serve-batch", type=int, default=0,
+                        metavar="MAX_BATCH",
+                        help="with --serve: coalesce concurrent /predict "
+                             "requests through the dynamic micro-batcher "
+                             "(veles_tpu.serving) into padded batches of "
+                             "up to MAX_BATCH rows; 0 = direct "
+                             "one-dispatch-per-request serving")
+    parser.add_argument("--serve-slots", type=int, default=0,
+                        metavar="SLOTS",
+                        help="with --serve on an LM workflow: decode up "
+                             "to SLOTS prompts concurrently over one "
+                             "shared KV cache (continuous batching); "
+                             "0 = one prompt batch at a time")
     return parser
 
 
@@ -319,11 +332,13 @@ def main(argv=None):
                 hasattr(wf.trainer, "n_heads"):
             # transformer-trainer workflows serve token continuation
             from veles_tpu.restful_api import serve_lm
-            api = serve_lm(wf, port=args.serve)
+            api = serve_lm(wf, port=args.serve, slots=args.serve_slots)
         else:
             api = RESTfulAPI(
-                wf, normalizer=getattr(wf.loader, "normalizer",
-                                       None)).start(port=args.serve)
+                wf, normalizer=getattr(wf.loader, "normalizer", None))
+            if args.serve_batch > 0:
+                api.enable_batching(max_batch=args.serve_batch)
+            api.start(port=args.serve)
         # parseable by wrappers/tests; flushed before blocking
         print("SERVING http://127.0.0.1:%d/predict" % api.port, flush=True)
         try:

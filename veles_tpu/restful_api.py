@@ -5,6 +5,26 @@ input through a trained forward pass over HTTP.  stdlib http.server on a
 background thread (the reference used Twisted web); the forward is the
 fused chain jitted once, so per-request work is one device dispatch.
 
+Two serving modes (ISSUE 1):
+
+- DIRECT (default) — each request runs its own dispatch; right for
+  single-user/debug serving.
+- BATCHED — :meth:`RESTfulAPI.enable_batching` routes ``/predict``
+  through :class:`veles_tpu.serving.MicroBatcher`: concurrent requests
+  coalesce into one padded power-of-two-bucket dispatch, a full queue
+  answers HTTP 429 with ``Retry-After``, and requests queued past their
+  deadline are shed with 503.  ``serve_lm(slots=N)`` likewise routes
+  greedy decode through :class:`veles_tpu.serving.LMEngine` (continuous
+  batching over a shared KV cache); sampled requests keep the direct
+  path.
+
+Error contract: every non-200 reply is structured JSON
+(``{"error": ...}``) with a meaningful status — 400 malformed request,
+404 unknown path, 413 oversized body (``max_body``), 429 overload
+(+``Retry-After`` seconds), 500 server fault, 503 shed past deadline.
+``GET /metrics.json`` (snapshot) and ``GET /metrics`` (Prometheus text)
+expose the serving counters on the serving port itself.
+
 Usage::
 
     api = RESTfulAPI(workflow)          # a trained StandardWorkflow
@@ -17,6 +37,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy
@@ -26,7 +47,7 @@ from veles_tpu.logger import Logger
 
 class RESTfulAPI(Logger):
     def __init__(self, workflow, normalizer=None, forward=None,
-                 handler=None):
+                 handler=None, metrics=None, max_body=16 << 20):
         self.workflow = workflow
         #: optional input normalizer (a loader's fitted normalizer) applied
         #: before the forward, so clients send raw feature scale
@@ -40,6 +61,17 @@ class RESTfulAPI(Logger):
         #: it replaces the predict flow entirely — used by serve_lm, whose
         #: requests carry decoding knobs beyond "input"
         self._handler = handler
+        #: serving counters (ServingMetrics) — end-to-end latency and
+        #: response counts are recorded HERE (engines own queue/dispatch
+        #: facts), so sharing one instance with an engine double-counts
+        #: nothing
+        self.metrics = metrics
+        #: request bodies beyond this are refused with 413 before parsing
+        self.max_body = int(max_body)
+        #: optional MicroBatcher the predict path routes through
+        self.batcher = None
+        #: optional LMEngine owned by serve_lm (stopped with the server)
+        self.lm_engine = None
 
     # ------------------------------------------------------------- inference
     def _ensure_forward(self):
@@ -68,43 +100,165 @@ class RESTfulAPI(Logger):
         self._forward = forward
         return forward
 
+    def _infer_sample_shape(self):
+        """Best-effort input sample shape (for bucket warmup): the
+        loader's minibatch row shape when a workflow is attached."""
+        data = getattr(getattr(self.workflow, "loader", None),
+                       "minibatch_data", None)
+        shape = getattr(data, "shape", None)
+        return tuple(shape[1:]) if shape and len(shape) > 1 else None
+
+    def enable_batching(self, max_batch=64, queue_depth=128,
+                        batch_wait_s=0.002, deadline_s=2.0,
+                        sample_shape=None, metrics=None,
+                        name="predict"):
+        """Route ``/predict`` through a :class:`MicroBatcher` (started
+        with the server).  Call before :meth:`start`.  ``name`` labels
+        this engine's metrics row — give each server its own when
+        several batched servers share one process (same-name engines
+        replace each other in the /metrics registry: the RESTART
+        semantics)."""
+        from veles_tpu.serving import MicroBatcher
+        from veles_tpu.serving import metrics as metrics_mod
+        if sample_shape is None:
+            sample_shape = self._infer_sample_shape()
+        # a FRESH registered instance per enable: a (re)started server
+        # must start its counters at zero, not atop the previous run's
+        m = metrics or metrics_mod.new(name)
+        self.batcher = MicroBatcher(
+            self._ensure_forward(), max_batch=max_batch,
+            queue_depth=queue_depth, batch_wait_s=batch_wait_s,
+            deadline_s=deadline_s, sample_shape=sample_shape,
+            metrics=m, name=name)
+        self.metrics = m
+        return self
+
     def predict(self, batch):
         x = numpy.asarray(batch, numpy.float32)
         if self.normalizer is not None:
             x = self.normalizer.apply(x)
-        probs = self._ensure_forward()(x)
+        if self.batcher is not None:
+            probs = self.batcher.submit(x)
+        else:
+            probs = self._ensure_forward()(x)
         return {"output": probs.tolist(),
                 "argmax": probs.reshape(len(probs), -1)
                                .argmax(axis=1).tolist()}
 
     # ---------------------------------------------------------------- server
     def start(self, host="127.0.0.1", port=8180):
+        from veles_tpu.serving.batcher import DeadlineExceeded, Overloaded
         api = self
+        if self.batcher is not None:
+            self.batcher.start()
 
         class Handler(BaseHTTPRequestHandler):
+            def _drain(self, length, cap=64 << 20):
+                """Discard an unread request body (bounded) before an
+                early error reply — closing with bytes still in flight
+                RSTs the connection and the client never sees the
+                structured error it was owed."""
+                left = min(length, cap)
+                while left > 0:
+                    chunk = self.rfile.read(min(left, 1 << 16))
+                    if not chunk:
+                        return
+                    left -= len(chunk)
+
+            def _reply(self, code, payload, headers=()):
+                body = json.dumps(payload).encode("utf-8")
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in headers:
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                path = self.path.rstrip("/")
+                if path == "/metrics.json" and api.metrics is not None:
+                    self._reply(200, api.metrics.snapshot())
+                elif path == "/metrics":
+                    from veles_tpu.serving import metrics as metrics_mod
+                    # merge this server's instance into the registry
+                    # render (one # TYPE line per family) even when a
+                    # later engine evicted it from the registry
+                    instances = metrics_mod.registered()
+                    if api.metrics is not None \
+                            and api.metrics not in instances:
+                        instances.append(api.metrics)
+                    body = metrics_mod.render_instances(instances).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self._reply(404, {"error": "unknown path %r"
+                                      % self.path})
+
             def do_POST(self):
-                if self.path.rstrip("/") != "/predict":
-                    self.send_error(404)
-                    return
+                t0 = time.monotonic()
                 try:
                     length = int(self.headers.get("Content-Length", 0))
+                except ValueError:
+                    self._reply(400, {"error": "malformed "
+                                      "Content-Length header"})
+                    return
+                if self.path.rstrip("/") != "/predict":
+                    self._drain(length)
+                    self._reply(404, {"error": "unknown path %r — POST "
+                                      "/predict" % self.path})
+                    return
+                if length > api.max_body:
+                    self._drain(length)
+                    self._reply(413, {
+                        "error": "request body %d bytes exceeds the "
+                                 "%d limit" % (length, api.max_body)})
+                    return
+                try:    # parse: malformed payloads are 400, full stop
                     payload = json.loads(self.rfile.read(length))
+                    batch = payload["input"]     # both flows require it
+                except (json.JSONDecodeError, KeyError, TypeError) as e:
+                    self._reply(400, {"error": "%s: %s"
+                                      % (type(e).__name__, e)})
+                    return
+                try:    # dispatch
                     result = (api._handler(payload)
                               if api._handler is not None
-                              else api.predict(payload["input"]))
-                    body = json.dumps(result).encode("utf-8")
-                    self.send_response(200)
-                    self.send_header("Content-Type", "application/json")
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
-                except Exception as e:   # noqa: BLE001 — reported to client
-                    body = json.dumps({"error": str(e)}).encode("utf-8")
-                    self.send_response(400)
-                    self.send_header("Content-Type", "application/json")
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
+                              else api.predict(batch))
+                except Overloaded as e:
+                    # Retry-After is integer delta-seconds per RFC 9110
+                    # (the exact float rides in the JSON body)
+                    self._reply(429, {"error": str(e),
+                                      "retry_after": e.retry_after},
+                                headers=[("Retry-After", "%d" % max(
+                                    1, int(e.retry_after + 0.999)))])
+                    return
+                except DeadlineExceeded as e:
+                    self._reply(503, {"error": str(e)},
+                                headers=[("Retry-After", "1")])
+                    return
+                except (TypeError, ValueError) as e:
+                    # input-validation contract: shape/range/length
+                    # complaints raised while processing the payload
+                    # (batcher shape check, serve_lm prompt bounds, bad
+                    # knob types) are the CLIENT's error
+                    self._reply(400, {"error": "%s: %s"
+                                      % (type(e).__name__, e)})
+                    return
+                except Exception as e:   # noqa: BLE001 — server fault
+                    if api.metrics is not None:
+                        api.metrics.record_error()
+                    api.warning("request failed: %s", e)
+                    self._reply(500, {"error": "%s: %s"
+                                      % (type(e).__name__, e)})
+                    return
+                if api.metrics is not None:
+                    api.metrics.record_response(time.monotonic() - t0)
+                self._reply(200, result)
 
             def log_message(self, fmt, *args):
                 api.debug("restful: " + fmt, *args)
@@ -122,17 +276,32 @@ class RESTfulAPI(Logger):
             self._server.shutdown()
             self._server.server_close()
             self._server = None
+        if self.batcher is not None:
+            self.batcher.stop()
+        if self.lm_engine is not None:
+            self.lm_engine.stop()
 
 
-def serve_lm(workflow, host="127.0.0.1", port=8180, max_new=256):
+def serve_lm(workflow, host="127.0.0.1", port=8180, max_new=256,
+             slots=0, queue_depth=64, deadline_s=30.0):
     """Serve a trained transformer-trainer workflow (e.g. char_lm) for
     autoregressive continuation: POST ``{"input": [[tok, ...]],
     "n_new": N, "temperature": T, "top_k": K, "seed": S}`` to
     ``/predict`` returns ``{"tokens": [[...]]}`` — prompt plus
-    continuation per row.  Decoding is the KV-cached
-    ``transformer.generate`` path, one jitted dispatch per request.
-    Compile count and per-request cost are both BOUNDED against
-    adversarial or merely varied clients:
+    continuation per row.
+
+    ``slots > 0`` starts a :class:`veles_tpu.serving.LMEngine` and
+    routes GREEDY requests (temperature 0, the default) through
+    slot-based continuous batching: concurrent prompts decode side by
+    side over one shared KV cache, each request gets its exact
+    ``n_new`` (no tier overshoot), and output is bit-identical to the
+    direct path.  Sampled requests (temperature > 0) always take the
+    direct path below.
+
+    The direct path decodes one prompt batch at a time via the
+    KV-cached ``transformer.generate``, one jitted dispatch per
+    request.  Compile count and per-request cost are both BOUNDED
+    against adversarial or merely varied clients:
 
     - prompt lengths are BUCKETED — the prompt is right-padded to the
       next power of two and decoded with a traced ``true_len`` (bit-exact
@@ -155,6 +324,17 @@ def serve_lm(workflow, host="127.0.0.1", port=8180, max_new=256):
     # n_new; {8,32,max} alone made an n_new=40 request pay a full
     # max_new=256 decode)
     tiers = sorted({t for t in (8, 32, 128, max_new) if t <= max_new})
+    engine = None
+    if slots > 0:
+        from veles_tpu.serving import LMEngine
+        from veles_tpu.serving import metrics as metrics_mod
+        engine = LMEngine(
+            params, n_heads=trainer.n_heads, max_len=cache_len,
+            slots=slots, rope=getattr(trainer, "rope", False),
+            window=getattr(trainer, "window", None),
+            sinks=getattr(trainer, "attn_sinks", 0),
+            queue_depth=queue_depth, deadline_s=deadline_s,
+            metrics=metrics_mod.new("lm")).start()
 
     def handler(request):
         prompt = numpy.asarray(request["input"], numpy.int32)
@@ -166,6 +346,12 @@ def serve_lm(workflow, host="127.0.0.1", port=8180, max_new=256):
         if headroom < 1:
             raise ValueError("prompt length %d leaves no room to decode "
                              "(max_len %d)" % (s_true, cache_len))
+        temperature = float(request.get("temperature", 0.0))
+        if engine is not None and temperature == 0.0:
+            # continuous batching: exact n_new (no tier), concurrent
+            # prompts share the decode step across slots
+            return {"tokens": engine.generate(
+                prompt, min(want, headroom)).tolist()}
         # decode length: round the request UP to a tier; near the cache
         # cap fall back to the largest tier that fits (or the exact
         # headroom when even the smallest doesn't — rare, self-limiting)
@@ -184,7 +370,7 @@ def serve_lm(workflow, host="127.0.0.1", port=8180, max_new=256):
         top_k = request.get("top_k")
         out = trainer_sample_tokens(
             trainer, prompt, n_new=run,
-            temperature=float(request.get("temperature", 0.0)),
+            temperature=temperature,
             seed=int(request.get("seed", 0)), params=params,
             max_len=cache_len,
             top_k=int(top_k) if top_k is not None else None,
@@ -195,17 +381,28 @@ def serve_lm(workflow, host="127.0.0.1", port=8180, max_new=256):
         return {"tokens": numpy.concatenate(
             [out[:, :s_true], new], axis=1).tolist()}
 
-    return RESTfulAPI(None, handler=handler).start(host=host, port=port)
+    api = RESTfulAPI(None, handler=handler,
+                     metrics=engine.metrics if engine is not None
+                     else None)
+    api.lm_engine = engine
+    return api.start(host=host, port=port)
 
 
-def serve_artifact(path, host="127.0.0.1", port=8180):
+def serve_artifact(path, host="127.0.0.1", port=8180, max_batch=0):
     """Serve a StableHLO export artifact (veles_tpu.export) WITHOUT
     constructing any training workflow — the libVeles serving path
-    (SURVEY §2.4/§3.4): load weights + compiled forward, start HTTP."""
+    (SURVEY §2.4/§3.4): load weights + compiled forward, start HTTP.
+    ``max_batch > 0`` coalesces concurrent requests through the
+    micro-batcher (the artifact's symbolic batch dim makes every bucket
+    a warm program)."""
     from veles_tpu.export import load_model
     model = load_model(path)
-    return RESTfulAPI(None, forward=model.predict).start(host=host,
-                                                         port=port)
+    api = RESTfulAPI(None, forward=model.predict)
+    if max_batch > 0:
+        api.enable_batching(
+            max_batch=max_batch,
+            sample_shape=tuple(model.manifest["input_sample_shape"]))
+    return api.start(host=host, port=port)
 
 
 def serve_snapshot(path, host="127.0.0.1", port=8180, build=None):

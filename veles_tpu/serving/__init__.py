@@ -1,0 +1,34 @@
+"""Serving subsystem — inference traffic at scale (ISSUE 1).
+
+The inference-traffic counterpart of ``veles_tpu.parallel``: where the
+direct REST path (``restful_api.py``) pays one device dispatch per HTTP
+request, this package amortizes dispatch across concurrent clients.
+
+- :mod:`veles_tpu.serving.batcher` — :class:`MicroBatcher`: dynamic
+  micro-batching of ``/predict`` traffic into padded power-of-two batch
+  buckets (warmed at start), with admission control (bounded queue →
+  :class:`Overloaded` / HTTP 429 + ``Retry-After``) and per-request
+  deadlines (:class:`DeadlineExceeded` / HTTP 503).
+- :mod:`veles_tpu.serving.lm_engine` — :class:`LMEngine`: slot-based
+  continuous batching for autoregressive LM decode over one shared KV
+  cache (greedy path bit-identical to ``ops.transformer.generate``).
+- :mod:`veles_tpu.serving.metrics` — :class:`ServingMetrics`:
+  lock-cheap counters/histograms (queue wait, batch size, latency
+  percentiles, shed/429, slot occupancy) with a snapshot API and a
+  Prometheus renderer (served by ``web_status.py`` at ``/metrics``).
+
+The engines are OPTIONAL: ``restful_api.py`` keeps the direct
+one-dispatch-per-request path for single-user/debug use and routes
+through here when asked (``RESTfulAPI.enable_batching``, ``serve_lm``'s
+``slots=``, CLI ``--serve-batch`` / ``--serve-slots``).
+"""
+
+from veles_tpu.serving.batcher import (DeadlineExceeded, MicroBatcher,
+                                       Overloaded, batch_buckets)
+from veles_tpu.serving.lm_engine import LMEngine, prompt_bucket
+from veles_tpu.serving.metrics import (ServingMetrics, get,
+                                       render_prometheus)
+
+__all__ = ["MicroBatcher", "LMEngine", "ServingMetrics", "Overloaded",
+           "DeadlineExceeded", "batch_buckets", "prompt_bucket", "get",
+           "render_prometheus"]

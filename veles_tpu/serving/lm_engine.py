@@ -1,0 +1,381 @@
+"""Continuous LM decode — slot-based batching over one shared KV cache.
+
+The LM-traffic half of the serving subsystem (ISSUE 1).  ``serve_lm``'s
+direct path decodes one prompt at a time: a second client waits for the
+whole first decode even though the decode step is embarrassingly
+batchable.  :class:`LMEngine` keeps a fixed pool of ``slots`` decode
+lanes sharing one batched KV cache (per block: (slots, kv_heads,
+max_len, head_dim)) and runs ONE vmapped decode step per token across
+every active lane — vLLM-style continuous batching on a jit substrate:
+
+- an arriving prompt is PREFILLED into any free slot mid-flight
+  (``ops/transformer.py::prefill`` at a power-of-two prompt bucket,
+  installed into the big cache at the slot index);
+- every engine tick advances ALL active slots by one token via a single
+  jitted vmap of ``ops/transformer.py::block_decode_step`` (per-slot
+  positions — each lane is at its own depth in its own sequence);
+- a finished sequence frees its slot immediately and the next queued
+  prompt takes it, so decode throughput scales with slot count instead
+  of serializing per prompt.
+
+Decoding is GREEDY (temperature 0) — bit-identical to
+``ops/transformer.py::generate`` for the same prompt, which is the
+serving contract (sampled requests fall back to the direct path
+upstream).  Compile count is bounded: one step program, one prefill
+program per prompt bucket, one install program.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy
+
+from veles_tpu.logger import Logger
+from veles_tpu.serving.batcher import DeadlineExceeded, Overloaded
+from veles_tpu.serving.metrics import ServingMetrics
+
+
+class _Request:
+    __slots__ = ("prompt", "true_len", "n_new", "future", "t_enq",
+                 "deadline", "cancelled")
+
+    def __init__(self, prompt, n_new, deadline_s):
+        self.prompt = prompt          # (s,) int32, unpadded
+        self.true_len = len(prompt)
+        self.n_new = n_new
+        self.future = Future()
+        self.future.request = self    # cancellation handle
+        self.t_enq = time.monotonic()
+        self.deadline = self.t_enq + deadline_s
+        self.cancelled = False
+
+
+class _Slot:
+    """Host-side lane state; device state lives in the shared caches."""
+
+    __slots__ = ("request", "emitted", "remaining")
+
+    def __init__(self, request):
+        self.request = request
+        self.emitted = []
+        self.remaining = request.n_new
+
+
+def prompt_bucket(true_len, max_len, floor=16):
+    """Power-of-two prompt pad width (compile-count bound), capped at
+    the cache length."""
+    bucket = floor
+    while bucket < true_len:
+        bucket *= 2
+    return min(bucket, max_len)
+
+
+class LMEngine(Logger):
+    """Slot-based continuous batching over ``params`` (a portable
+    transformer param tree, see ``TransformerTrainer._to_portable``).
+
+    One worker thread owns the device state; clients :meth:`submit`
+    single prompts (or :meth:`generate` a batch) and block on futures.
+    ``max_len`` pins the shared cache length: every request must satisfy
+    ``len(prompt) + n_new <= max_len``.
+    """
+
+    def __init__(self, params, n_heads, max_len, slots=4, rope=False,
+                 window=None, sinks=0, queue_depth=64, deadline_s=30.0,
+                 metrics=None, name="lm"):
+        import jax.numpy as jnp
+        if slots < 1:
+            raise ValueError("slots must be >= 1")
+        self.name = name
+        self.params = params
+        self.n_heads = int(n_heads)
+        self.max_len = int(max_len)
+        self.slots = int(slots)
+        self.rope = bool(rope)
+        self.window = window
+        self.sinks = int(sinks)
+        self.queue_depth = int(queue_depth)
+        self.deadline_s = float(deadline_s)
+        self.metrics = metrics or ServingMetrics(name)
+        self.metrics.set_gauge("slots_total", self.slots)
+        self.metrics.set_gauge("slots_busy", 0)
+
+        embed = params["embed"]
+        d_model = embed.shape[1]
+        head_dim = d_model // self.n_heads
+        kv_heads = params["blocks"][0]["attn"]["wk"].shape[1] // head_dim
+        cache_shape = (self.slots, kv_heads, self.max_len, head_dim)
+        self._caches = [(jnp.zeros(cache_shape, embed.dtype),
+                         jnp.zeros(cache_shape, embed.dtype))
+                        for _ in params["blocks"]]
+        #: per-slot device-facing scalars, host-owned between ticks
+        self._pos = numpy.zeros(self.slots, numpy.int32)
+        self._last = numpy.zeros(self.slots, numpy.int32)
+        self._lanes = [None] * self.slots
+        self._free = list(range(self.slots))
+
+        self._queue = collections.deque()
+        self._cond = threading.Condition()
+        self._thread = None
+        self._stop = False
+        self._build_jits()
+
+    # ------------------------------------------------------------- jitted core
+    def _build_jits(self):
+        import jax
+        import jax.numpy as jnp
+        from veles_tpu.ops.transformer import (block_decode_step,
+                                               head_logits, prefill)
+        n_heads, max_len = self.n_heads, self.max_len
+        rope, window, sinks = self.rope, self.window, self.sinks
+
+        def prefill_one(params, prompt, true_len):
+            # prompt (1, bucket) int32, true_len traced: positions
+            # < true_len are exact under causal attention regardless of
+            # pad content (see transformer._generate_impl), so one
+            # compile serves every prompt length in the bucket
+            h, caches = prefill(params, prompt, n_heads, max_len,
+                                rope=rope, window=window, sinks=sinks)
+            logits = head_logits(params, jax.lax.dynamic_slice_in_dim(
+                h, true_len - 1, 1, axis=1))[:, 0, :]
+            tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)[0]
+            return tok, caches
+
+        def install(caches, rows, slot):
+            # scatter one prefilled lane (rows of (1,H,L,D)) into the
+            # shared cache at a TRACED slot index — one compile total
+            return [(k.at[slot].set(rk[0]), v.at[slot].set(rv[0]))
+                    for (k, v), (rk, rv) in zip(caches, rows)]
+
+        def step_one(params, cache_rows, tok, pos):
+            # one lane, one token: feed ``tok`` at ``pos`` against this
+            # lane's cache rows; vmapped below over the slot axis so
+            # every lane advances in ONE dispatch at its own position
+            x = jnp.take(params["embed"], tok[None], axis=0)[None]
+            if "pos" in params:
+                x = x + jax.lax.dynamic_slice_in_dim(
+                    params["pos"], pos, 1, axis=0)[None]
+            new_rows = []
+            for blk, (kc, vc) in zip(params["blocks"], cache_rows):
+                x, kc, vc = block_decode_step(
+                    blk, x, kc[None], vc[None], pos, n_heads, rope=rope,
+                    window=window, sinks=sinks)
+                new_rows.append((kc[0], vc[0]))
+            logits = head_logits(params, x)[0, 0, :]
+            return new_rows, jnp.argmax(logits).astype(jnp.int32)
+
+        self._prefill_jit = jax.jit(prefill_one)
+        self._install_jit = jax.jit(install)
+        self._step_jit = jax.jit(jax.vmap(step_one,
+                                          in_axes=(None, 0, 0, 0)))
+
+    # --------------------------------------------------------------- lifecycle
+    def start(self):
+        import jax.numpy as jnp
+        # warm the step program (and the smallest prompt bucket) before
+        # traffic: the discarded warmup writes land at pos 0 of free
+        # slots, which the next prefill overwrites before they are ever
+        # attended
+        tok, rows = self._prefill_jit(
+            self.params,
+            jnp.zeros((1, prompt_bucket(1, self.max_len)), jnp.int32),
+            jnp.asarray(1, jnp.int32))
+        self._caches = self._install_jit(self._caches, rows,
+                                         jnp.asarray(0, jnp.int32))
+        self._caches, _ = self._step_jit(
+            self.params, self._caches,
+            jnp.zeros(self.slots, jnp.int32),
+            jnp.ones(self.slots, jnp.int32))
+        self._stop = False
+        self._thread = threading.Thread(target=self._worker, daemon=True,
+                                        name="lm-engine-%s" % self.name)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=60)
+            self._thread = None
+
+    # ------------------------------------------------------------------ client
+    def submit(self, prompt, n_new):
+        """Queue one prompt ((s,) ints) for ``n_new`` greedy tokens;
+        returns a Future resolving to the (n_new,) continuation."""
+        prompt = numpy.asarray(prompt, numpy.int32).reshape(-1)
+        if len(prompt) < 1:
+            raise ValueError("empty prompt")
+        if n_new < 1:
+            raise ValueError("n_new must be >= 1")
+        if len(prompt) + n_new > self.max_len:
+            raise ValueError("prompt %d + n_new %d exceeds the engine "
+                             "cache length %d"
+                             % (len(prompt), n_new, self.max_len))
+        with self._cond:
+            if self._stop or self._thread is None:
+                raise RuntimeError("LM engine is not running")
+            if len(self._queue) >= self.queue_depth:
+                self.metrics.record_reject()
+                raise Overloaded()
+            req = _Request(prompt, int(n_new), self.deadline_s)
+            self._queue.append(req)
+            self.metrics.record_enqueue()
+            self.metrics.set_gauge("queue_depth", len(self._queue))
+            self._cond.notify()
+        return req.future
+
+    def generate(self, prompts, n_new):
+        """Decode a whole (b, s) prompt batch; returns (b, s + n_new)
+        int32 — prompt plus greedy continuation per row (rows decode
+        concurrently across slots).  All-or-nothing: if a later row is
+        refused (Overloaded/...), the rows already queued are CANCELLED
+        instead of decoding to discarded results — a rejected batch must
+        not keep consuming slots exactly when the engine is overloaded."""
+        prompts = numpy.asarray(prompts, numpy.int32)
+        futures = []
+        try:
+            for row in prompts:
+                futures.append(self.submit(row, n_new))
+            news = numpy.stack([f.result() for f in futures])
+        except Exception:
+            # one row refused (Overloaded) or failed (shed, prefill
+            # fault): withdraw ALL siblings — they must not keep
+            # consuming slots for output nobody will read
+            for f in futures:
+                self._cancel(f.request)
+            raise
+        return numpy.concatenate([prompts, news], axis=1)
+
+    def _cancel(self, req):
+        """Withdraw a request: dequeue it if still queued; if already in
+        a slot, flag it so the worker frees the slot at the next tick."""
+        req.cancelled = True
+        with self._cond:
+            try:
+                self._queue.remove(req)
+            except ValueError:
+                return           # admitted (or done) — worker handles it
+        req.future.cancel()
+
+    # ------------------------------------------------------------------ worker
+    def _admit(self):
+        """Move queued prompts into free slots (prefill + install)."""
+        import jax.numpy as jnp
+        while self._free:
+            with self._cond:
+                req = self._queue.popleft() if self._queue else None
+                self.metrics.set_gauge("queue_depth", len(self._queue))
+            if req is None:
+                return
+            if req.cancelled:            # raced _cancel's dequeue
+                req.future.cancel()
+                continue
+            if time.monotonic() > req.deadline:
+                self.metrics.record_shed()
+                req.future.set_exception(DeadlineExceeded(
+                    "prompt shed after %.3fs in queue" % (
+                        time.monotonic() - req.t_enq)))
+                continue
+            slot = self._free.pop()
+            bucket = prompt_bucket(req.true_len, self.max_len)
+            prompt = req.prompt
+            if bucket > req.true_len:
+                prompt = numpy.pad(prompt,
+                                   (0, bucket - req.true_len))
+            try:
+                tok, rows = self._prefill_jit(
+                    self.params, jnp.asarray(prompt[None], jnp.int32),
+                    jnp.asarray(req.true_len, jnp.int32))
+                self._caches = self._install_jit(
+                    self._caches, rows, jnp.asarray(slot, jnp.int32))
+            except Exception as e:   # noqa: BLE001 — fails THIS request
+                # a prefill fault (bad bucket compile, device error)
+                # must fail its own request, not wedge the engine
+                self.metrics.record_error()
+                self.warning("prefill failed: %s", e)
+                self._free.append(slot)
+                if not req.future.cancelled():
+                    req.future.set_exception(e)
+                continue
+            self.metrics.record_queue_wait(
+                time.monotonic() - req.t_enq)
+            lane = _Slot(req)
+            lane.emitted.append(int(tok))
+            lane.remaining -= 1
+            self._pos[slot] = req.true_len
+            self._last[slot] = int(tok)
+            self._lanes[slot] = lane
+            if lane.remaining == 0:
+                self._finish(slot)
+
+    def _finish(self, slot):
+        lane = self._lanes[slot]
+        self._lanes[slot] = None
+        self._free.append(slot)
+        fut = lane.request.future
+        if not fut.cancelled():          # withdrawn mid-decode
+            fut.set_result(numpy.asarray(lane.emitted, numpy.int32))
+
+    def _worker(self):
+        import jax.numpy as jnp
+        while True:
+            self._admit()
+            active = [i for i, lane in enumerate(self._lanes)
+                      if lane is not None]
+            self.metrics.set_gauge("slots_busy", len(active))
+            if not active:
+                with self._cond:
+                    if self._stop:
+                        break
+                    if not self._queue:
+                        self._cond.wait(0.5)
+                continue
+            # ONE dispatch advances every active lane by one token;
+            # inactive lanes step too (their writes land at a frozen
+            # position that the next prefill/decode overwrites before
+            # attending — see the module docstring), so the step program
+            # never respecializes on the active set
+            try:
+                self._caches, toks = self._step_jit(
+                    self.params, self._caches,
+                    jnp.asarray(self._last), jnp.asarray(self._pos))
+                toks = numpy.asarray(toks)
+            except Exception as e:   # noqa: BLE001 — fails the lanes
+                # a step fault poisons every in-flight lane; fail them
+                # to their clients and keep serving — never wedge with
+                # futures that no one will ever resolve
+                self.metrics.record_error()
+                self.warning("decode step failed: %s", e)
+                for slot in active:
+                    lane = self._lanes[slot]
+                    self._lanes[slot] = None
+                    self._free.append(slot)
+                    if not lane.request.future.cancelled():
+                        lane.request.future.set_exception(e)
+                continue
+            self.metrics.record_dispatch(len(active))
+            for slot in active:
+                lane = self._lanes[slot]
+                lane.emitted.append(int(toks[slot]))
+                lane.remaining -= 1
+                self._pos[slot] += 1
+                self._last[slot] = int(toks[slot])
+                if lane.remaining == 0 or lane.request.cancelled:
+                    self._finish(slot)
+        # drain: engine stopping fails whatever is still queued
+        with self._cond:
+            pending = list(self._queue)
+            self._queue.clear()
+        for req in pending:
+            req.future.set_exception(RuntimeError("LM engine stopped"))
+        for slot, lane in enumerate(self._lanes):
+            if lane is not None:
+                lane.request.future.set_exception(
+                    RuntimeError("LM engine stopped"))
+                self._lanes[slot] = None

@@ -1,0 +1,249 @@
+"""Serving metrics — lock-cheap counters/histograms with a snapshot API.
+
+The observability half of the serving subsystem (ISSUE 1): every engine
+(micro-batcher, LM slot engine) owns one :class:`ServingMetrics` and
+records per-request and per-batch facts into it — queue wait, dispatch
+batch size, end-to-end latency, 429/shed counts, slot occupancy.
+Recording is a few integer adds under one short-lived lock (no
+allocation on the hot path beyond the bounded latency ring), so the
+serving threads never serialize on observability.
+
+Consumers read via :meth:`ServingMetrics.snapshot` (a plain dict with
+p50/p95/p99 computed over a bounded reservoir of recent latencies) or
+the module-level :func:`render_prometheus`, which renders every
+registered instance in Prometheus text format — ``web_status.py``
+serves that at ``GET /metrics`` so the dashboard and scrapers share
+one source.
+"""
+
+from __future__ import annotations
+
+import bisect
+import collections
+import threading
+
+#: default histogram bucket bounds (seconds) for queue-wait / latency
+TIME_BOUNDS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+               0.5, 1.0, 2.5, 5.0, 10.0)
+#: default bucket bounds for dispatch batch sizes (powers of two)
+SIZE_BOUNDS = (1, 2, 4, 8, 16, 32, 64, 128, 256)
+
+
+class Histogram:
+    """Fixed-bound histogram (``le`` upper bounds, +Inf implicit).
+
+    NOT thread-safe on its own — the owning ServingMetrics' lock guards
+    every observe/read (one lock for the whole instance is cheaper than
+    one per histogram at these rates)."""
+
+    __slots__ = ("bounds", "counts", "total", "sum")
+
+    def __init__(self, bounds):
+        self.bounds = tuple(bounds)
+        self.counts = [0] * (len(self.bounds) + 1)   # last = overflow
+        self.total = 0
+        self.sum = 0.0
+
+    def observe(self, value):
+        self.counts[bisect.bisect_left(self.bounds, value)] += 1
+        self.total += 1
+        self.sum += value
+
+    def snapshot(self):
+        return {"buckets": {str(b): c for b, c in
+                            zip(self.bounds + ("+Inf",), self._cum())},
+                "count": self.total,
+                "sum": self.sum,
+                "mean": self.sum / self.total if self.total else 0.0}
+
+    def _cum(self):
+        """Cumulative counts per bound (the Prometheus convention)."""
+        out, acc = [], 0
+        for c in self.counts:
+            acc += c
+            out.append(acc)
+        return out
+
+
+def _percentile(sorted_vals, q):
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, int(q * len(sorted_vals)))
+    return sorted_vals[i]
+
+
+class ServingMetrics:
+    """One engine's counters; create via :func:`get` to auto-register."""
+
+    def __init__(self, name="serving", latency_window=4096):
+        self.name = name
+        self._lock = threading.Lock()
+        #: counters
+        self.requests = 0        # admitted into a queue
+        self.responses = 0       # completed successfully
+        self.rejected = 0        # refused at admission (HTTP 429)
+        self.shed = 0            # dropped from the queue past deadline
+        self.errors = 0          # failed dispatches / handler errors
+        self.dispatches = 0      # device dispatches (batches / steps)
+        self.rows = 0            # rows across all dispatches
+        #: histograms
+        self.queue_wait = Histogram(TIME_BOUNDS)
+        self.batch_size = Histogram(SIZE_BOUNDS)
+        self.latency = Histogram(TIME_BOUNDS)
+        #: bounded reservoir of recent end-to-end latencies (percentiles)
+        self._recent = collections.deque(maxlen=latency_window)
+        #: point-in-time values (queue depth, slot occupancy, ...)
+        self.gauges = {}
+
+    # ------------------------------------------------------------- recording
+    def record_enqueue(self):
+        with self._lock:
+            self.requests += 1
+
+    def record_reject(self):
+        with self._lock:
+            self.rejected += 1
+
+    def record_shed(self):
+        with self._lock:
+            self.shed += 1
+
+    def record_error(self):
+        with self._lock:
+            self.errors += 1
+
+    def record_dispatch(self, batch_rows, queue_waits=()):
+        """One device dispatch of ``batch_rows`` rows; ``queue_waits``
+        are the seconds each member request spent queued."""
+        with self._lock:
+            self.dispatches += 1
+            self.rows += batch_rows
+            self.batch_size.observe(batch_rows)
+            for w in queue_waits:
+                self.queue_wait.observe(w)
+
+    def record_queue_wait(self, wait_s):
+        with self._lock:
+            self.queue_wait.observe(wait_s)
+
+    def record_response(self, latency_s):
+        with self._lock:
+            self.responses += 1
+            self.latency.observe(latency_s)
+            self._recent.append(latency_s)
+
+    def set_gauge(self, name, value):
+        with self._lock:
+            self.gauges[name] = value
+
+    # --------------------------------------------------------------- reading
+    def snapshot(self):
+        """Plain-dict snapshot (JSON-safe) with latency percentiles."""
+        with self._lock:
+            recent = sorted(self._recent)
+            return {
+                "name": self.name,
+                "requests": self.requests,
+                "responses": self.responses,
+                "rejected": self.rejected,
+                "shed": self.shed,
+                "errors": self.errors,
+                "dispatches": self.dispatches,
+                "rows": self.rows,
+                "queue_wait": self.queue_wait.snapshot(),
+                "batch_size": self.batch_size.snapshot(),
+                "latency": dict(self.latency.snapshot(),
+                                p50=_percentile(recent, 0.50),
+                                p95=_percentile(recent, 0.95),
+                                p99=_percentile(recent, 0.99)),
+                "gauges": dict(self.gauges),
+            }
+
+    def _families(self):
+        """[(family, kind, [sample lines])] — merged per family across
+        engines by the renderers, so the exposition carries exactly ONE
+        ``# TYPE`` line per metric family (strict parsers reject
+        duplicates)."""
+        label = '{engine="%s"}' % self.name
+        fams = []
+        with self._lock:
+            for cname in ("requests", "responses", "rejected", "shed",
+                          "errors", "dispatches", "rows"):
+                metric = "veles_serving_%s_total" % cname
+                fams.append((metric, "counter",
+                             ["%s%s %d" % (metric, label,
+                                           getattr(self, cname))]))
+            for hname in ("queue_wait", "batch_size", "latency"):
+                hist = getattr(self, hname)
+                metric = "veles_serving_%s" % hname
+                lines = ['%s_bucket{engine="%s",le="%s"} %d'
+                         % (metric, self.name, bound, cum)
+                         for bound, cum in zip(hist.bounds + ("+Inf",),
+                                               hist._cum())]
+                lines.append("%s_sum%s %g" % (metric, label, hist.sum))
+                lines.append("%s_count%s %d" % (metric, label,
+                                                hist.total))
+                fams.append((metric, "histogram", lines))
+            for gname, value in sorted(self.gauges.items()):
+                metric = "veles_serving_%s" % gname
+                fams.append((metric, "gauge",
+                             ["%s%s %g" % (metric, label, value)]))
+        return fams
+
+    def render_prometheus(self):
+        """This instance's metrics in Prometheus text format."""
+        return render_instances([self])
+
+
+# ------------------------------------------------------------------ registry
+_registry = {}
+_registry_lock = threading.Lock()
+
+
+def register(metrics):
+    """Make ``metrics`` visible to the global /metrics renderer (latest
+    instance wins per name — restarted engines replace their row)."""
+    with _registry_lock:
+        _registry[metrics.name] = metrics
+    return metrics
+
+
+def get(name="serving"):
+    """The registered instance for ``name``, created on first use."""
+    with _registry_lock:
+        if name not in _registry:
+            _registry[name] = ServingMetrics(name)
+        return _registry[name]
+
+
+def new(name):
+    """A FRESH registered instance for ``name`` — engine starts use this
+    so a restarted server begins at zero instead of accumulating into
+    the previous run's counters (the old row is replaced)."""
+    return register(ServingMetrics(name))
+
+
+def registered():
+    with _registry_lock:
+        return list(_registry.values())
+
+
+def render_instances(instances, extra_lines=()):
+    """Prometheus text for ``instances``, one ``# TYPE`` line per
+    family with every engine's samples under it."""
+    fams = {}    # family -> (kind, [lines]); dict preserves order
+    for m in instances:
+        for family, kind, lines in m._families():
+            fams.setdefault(family, (kind, []))[1].extend(lines)
+    out = []
+    for family, (kind, lines) in fams.items():
+        out.append("# TYPE %s %s" % (family, kind))
+        out.extend(lines)
+    out.extend(line.rstrip("\n") for line in extra_lines)
+    return "\n".join(out) + "\n" if out else ""
+
+
+def render_prometheus(extra_lines=()):
+    """All registered engines (plus caller-supplied lines — web_status
+    appends its workflow gauges) in Prometheus text format."""
+    return render_instances(registered(), extra_lines)

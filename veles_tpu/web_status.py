@@ -168,6 +168,38 @@ class WebStatus:
                             e.get("graph_dot", ""))
         return None
 
+    def render_metrics(self):
+        """Prometheus text: serving-engine counters + one gauge set per
+        workflow row (epoch, best metric when numeric, completeness).
+
+        Rows arrive over ``POST /report`` (arbitrary JSON), so every
+        interpolated value is sanitized — label values escaped per the
+        exposition format, sample values emitted only when numeric — or
+        one malformed report would invalidate the whole scrape."""
+        from veles_tpu.serving import metrics as serving_metrics
+
+        def esc(v):     # Prometheus label-value escaping
+            return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+                    .replace("\n", "\\n"))
+
+        def num(v):
+            return v if isinstance(v, (int, float)) \
+                and not isinstance(v, bool) else None
+
+        lines = []
+        for name, e in sorted(self.snapshot().items()):
+            label = '{workflow="%s",process="%s"}' % (
+                esc(e.get("workflow", name)), esc(e.get("process", 0)))
+            if num(e.get("epoch")) is not None:
+                lines.append("veles_workflow_epoch%s %g"
+                             % (label, e["epoch"]))
+            if num(e.get("best")) is not None:
+                lines.append("veles_workflow_best_metric%s %g"
+                             % (label, e["best"]))
+            lines.append("veles_workflow_complete%s %d"
+                         % (label, 1 if e.get("complete") else 0))
+        return serving_metrics.render_prometheus(lines)
+
     # ---------------------------------------------------------------- server
     def start(self, host="127.0.0.1", port=0):
         status = self
@@ -178,6 +210,13 @@ class WebStatus:
                     body = json.dumps(status.snapshot(),
                                       default=str).encode()
                     ctype = "application/json"
+                elif self.path.rstrip("/") == "/metrics":
+                    # one scrape surface for everything: the serving
+                    # engines' counters (veles_tpu.serving.metrics
+                    # registry) plus this dashboard's workflow rows as
+                    # gauges — dashboards and Prometheus share a source
+                    body = status.render_metrics().encode()
+                    ctype = "text/plain; version=0.0.4"
                 elif self.path.startswith("/graph/"):
                     target = self.path[len("/graph/"):]
                     base, _, ext = target.rpartition(".")
