@@ -1467,7 +1467,25 @@ def collect_worker_output(stdout_bytes):
     return got, final is not None
 
 
-def orchestrate(wanted, args, argv):
+class OuterTimeout(BaseException):
+    """Raised by the SIGTERM handler: the DRIVER's outer watchdog fired
+    (round-5 lesson, BENCH_r05.json: a wedged relay burned the whole
+    outer `timeout` budget in probe retries and the bench died with
+    rc 124 and NO JSON line — every already-measured record lost).
+    BaseException so no blanket per-config `except Exception` can eat
+    it on the way out."""
+
+
+def total_deadline():
+    """Monotonic deadline for the WHOLE bench run (VELES_BENCH_TOTAL_S,
+    0 disables): finishing — with partials — BEFORE the driver's outer
+    timeout is the only way to exit 0 with the record intact, because
+    GNU timeout reports 124 regardless of the child's own exit code."""
+    total = float(os.environ.get("VELES_BENCH_TOTAL_S", 1680))
+    return (time.monotonic() + total) if total > 0 else None
+
+
+def orchestrate(wanted, args, argv, results=None, deadline=None):
     """Run each config in its own subprocess under a hard deadline.
 
     Round-4 lesson: a tunnel that dies MID-RUN leaves the next XLA compile
@@ -1478,6 +1496,12 @@ def orchestrate(wanted, args, argv):
     one-line contract always holds.  Workers run STRICTLY sequentially
     (the TPU tunnel admits one client at a time) and the parent never
     imports jax (an idle client could hold the tunnel claim).
+
+    ``results`` (when given) is mutated IN PLACE so the caller's SIGTERM
+    handler can emit whatever was measured if the outer watchdog fires
+    mid-config; ``deadline`` (time.monotonic()) bounds the whole run —
+    configs that would start too close to it are recorded as skipped so
+    the summary line still gets out in time.
     """
     import subprocess
     per_config = float(os.environ.get(
@@ -1491,7 +1515,12 @@ def orchestrate(wanted, args, argv):
     # records — when the tunnel is dead, so a dead-tunnel bench degrades
     # to a valid host-side record instead of round-4's empty bench_failed
     host_only = {"records", "native"}
-    results = {}
+    if results is None:
+        results = {}
+
+    def time_left():
+        return (float("inf") if deadline is None
+                else deadline - time.monotonic())
     tunnel_dead = False
 
     def probe_ok():
@@ -1512,18 +1541,29 @@ def orchestrate(wanted, args, argv):
             return False
 
     for name in wanted:
+        if time_left() < 60:
+            # too close to the driver's outer watchdog to start another
+            # config: record the skip and keep going (cheap) so the
+            # summary emits while we still own the process
+            results[name + "_error"] = (
+                "skipped: total bench deadline reached "
+                "(VELES_BENCH_TOTAL_S) — partial results emitted")
+            continue
         if tunnel_dead and name not in host_only:
             # wait out the relay grant timeout while budget remains —
             # round-5 lesson: one hung config used to forfeit every
-            # remaining device record even though the relay recovers
-            while recover_budget > 0:
+            # remaining device record even though the relay recovers.
+            # The probe-retry loop is ALSO deadline-bounded: r05 died
+            # burning the outer timeout right here, losing the record
+            while recover_budget > 0 and time_left() > 180:
                 begin = time.time()
                 if probe_ok():
                     recover_budget -= time.time() - begin
                     tunnel_dead = False
                     break
                 recover_budget -= time.time() - begin
-                pause = min(120.0, recover_budget)
+                pause = min(120.0, recover_budget, max(time_left() - 180,
+                                                       0))
                 if pause <= 0:
                     break
                 print("[bench] relay wedged; retrying probe in %.0fs "
@@ -1550,9 +1590,13 @@ def orchestrate(wanted, args, argv):
                 # wedged relay would burn its full timeouts — tell the
                 # worker to stop after build+selfcheck+export
                 env["VELES_BENCH_TUNNEL_DEAD"] = "1"
+        # a worker may not outlive the total deadline either — cap its
+        # watchdog so ITS kill (and partial collection) happens while
+        # the parent can still print the summary line
+        worker_timeout = min(per_config, max(time_left() - 60, 30))
         try:
             proc = subprocess.run(cmd, stdout=subprocess.PIPE,
-                                  timeout=per_config, env=env)
+                                  timeout=worker_timeout, env=env)
             got, complete = collect_worker_output(proc.stdout)
             if not got and not complete:
                 got = {name + "_error":
@@ -1566,7 +1610,8 @@ def orchestrate(wanted, args, argv):
             got, _ = collect_worker_output(exc.stdout)  # keep pre-hang records
             results.update(got)
             results[name + "_error"] = ("killed after %.0fs (hung device "
-                                        "dispatch/compile)" % per_config)
+                                        "dispatch/compile)"
+                                        % worker_timeout)
             tunnel_dead = True
         except Exception as exc:   # worker crash / bad output
             results[name + "_error"] = "worker failed: %r" % (exc,)
@@ -1619,14 +1664,48 @@ def main():
         parser.error("unknown configs %r (choose from %s)"
                      % (unknown, ", ".join(sorted(known))))
 
-    # --smoke forces CPU, where a wedged-tunnel hang cannot occur — run in
-    # process and skip paying one python+jax cold start per config
-    if args.in_process or args.smoke:
-        results = run_configs(wanted, args)
-    else:
-        argv = (["--seconds", str(args.seconds)] if args.seconds else [])
-        results = orchestrate(expand_configs(wanted), args, argv)
-    return emit_summary(results)
+    # The driver runs the bench under an outer `timeout`: if the relay
+    # wedge eats the whole budget, TERM arrives here — emit whatever was
+    # measured (the one-line contract) and exit 0 instead of dying
+    # silently with "parsed": null (BENCH_r05.json's failure mode)
+    import signal
+    partial = {}
+
+    def _on_term(signum, frame):
+        raise OuterTimeout()
+
+    try:
+        signal.signal(signal.SIGTERM, _on_term)
+    except ValueError:       # non-main thread (embedded use): skip
+        pass
+
+    try:
+        # --smoke forces CPU, where a wedged-tunnel hang cannot occur —
+        # run in process, skip one python+jax cold start per config
+        if args.in_process or args.smoke:
+            results = run_configs(wanted, args)
+        else:
+            argv = (["--seconds", str(args.seconds)]
+                    if args.seconds else [])
+            results = orchestrate(expand_configs(wanted), args, argv,
+                                  results=partial,
+                                  deadline=total_deadline())
+    except OuterTimeout:
+        partial["bench_error"] = (
+            "terminated by the outer watchdog (SIGTERM) mid-run — "
+            "partial results emitted, exit 0")
+        emit_summary(partial)
+        return 0
+    rc = emit_summary(results)
+    if rc and results and all(
+            isinstance(v, str) and "total bench deadline" in v
+            for v in results.values()):
+        # nothing measured because the deadline landed before ANY config
+        # could start: the wedged-relay partial case, not a bench
+        # failure.  A genuine config failure alongside deadline skips
+        # keeps the nonzero rc
+        return 0
+    return rc
 
 
 if __name__ == "__main__":

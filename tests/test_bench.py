@@ -185,6 +185,43 @@ def test_worker_streams_partials_and_collect_merges():
     assert got["records_pipeline"]["samples_per_sec"] > 0
 
 
+def test_sigterm_emits_partial_json_and_exit_zero():
+    """The driver wraps the bench in an outer `timeout`; when the TPU
+    relay wedge burns that budget, TERM must produce the one JSON line
+    (partial results) and exit 0 — not die mid-probe with rc 124 and
+    nothing parseable (BENCH_r05.json's failure mode)."""
+    import signal
+    import time as time_mod
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    proc = subprocess.Popen(
+        [sys.executable, BENCH, "--configs", "records",
+         "--seconds", "9999"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env,
+        cwd=REPO)
+    time_mod.sleep(5)                    # handler installed; worker busy
+    proc.send_signal(signal.SIGTERM)
+    out, _ = proc.communicate(timeout=60)
+    assert proc.returncode == 0
+    lines = [ln for ln in out.decode().splitlines() if ln.startswith("{")]
+    assert len(lines) == 1, lines
+    rec = json.loads(lines[-1])
+    assert "bench_error" in rec["configs"]
+    assert "partial results" in rec["configs"]["bench_error"]
+
+
+def test_total_deadline_skips_and_exits_zero():
+    """VELES_BENCH_TOTAL_S bounds the whole run: configs that would
+    start past the deadline are recorded as skipped, the summary still
+    emits, and a nothing-measured-because-deadline run exits 0."""
+    rc, lines = _run(["--configs", "records", "--seconds", "9999"],
+                     env_extra={"VELES_BENCH_TOTAL_S": "1"}, timeout=120)
+    assert rc == 0
+    assert len(lines) == 1, lines
+    rec = json.loads(lines[0])
+    assert "total bench deadline" in rec["configs"]["records_error"]
+
+
 def test_dead_tunnel_degrades_to_host_records():
     """A dead tunnel must NOT zero the bench (round-4 failure mode):
     device configs record unreachable-errors, but host-side configs

@@ -468,3 +468,81 @@ class TestRecordsPrefetch:
         loader.run()
         loader.stop()
         loader.stop()                        # no double-shutdown crash
+
+    def test_staged_batch_equals_synchronous_gather(self, tmp_path):
+        """After run() stages the NEXT minibatch, the pending future's
+        payload must equal what a synchronous gather of those indices
+        produces — the double buffer changes timing, never bytes."""
+        loader = self._make(tmp_path, prefetch=True)
+        loader.run()
+        assert loader._pending is not None
+        key, fut = loader._pending
+        staged_batch, staged_labels = fut.result()
+        nxt = loader.local_chunk(loader._order[loader._position][1])
+        assert key == nxt.tobytes()
+        sync_batch, sync_labels = loader._gather(nxt)
+        numpy.testing.assert_array_equal(staged_batch, sync_batch)
+        numpy.testing.assert_array_equal(staged_labels, sync_labels)
+        loader.stop()
+
+    def test_stale_plan_discarded_falls_back_clean(self, tmp_path):
+        """A plan change between staging and consumption (key !=
+        indices.tobytes()) must discard the staged batch and fall back
+        to the synchronous gather for the ACTUAL indices."""
+        loader = self._make(tmp_path, prefetch=True)
+        loader.run()                         # stages minibatch #2
+        assert loader._pending is not None
+        stale_key = loader._pending[0]
+        # shuffle a fresh plan under the staged future (what a snapshot
+        # restore or replan does): position resets, indices change
+        loader._plan_epoch()
+        loader._position = 0
+        loader.run()
+        assert loader.minibatch_indices.mem.tobytes() != stale_key
+        # delivered rows are the fresh plan's rows, gathered cleanly
+        expect, expect_labels = loader._gather(
+            numpy.asarray(loader.minibatch_indices.mem))
+        numpy.testing.assert_array_equal(
+            numpy.asarray(loader.minibatch_data.mem), expect)
+        numpy.testing.assert_array_equal(
+            numpy.asarray(loader.minibatch_labels.mem), expect_labels)
+        loader.stop()
+
+    def test_stop_shuts_pool_without_leaking_pending(self, tmp_path):
+        """stop() must drop the pending future and tear the pool down
+        (no orphan worker thread keeping the memmap alive)."""
+        loader = self._make(tmp_path, prefetch=True)
+        loader.run()
+        assert loader._pending is not None
+        pool = loader._pool
+        loader.stop()
+        assert loader._pending is None
+        assert loader._pool is None
+        assert pool._shutdown
+
+
+def test_lmdb_gather_window_matches_fill(tmp_path):
+    """LMDBLoader.gather_window (streaming epoch-scan staging hook)
+    applies the exact fill_minibatch conversion."""
+    from veles_tpu import prng
+    from veles_tpu.loader.lmdb import LMDBLoader
+    rng = numpy.random.RandomState(8)
+    train = rng.randint(0, 255, (12, 3, 5, 5)).astype(numpy.uint8)
+    valid = rng.randint(0, 255, (6, 3, 5, 5)).astype(numpy.uint8)
+    t_dir = _write_caffe_env(tmp_path / "gw_train", train,
+                             numpy.arange(12) % 4)
+    v_dir = _write_caffe_env(tmp_path / "gw_valid", valid,
+                             numpy.arange(6) % 4)
+    prng.reset(); prng.seed_all(5)
+    loader = LMDBLoader(None, train_path=os.path.dirname(t_dir),
+                        validation_path=os.path.dirname(v_dir),
+                        minibatch_size=6, name="loader")
+    loader.initialize()
+    assert loader.can_gather_windows
+    idx = numpy.asarray([0, 17, 5, 5, 9], numpy.int32)
+    win, win_labels = loader.gather_window(idx)
+    loader.fill_minibatch(idx, len(idx))
+    numpy.testing.assert_array_equal(
+        win, numpy.asarray(loader.minibatch_data.mem)[:len(idx)])
+    numpy.testing.assert_array_equal(
+        win_labels, numpy.asarray(loader.minibatch_labels.mem)[:len(idx)])

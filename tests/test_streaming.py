@@ -1,0 +1,277 @@
+"""Streaming windowed epoch-scan (epoch_driver.py + compiled.window_scan_fn).
+
+Contract under test: an out-of-core (records/LMDB) dataset streamed
+through HBM in device-resident windows — one lax.scan dispatch per
+window, next window staged concurrently — trains the SAME model the
+full-batch epoch-scan and the per-minibatch graph loop train (identical
+plan, no stochastic layers), while cutting dispatches per epoch from
+~minibatches to ~windows.
+"""
+
+import os
+
+import numpy
+import pytest
+
+LAYERS = [
+    {"type": "all2all_tanh", "output_sample_shape": 12,
+     "learning_rate": 0.05, "momentum": 0.9},
+    {"type": "softmax", "output_sample_shape": 5,
+     "learning_rate": 0.05, "momentum": 0.9},
+]
+N_VALID, N_TRAIN, MB = 40, 160, 16
+
+
+def _dataset():
+    rng = numpy.random.RandomState(3)
+    data = rng.normal(0, 1, (N_VALID + N_TRAIN, 8)).astype(numpy.float32)
+    labels = (numpy.arange(N_VALID + N_TRAIN) % 5).astype(numpy.int32)
+    return data, labels
+
+
+def _records_path(tmp_path, data, labels):
+    from veles_tpu.loader.records import write_records
+    return write_records(str(tmp_path / "stream.rec"), data, labels,
+                         [0, N_VALID, N_TRAIN])
+
+
+def _build(loader_factory, loader_cfg, seed=21, max_epochs=4,
+           layers=LAYERS):
+    from veles_tpu import prng
+    from veles_tpu.standard_workflow import StandardWorkflow
+    prng.reset()
+    prng.seed_all(seed)
+    return StandardWorkflow(
+        None, name="stream_test", loader_factory=loader_factory,
+        loader_config=dict(minibatch_size=MB, **loader_cfg),
+        layers=[dict(layer) for layer in layers],
+        decision_config={"max_epochs": max_epochs, "fail_iterations": 10},
+        loss_function="softmax")
+
+
+def _fullbatch_factory(data, labels):
+    from veles_tpu.loader.fullbatch import FullBatchLoader
+
+    class ArrayFullBatch(FullBatchLoader):
+        def load_data(self):
+            self.original_data.reset(data.copy())
+            self.original_labels.reset(labels.copy())
+            self.class_lengths = [0, N_VALID, N_TRAIN]
+
+    return ArrayFullBatch
+
+def _assert_same_training(wf_a, wf_b):
+    assert len(wf_a.decision.epoch_metrics) == \
+        len(wf_b.decision.epoch_metrics)
+    for ma, mb in zip(wf_a.decision.epoch_metrics,
+                      wf_b.decision.epoch_metrics):
+        assert set(ma) == set(mb)
+        for set_name in ma:
+            for key in ("n_err", "count", "loss"):
+                if key in ma[set_name]:
+                    numpy.testing.assert_allclose(
+                        ma[set_name][key], mb[set_name][key], rtol=1e-5,
+                        err_msg="%s/%s" % (set_name, key))
+    for fa, fb in zip(wf_a.forwards, wf_b.forwards):
+        if fa.has_params:
+            numpy.testing.assert_allclose(
+                numpy.asarray(fa.weights.mem),
+                numpy.asarray(fb.weights.mem), rtol=2e-5, atol=2e-6)
+            numpy.testing.assert_allclose(
+                numpy.asarray(fa.bias.mem),
+                numpy.asarray(fb.bias.mem), rtol=2e-5, atol=2e-6)
+
+
+class TestStreamingParity:
+    def test_matches_fullbatch_epoch_scan(self, tmp_path):
+        """Acceptance pin: streaming windowed training on a records
+        dataset == the full-batch epoch-scan path — same final weights,
+        same per-epoch metrics (identical plan, no stochastic layers).
+        Window 3 over 10 train minibatches also exercises the TAIL
+        window (10 = 3+3+3+1)."""
+        from veles_tpu.launcher import Launcher
+        from veles_tpu.loader.records import RecordsLoader
+        data, labels = _dataset()
+
+        wf_a = _build(_fullbatch_factory(data, labels), {})
+        Launcher(wf_a, stats=False, epoch_scan=1).boot()
+
+        rec = _records_path(tmp_path, data, labels)
+        wf_b = _build(RecordsLoader, {"path": rec, "scale_uint8": False})
+        Launcher(wf_b, stats=False, epoch_scan=1, stream_window=3,
+                 stage_ahead=2).boot()
+        assert wf_b.is_finished and bool(wf_b.decision.complete)
+        _assert_same_training(wf_a, wf_b)
+
+    def test_matches_graph_loop(self, tmp_path):
+        """Direct graph-loop parity (covers the completion-gate replay:
+        the stopping epoch's last minibatch update is computed but
+        DISCARDED in graph mode — the streaming driver replays the final
+        window truncated to reproduce it)."""
+        from veles_tpu.launcher import Launcher
+        from veles_tpu.loader.records import RecordsLoader
+        data, labels = _dataset()
+        rec = _records_path(tmp_path, data, labels)
+
+        wf_a = _build(RecordsLoader, {"path": rec, "scale_uint8": False})
+        Launcher(wf_a, stats=False).boot()     # per-minibatch graph loop
+
+        wf_b = _build(RecordsLoader, {"path": rec, "scale_uint8": False})
+        Launcher(wf_b, stats=False, stream_window=4).boot()
+        _assert_same_training(wf_a, wf_b)
+        # the counter parity a resumed lr policy depends on
+        assert wf_a.fused_step.train_steps == wf_b.fused_step.train_steps
+
+    def test_window_size_invariance(self, tmp_path):
+        """Any window size trains the same trajectory (the window split
+        only changes dispatch granularity, never the step sequence)."""
+        from veles_tpu.launcher import Launcher
+        from veles_tpu.loader.records import RecordsLoader
+        data, labels = _dataset()
+        rec = _records_path(tmp_path, data, labels)
+        wf_a = _build(RecordsLoader, {"path": rec, "scale_uint8": False})
+        Launcher(wf_a, stats=False, stream_window=1).boot()
+        wf_b = _build(RecordsLoader, {"path": rec, "scale_uint8": False})
+        Launcher(wf_b, stats=False, stream_window=100).boot()
+        _assert_same_training(wf_a, wf_b)
+
+
+class TestStreamingDriverPlumbing:
+    def test_bare_epoch_scan_streams_records_loader(self, tmp_path):
+        """--epoch-scan alone on an out-of-core loader used to refuse;
+        it now streams with the default window."""
+        from veles_tpu.epoch_driver import (EpochScanDriver,
+                                            DEFAULT_STREAM_WINDOW)
+        from veles_tpu.loader.records import RecordsLoader
+        data, labels = _dataset()
+        rec = _records_path(tmp_path, data, labels)
+        wf = _build(RecordsLoader, {"path": rec, "scale_uint8": False},
+                    max_epochs=2)
+        wf.initialize()
+        driver = EpochScanDriver(wf, chunk=1)
+        assert driver.streaming
+        assert driver.stream_window == DEFAULT_STREAM_WINDOW
+        driver.run()
+        assert wf.is_finished and bool(wf.decision.complete)
+
+    def test_stream_stats_shape(self, tmp_path):
+        """Overlap is measured: windows/dispatches per epoch and the
+        staging-stall fraction land on the workflow for print_stats and
+        the /metrics gauges."""
+        from veles_tpu.launcher import Launcher
+        from veles_tpu.loader.records import RecordsLoader
+        data, labels = _dataset()
+        rec = _records_path(tmp_path, data, labels)
+        wf = _build(RecordsLoader, {"path": rec, "scale_uint8": False},
+                    max_epochs=3)
+        Launcher(wf, stats=False, stream_window=5).boot()
+        stats = wf._stream_stats
+        epochs = stats["epochs"]
+        assert epochs == len(wf.decision.epoch_metrics)
+        # 10 train minibatches, window 5 -> 2 windows/epoch; dispatches =
+        # windows + 1 valid eval per epoch + 1 completion replay
+        assert stats["windows"] == 2 * epochs
+        assert stats["dispatches"] == stats["windows"] + epochs + 1
+        assert 0.0 <= stats["staging_stall_fraction"] <= 1.0
+        assert stats["samples_per_sec"] > 0
+        assert stats["train_samples"] == N_TRAIN * epochs
+        wf.print_stats()          # streaming lines must render
+
+    def test_stream_window_needs_capable_loader(self):
+        """A loader without a random-access backing store cannot
+        stream — clear error instead of a silent graph-loop fallback."""
+        from veles_tpu.epoch_driver import EpochScanDriver
+        from veles_tpu.loader.base import Loader
+
+        class NoWindowLoader(Loader):
+            def load_data(self):
+                self.class_lengths = [0, 8, 16]
+
+            def create_minibatch_data(self):
+                self.minibatch_data.reset(
+                    numpy.zeros((self.max_minibatch_size, 8),
+                                numpy.float32))
+                self.minibatch_labels.reset(
+                    numpy.zeros(self.max_minibatch_size, numpy.int32))
+
+            def fill_minibatch(self, indices, actual_size):
+                self.minibatch_data.reset(
+                    numpy.zeros((len(indices), 8), numpy.float32))
+                self.minibatch_labels.reset(
+                    numpy.zeros(len(indices), numpy.int32))
+
+        wf = _build(NoWindowLoader, {})
+        wf.initialize()
+        assert not wf.loader.can_gather_windows
+        with pytest.raises(ValueError, match="stream-window"):
+            EpochScanDriver(wf, stream_window=4)
+        # and bare --epoch-scan still refuses it with the guidance error
+        with pytest.raises(ValueError, match="full-batch"):
+            EpochScanDriver(wf)
+
+    def test_dropout_network_streams_and_completes(self, tmp_path):
+        """Stochastic layers ride the streaming path (scan-path dropout
+        keys — the documented epoch-scan divergence): the run completes
+        and trains."""
+        from veles_tpu.launcher import Launcher
+        from veles_tpu.loader.records import RecordsLoader
+        data, labels = _dataset()
+        rec = _records_path(tmp_path, data, labels)
+        layers = [dict(LAYERS[0]),
+                  {"type": "dropout", "dropout_ratio": 0.2},
+                  dict(LAYERS[1])]
+        wf = _build(RecordsLoader, {"path": rec, "scale_uint8": False},
+                    max_epochs=3, layers=layers)
+        Launcher(wf, stats=False, stream_window=4).boot()
+        assert wf.is_finished and bool(wf.decision.complete)
+        assert len(wf.decision.epoch_metrics) == 3
+
+
+class TestGatherWindow:
+    def test_records_gather_window_matches_fill(self, tmp_path):
+        from veles_tpu.loader.records import RecordsLoader, write_records
+        rng = numpy.random.RandomState(5)
+        data = rng.randint(0, 256, (60, 4, 4, 3)).astype(numpy.uint8)
+        labels = (numpy.arange(60) % 7).astype(numpy.int32)
+        path = write_records(str(tmp_path / "g.rec"), data, labels,
+                             [0, 20, 40])
+        loader = RecordsLoader(None, path=path, minibatch_size=10,
+                               name="loader")
+        loader.initialize()
+        idx = numpy.asarray([3, 59, 17, 17, 0], numpy.int32)
+        win, win_labels = loader.gather_window(idx)
+        loader.fill_minibatch(idx, len(idx))
+        numpy.testing.assert_array_equal(
+            win, numpy.asarray(loader.minibatch_data.mem)[:len(idx)])
+        numpy.testing.assert_array_equal(
+            win_labels,
+            numpy.asarray(loader.minibatch_labels.mem)[:len(idx)])
+
+    def test_capability_flags(self, tmp_path):
+        from veles_tpu.loader.base import Loader
+        from veles_tpu.loader.records import RecordsLoader
+        from veles_tpu.loader.stream import StreamLoaderBase
+        assert RecordsLoader(None, path="x", name="l").can_gather_windows
+        assert not StreamLoaderBase(None, name="s").can_gather_windows
+        with pytest.raises(NotImplementedError):
+            Loader.gather_window(
+                StreamLoaderBase(None, name="s2"),
+                numpy.arange(3))
+
+
+def test_metrics_gauges_render_stream_stats():
+    """The /metrics scrape carries the streaming gauges once a workflow
+    row holds stream stats (fed by StatusReporter from
+    wf._stream_stats)."""
+    from veles_tpu.web_status import WebStatus
+    status = WebStatus()
+    status.update("wf_row", workflow="stream_test", process=0, epoch=2,
+                  complete=False,
+                  stream={"samples_per_sec": 123.5,
+                          "staging_stall_fraction": 0.25,
+                          "windows": 8, "dispatches": 11})
+    text = status.render_metrics()
+    assert 'veles_stream_samples_per_sec{workflow="stream_test"' in text
+    assert "123.5" in text
+    assert "veles_stream_staging_stall_fraction" in text
+    assert "veles_stream_dispatches_total" in text

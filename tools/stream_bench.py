@@ -1,0 +1,175 @@
+"""Micro-harness: graph-loop vs streaming windowed epoch-scan.
+
+Trains the SAME tiny model over the SAME synthetic records dataset two
+ways — the per-minibatch graph loop (one device dispatch per minibatch)
+and the streaming windowed epoch-scan driver (``--stream-window``: one
+dispatch per window, next window staged concurrently) — and prints one
+JSON line with the evidence the streaming path (ISSUE 3) claims:
+
+- ``dispatches_per_epoch`` drops from ~minibatches to ~windows,
+- ``staging_stall_pct`` (time the device waited on the host) stays low
+  when staging overlaps compute,
+- ``windows_per_sec`` / ``samples_per_sec`` for throughput comparison.
+
+Standalone::
+
+    python tools/stream_bench.py [--samples 4096] [--minibatch 64] \
+        [--window 8] [--stage-ahead 1] [--epochs 3]
+
+Importable: :func:`run_stream_bench` is used by the slow-marked test in
+``tests/test_streaming_bench.py``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+# run as a script, tools/ is on sys.path but the repo root (veles_tpu/)
+# is not — the convergence.py convention
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def _build_workflow(rec_path, minibatch, max_epochs, seed=17):
+    from veles_tpu import prng
+    from veles_tpu.loader.records import RecordsLoader
+    from veles_tpu.standard_workflow import StandardWorkflow
+    prng.reset()
+    prng.seed_all(seed)
+    return StandardWorkflow(
+        None, name="stream_bench",
+        loader_factory=RecordsLoader,
+        loader_config={"path": rec_path, "minibatch_size": minibatch,
+                       "scale_uint8": False},
+        layers=[
+            {"type": "all2all_tanh", "output_sample_shape": 32,
+             "learning_rate": 0.02, "momentum": 0.9},
+            {"type": "softmax", "output_sample_shape": 10,
+             "learning_rate": 0.02, "momentum": 0.9},
+        ],
+        decision_config={"max_epochs": max_epochs,
+                         "fail_iterations": max_epochs + 1},
+        loss_function="softmax")
+
+
+def make_dataset(path, samples=4096, features=64):
+    """Synthetic records file: ``samples`` rows of ``features`` floats,
+    10 classes, 1/8 of the rows as the validation split."""
+    import numpy
+    from veles_tpu.loader.records import write_records
+    rng = numpy.random.RandomState(5)
+    data = rng.normal(0, 1, (samples, features)).astype(numpy.float32)
+    labels = (numpy.arange(samples) % 10).astype(numpy.int32)
+    n_valid = samples // 8
+    return write_records(path, data, labels,
+                         [0, n_valid, samples - n_valid])
+
+
+def run_stream_bench(samples=4096, minibatch=64, window=8, stage_ahead=1,
+                     epochs=3, rec_path=None):
+    """Returns the comparison record (also the one JSON line printed by
+    the CLI): graph-loop vs streaming timings over identical work."""
+    tmp = None
+    if rec_path is None:
+        tmp = tempfile.mkdtemp(prefix="stream_bench_")
+        rec_path = make_dataset(os.path.join(tmp, "bench.rec"),
+                                samples=samples)
+    try:
+        return _run_stream_bench(samples, minibatch, window, stage_ahead,
+                                 epochs, rec_path)
+    finally:
+        if tmp is not None:
+            import shutil
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _run_stream_bench(samples, minibatch, window, stage_ahead, epochs,
+                      rec_path):
+    from veles_tpu.launcher import Launcher
+    n_valid = samples // 8
+    train_minibatches = -(-(samples - n_valid) // minibatch)
+
+    # graph loop: one fused dispatch per minibatch (train + eval sets)
+    wf_graph = _build_workflow(rec_path, minibatch, epochs)
+    begin = time.perf_counter()
+    Launcher(wf_graph, stats=False).boot()
+    graph_seconds = time.perf_counter() - begin
+    graph_epochs = len(wf_graph.decision.epoch_metrics)
+    graph_dispatches = wf_graph.fused_step.run_count
+
+    # streaming windowed epoch-scan: one dispatch per window
+    wf_stream = _build_workflow(rec_path, minibatch, epochs)
+    begin = time.perf_counter()
+    Launcher(wf_stream, stats=False, stream_window=window,
+             stage_ahead=stage_ahead).boot()
+    stream_seconds = time.perf_counter() - begin
+    stats = wf_stream._stream_stats
+
+    record = {
+        "samples": samples,
+        "minibatch": minibatch,
+        "window_minibatches": window,
+        "stage_ahead": stage_ahead,
+        "epochs": stats["epochs"],
+        "train_minibatches_per_epoch": train_minibatches,
+        "graph_loop": {
+            "seconds": round(graph_seconds, 4),
+            "dispatches_per_epoch": (graph_dispatches
+                                     / max(graph_epochs, 1)),
+            "samples_per_sec": ((samples - n_valid) * graph_epochs
+                                / graph_seconds),
+        },
+        "streaming": {
+            "seconds": round(stream_seconds, 4),
+            "dispatches_per_epoch": (stats["dispatches"]
+                                     / max(stats["epochs"], 1)),
+            "windows_per_epoch": (stats["windows"]
+                                  / max(stats["epochs"], 1)),
+            "windows_per_sec": (stats["windows"]
+                                / max(stats["compute_s"]
+                                      + stats["staging_stall_s"], 1e-9)),
+            "samples_per_sec": stats["samples_per_sec"],
+            "staging_stall_pct": round(
+                100.0 * stats["staging_stall_fraction"], 2),
+        },
+        "dispatch_reduction": (graph_dispatches / max(graph_epochs, 1))
+        / max(stats["dispatches"] / max(stats["epochs"], 1), 1e-9),
+    }
+    # identical work check: both trained the same number of epochs
+    record["parity"] = {
+        "epochs_equal": graph_epochs == stats["epochs"],
+        "final_train_loss_graph": float(
+            wf_graph.decision.epoch_metrics[-1]["train"]["loss"]),
+        "final_train_loss_stream": float(
+            wf_stream.decision.epoch_metrics[-1]["train"]["loss"]),
+    }
+    return record
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--samples", type=int, default=4096)
+    parser.add_argument("--minibatch", type=int, default=64)
+    parser.add_argument("--window", type=int, default=8)
+    parser.add_argument("--stage-ahead", type=int, default=1)
+    parser.add_argument("--epochs", type=int, default=3)
+    parser.add_argument("--records", default=None,
+                        help="reuse an existing records file instead of "
+                             "synthesizing one")
+    args = parser.parse_args(argv)
+    record = run_stream_bench(
+        samples=args.samples, minibatch=args.minibatch,
+        window=args.window, stage_ahead=args.stage_ahead,
+        epochs=args.epochs, rec_path=args.records)
+    print(json.dumps(record))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -56,6 +56,21 @@ def build_argparser():
                              "identical decision/metrics semantics, "
                              "snapshot granularity = CHUNK epochs — the "
                              "fast path when dispatch latency is high")
+    parser.add_argument("--stream-window", type=int, default=0,
+                        metavar="MINIBATCHES",
+                        help="stream the dataset through device memory "
+                             "in windows of this many minibatches: each "
+                             "window's minibatches run as ONE device "
+                             "program while a host thread stages the "
+                             "next window (out-of-core epoch-scan for "
+                             "RecordsLoader/LMDB datasets; implies "
+                             "--epoch-scan)")
+    parser.add_argument("--stage-ahead", type=int, default=1,
+                        metavar="N",
+                        help="with --stream-window: windows staged "
+                             "ahead of the device (default 1 = classic "
+                             "double buffering; more overlaps deeper at "
+                             "N+1 windows of HBM)")
     parser.add_argument("--no-fused", action="store_true",
                         help="run the unit graph without the fused "
                              "compiled step (debugging)")
@@ -184,7 +199,15 @@ def exec_config_file(path):
 
 def main(argv=None):
     parser = build_argparser()
-    args = parser.parse_args(argv)
+    # this image's argparse (3.10) cannot allocate positionals that
+    # TRAIL optionals to the `overrides` nargs="*" slot ("prog wf
+    # --flag x root.a.b=1" dies with "unrecognized arguments"):
+    # collect override-shaped leftovers ourselves, reject the rest
+    args, extra = parser.parse_known_args(argv)
+    bad = [t for t in extra if t.startswith("-") or "=" not in t]
+    if bad:
+        parser.error("unrecognized arguments: %s" % " ".join(bad))
+    args.overrides = list(args.overrides) + extra
 
     if args.device:
         # must win before the first jax import; a sitecustomize may force a
@@ -304,7 +327,9 @@ def main(argv=None):
             coordinator_address=args.coordinator_address,
             num_processes=args.num_processes, process_id=args.process_id,
             stats=not args.no_stats, profile=args.profile,
-            evaluate=args.evaluate, epoch_scan=args.epoch_scan)
+            evaluate=args.evaluate, epoch_scan=args.epoch_scan,
+            stream_window=args.stream_window,
+            stage_ahead=args.stage_ahead)
         holder["launcher"] = launcher
         launcher.boot()
 

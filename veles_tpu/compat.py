@@ -1,0 +1,26 @@
+"""JAX version compatibility shims.
+
+One import site per moved/renamed API, so version drift is absorbed here
+instead of at every caller.  Nothing in this module imports jax at module
+load time — callers stay lazy, matching the repo-wide convention.
+"""
+
+from __future__ import annotations
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma=None):
+    """``jax.shard_map`` (jax >= 0.5) or the ``jax.experimental``
+    fallback (jax 0.4.x, where the replication-check kwarg is named
+    ``check_rep`` instead of ``check_vma``).  Pass ``check_vma`` in the
+    NEW spelling; None leaves the backend default in place."""
+    kwargs = {}
+    try:
+        from jax import shard_map as _shard_map
+        if check_vma is not None:
+            kwargs["check_vma"] = check_vma
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _shard_map
+        if check_vma is not None:
+            kwargs["check_rep"] = check_vma
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kwargs)

@@ -441,6 +441,37 @@ class FusedRunner:
             cache[(k, eval_first, donate)] = chunk
         return cache[(k, eval_first, donate)]
 
+    def window_scan_fn(self):
+        """Jitted ``(state, data, labels, idx, mask[, rng, step0]) ->
+        (state, window metric totals)``: ALL of a WINDOW's minibatches as
+        one ``lax.scan`` device program over window-resident data —
+        ``_epoch_train`` (and therefore ``_step_fn``) reused verbatim
+        with ``idx`` indexing INTO the window arrays, so fused/graph
+        numerics parity is preserved by construction.  This is the
+        streaming epoch-scan inner program (see epoch_driver.py): the
+        dataset streams through HBM one window at a time while the host
+        stages the next window concurrently.
+
+        Non-donating: the streaming driver keeps the final window's
+        input state alive so a Decision completion can be replayed with
+        the last minibatch's update discarded (graph-loop parity, same
+        artifact the chunk driver reproduces).  Compiled once per
+        distinct window geometry — a uniform window size plus one tail
+        window means at most two traces per run."""
+        import jax
+        if not hasattr(self, "_window_scan_jit"):
+            inner = jax.jit(self._epoch_train)
+
+            def window_scan(state, data, labels, idx, mask, rng=None,
+                            step0=0):
+                import jax.numpy as jnp
+                self.require_epoch_rng(rng)
+                return inner(state, data, labels, idx, mask, rng,
+                             jnp.asarray(step0, jnp.int32))
+
+            self._window_scan_jit = window_scan
+        return self._window_scan_jit
+
     def require_epoch_rng(self, rng):
         """Stochastic layers (dropout) need an explicit epoch rng — shared
         guard for the single-chip and SPMD epoch-scan entry points."""

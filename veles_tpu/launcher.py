@@ -41,13 +41,23 @@ class Launcher(Logger):
     def __init__(self, workflow, snapshot=None, distributed=False,
                  coordinator_address=None, num_processes=None,
                  process_id=None, stats=True, profile=None,
-                 evaluate=False, epoch_scan=0):
+                 evaluate=False, epoch_scan=0, stream_window=0,
+                 stage_ahead=1):
         self.workflow = workflow
         self.snapshot = snapshot
         #: > 0: train via the epoch-scan driver (k-epoch chunks as one
         #: device program each) instead of the per-minibatch graph loop —
         #: see veles_tpu/epoch_driver.py for the exact semantics
         self.epoch_scan = int(epoch_scan or 0)
+        #: > 0: stream the dataset through HBM in windows of this many
+        #: minibatches (one scan dispatch per window, the next window
+        #: staged concurrently) — the epoch-scan driver's out-of-core
+        #: mode; implies epoch_scan when set alone
+        self.stream_window = int(stream_window or 0)
+        if self.stream_window and not self.epoch_scan:
+            self.epoch_scan = 1
+        #: windows staged ahead of the device (staging thread pool size)
+        self.stage_ahead = int(stage_ahead or 1)
         #: evaluation-only run (SURVEY §3.3 "resume/EVALUATE from
         #: snapshot"): one pass over every dataset split with ALL weight
         #: updates gated off — metrics come out, parameters don't move
@@ -153,7 +163,9 @@ class Launcher(Logger):
         runner = None
         if self.epoch_scan:
             from veles_tpu.epoch_driver import EpochScanDriver
-            driver = EpochScanDriver(wf, chunk=self.epoch_scan)
+            driver = EpochScanDriver(wf, chunk=self.epoch_scan,
+                                     stream_window=self.stream_window,
+                                     stage_ahead=self.stage_ahead)
             runner = driver.run
         begin = time.perf_counter()
         if self.profile:

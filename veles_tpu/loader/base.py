@@ -70,6 +70,31 @@ class Loader(Unit):
         """Fill minibatch Vectors for the given global sample indices."""
         raise NotImplementedError
 
+    # -- window gather (streaming epoch-scan) --------------------------------
+    def gather_window(self, indices):
+        """``(data float32 (len(indices), ...), labels int32 or None)``
+        for a FLAT vector of global sample indices — the staging hook of
+        the streaming windowed epoch-scan (epoch_driver.py): a window's
+        worth of samples is gathered host-side (and uploaded once) while
+        the device trains the previous window.  Must apply the SAME
+        conversion/normalization ``fill_minibatch`` applies, so the
+        windowed path is numerically the per-minibatch path.
+
+        Subclasses with random-access backing stores override this;
+        the base loader has no storage to gather from."""
+        raise NotImplementedError(
+            "%s cannot gather sample windows — the streaming epoch-scan "
+            "needs a loader with a random-access backing store "
+            "(RecordsLoader, LMDBLoader, any FullBatchLoader)"
+            % type(self).__name__)
+
+    @property
+    def can_gather_windows(self):
+        """True when this loader implements :meth:`gather_window` (the
+        capability gate the epoch-scan driver checks before choosing the
+        streaming path)."""
+        return type(self).gather_window is not Loader.gather_window
+
     # -- sharding (multi-host DP) -------------------------------------------
     def shard(self, process_index, process_count):
         """Restrict this loader to a strided shard of every set.

@@ -94,3 +94,15 @@ class FullBatchLoader(Loader):
         if self.has_labels:
             self.minibatch_labels.assign_device(
                 jnp.take(self.original_labels.devmem, idx, axis=0))
+
+    def gather_window(self, indices):
+        """Streaming epoch-scan staging hook.  A full-batch loader never
+        NEEDS windows (the dataset is already HBM-resident), but serving
+        the API keeps ``--stream-window`` runnable on every sample and
+        gives the parity tests an apples-to-apples reference."""
+        data = numpy.asarray(self.original_data.mem)[indices].astype(
+            numpy.float32)
+        labels = (numpy.ascontiguousarray(
+            numpy.asarray(self.original_labels.mem)[indices], numpy.int32)
+            if self.has_labels else None)
+        return data, labels

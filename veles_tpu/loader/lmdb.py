@@ -229,3 +229,10 @@ class LMDBLoader(Loader):
         batch = self._data[indices].astype(numpy.float32) / 127.5 - 1.0
         self.minibatch_data.reset(batch)
         self.minibatch_labels.reset(self._labels[indices])
+
+    def gather_window(self, indices):
+        """Streaming epoch-scan staging hook: identical conversion to
+        :meth:`fill_minibatch`, a window of rows at a time."""
+        batch = self._data[indices].astype(numpy.float32) / 127.5 - 1.0
+        return batch, numpy.ascontiguousarray(self._labels[indices],
+                                              numpy.int32)

@@ -125,6 +125,12 @@ class RecordsLoader(Loader):
                   if self.has_labels else None)
         return batch, labels
 
+    def gather_window(self, indices):
+        """Window-sized gather straight off the mapped pages — the
+        streaming epoch-scan staging hook (same fused gather+convert as
+        the per-minibatch path, so numerics match exactly)."""
+        return self._gather(numpy.ascontiguousarray(indices, numpy.int32))
+
     def fill_minibatch(self, indices, actual_size):
         batch = labels = None
         if self.prefetch:

@@ -105,7 +105,7 @@ def moe_ffn_ep(params, x, mesh, expert_axis="expert"):
     Numerically equals :func:`moe_ffn`.
     """
     from jax.sharding import NamedSharding, PartitionSpec as P
-    from jax import shard_map
+    from veles_tpu.compat import shard_map
 
     n = mesh.shape[expert_axis]
     n_experts = params["w1"].shape[0]

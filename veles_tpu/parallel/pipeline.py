@@ -68,7 +68,7 @@ def pipeline_blocks(stacked_blocks, h, mesh, n_heads, n_microbatches,
     ``stacked_blocks`` must divide by the stage count.
     """
     from jax.sharding import NamedSharding, PartitionSpec as P
-    from jax import shard_map
+    from veles_tpu.compat import shard_map
 
     n_stages = mesh.shape["stage"]
     n_layers = jax.tree.leaves(stacked_blocks)[0].shape[0]

@@ -116,7 +116,7 @@ def ring_attention(q, k, v, mesh, causal=True, seq_axis="seq",
     O(s_local + W) keys per query block rather than O(seq).
     """
     from jax.sharding import NamedSharding, PartitionSpec as P
-    from jax import shard_map
+    from veles_tpu.compat import shard_map
 
     if window and not causal:
         raise ValueError("window requires causal=True")

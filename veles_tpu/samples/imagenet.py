@@ -93,6 +93,16 @@ class ImagenetRecordsLoader(RecordsLoader):
             self.minibatch_data.reset(native.subtract_mean(
                 self.minibatch_data.mem, self._mean))
 
+    def gather_window(self, indices):
+        # the streaming epoch-scan stages through this hook: the window
+        # must see the SAME mean-subtracted pixels the per-minibatch
+        # path feeds, or --stream-window would silently change the model
+        batch, labels = super().gather_window(indices)
+        if self._mean is not None:
+            from veles_tpu import native
+            batch = native.subtract_mean(batch, self._mean)
+        return batch, labels
+
 
 class ImagenetSyntheticLoader(FullBatchLoader):
     """Synthetic ImageNet-shaped stand-in (stream "imagenet_synth") so the

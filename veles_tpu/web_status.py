@@ -198,6 +198,20 @@ class WebStatus:
                              % (label, e["best"]))
             lines.append("veles_workflow_complete%s %d"
                          % (label, 1 if e.get("complete") else 0))
+            stream = e.get("stream")
+            if isinstance(stream, dict):
+                # streaming windowed epoch-scan health (epoch_driver.py):
+                # is the input pipeline keeping the device fed?
+                for key, gauge in (
+                        ("samples_per_sec",
+                         "veles_stream_samples_per_sec"),
+                        ("staging_stall_fraction",
+                         "veles_stream_staging_stall_fraction"),
+                        ("windows", "veles_stream_windows_total"),
+                        ("dispatches", "veles_stream_dispatches_total")):
+                    if num(stream.get(key)) is not None:
+                        lines.append("%s%s %g"
+                                     % (gauge, label, stream[key]))
         return serving_metrics.render_prometheus(lines)
 
     # ---------------------------------------------------------------- server
@@ -386,6 +400,13 @@ class StatusReporter(Unit):
             best=decision.best_metric,
             complete=bool(decision.complete),
             metrics=metrics)
+        stream = getattr(wf, "_stream_stats", None)
+        if stream:
+            # streaming windowed epoch-scan counters (numbers only —
+            # rows also arrive over POST /report from remote processes)
+            fields["stream"] = {k: v for k, v in stream.items()
+                                if isinstance(v, (int, float))
+                                and not isinstance(v, bool)}
         if not self._graph_pushed:
             nodes, edges = wf.graph_data()
             fields.update(graph_nodes=nodes,

@@ -192,6 +192,22 @@ class Workflow(Unit):
                           step_time * 1e3)
             # release the pinned minibatch (HBM) once measured
             runner._last_train_args = None
+        stream = getattr(self, "_stream_stats", None)
+        if stream:
+            # streaming windowed epoch-scan (epoch_driver.py): did the
+            # host keep the device fed?  stall fraction ~0 = staging
+            # fully hidden behind compute; ~1 = device starved
+            self.info("  streaming: %d windows (%d mb each, stage-ahead "
+                      "%d), %d dispatches / %d epochs",
+                      stream["windows"], stream["window_minibatches"],
+                      stream["stage_ahead"], stream["dispatches"],
+                      stream["epochs"])
+            self.info("  streaming: %.1f samples/s, staging stall "
+                      "%.3fs of %.3fs busy (%.1f%%)",
+                      stream["samples_per_sec"],
+                      stream["staging_stall_s"],
+                      stream["staging_stall_s"] + stream["compute_s"],
+                      100.0 * stream["staging_stall_fraction"])
 
     def graph_data(self):
         """(node_labels, edge_index_pairs) of the unit graph — the one
