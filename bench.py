@@ -1319,8 +1319,11 @@ def run_configs(wanted, args):
     return results
 
 
-def emit_summary(results):
-    """Print the ONE JSON line the driver records; returns the exit code."""
+def summary_record(results):
+    """Build (record, exit_code) for the driver's summary JSON line —
+    the metric-selection priority lives HERE so the final emit and the
+    per-leg partial stream (``orchestrate``) can never disagree on
+    shape."""
     hbm = results.get("alexnet", {})
     rec = results.get("alexnet_records", {})
     if isinstance(rec, dict) and rec.get("samples_per_sec") and \
@@ -1336,77 +1339,77 @@ def emit_summary(results):
         headline_name = ("mnist_fc" if "mnist_fc" in results
                          else model_results[0])
         headline = results[headline_name]
-        print(json.dumps({
+        return {
             "metric": "%s_train_samples_per_sec_per_chip" % headline_name,
             "value": headline["samples_per_sec"],
             "unit": "samples/sec",
             "vs_baseline": headline.get("vs_numpy_floor"),
             "configs": results,
-        }))
-    elif "sgd_update" in results:   # aux-only invocation
-        print(json.dumps({
+        }, 0
+    if "sgd_update" in results:   # aux-only invocation
+        return {
             "metric": "sgd_update_device_us",
             "value": results["sgd_update"].get("xla_us"),
             "unit": "us",
             "vs_baseline": None,
             "configs": results,
-        }))
-    elif "lrn_fwd_bwd" in results:
-        print(json.dumps({
+        }, 0
+    if "lrn_fwd_bwd" in results:
+        return {
             "metric": "lrn_fwd_bwd_device_us",
             "value": results["lrn_fwd_bwd"].get("xla_us"),
             "unit": "us",
             "vs_baseline": None,
             "configs": results,
-        }))
-    elif "records_pipeline" in results:
+        }, 0
+    if "records_pipeline" in results:
         # preferred over native_runner: always carries a real value
         # (the native record may be selfcheck-only on a dead tunnel)
-        print(json.dumps({
+        return {
             "metric": "records_pipeline_samples_per_sec",
             "value": results["records_pipeline"]["samples_per_sec"],
             "unit": "samples/sec",
             "vs_baseline": None,
             "configs": results,
-        }))
-    elif "native_runner" in results:
-        print(json.dumps({
+        }, 0
+    if "native_runner" in results:
+        return {
             "metric": "native_runner_compile_plus_infer_wall_s",
             "value": results["native_runner"].get(
                 "compile_plus_infer_wall_s"),
             "unit": "s",
             "vs_baseline": None,
             "configs": results,
-        }))
-    elif "char_lm" in results:
-        print(json.dumps({
+        }, 0
+    if "char_lm" in results:
+        return {
             "metric": "char_lm_train_tokens_per_sec",
             "value": results["char_lm"]["tokens_per_sec"],
             "unit": "tokens/sec",
             "vs_baseline": None,
             "configs": results,
-        }))
-    elif results.get("dp_scaling", {}).get("scaling_efficiency") \
+        }, 0
+    if results.get("dp_scaling", {}).get("scaling_efficiency") \
             is not None:
-        print(json.dumps({
+        return {
             "metric": "dp_scaling_efficiency",
             "value": results["dp_scaling"].get("scaling_efficiency"),
             "unit": "fraction",
             "vs_baseline": None,
             "configs": results,
-        }))
-    elif "skipped" in results.get("dp_scaling", {}):
+        }, 0
+    if "skipped" in results.get("dp_scaling", {}):
         # a skipped scaling probe on a single-device host is a SUCCESS
         # (the record documents why), not a bench failure
-        print(json.dumps({
+        return {
             "metric": "dp_scaling_skipped",
             "value": None,
             "unit": "",
             "vs_baseline": None,
             "configs": results,
-        }))
-    elif any(k.startswith("convergence_") and isinstance(results[k], dict)
-             for k in results):   # convergence-only invocation
+        }, 0
+    if any(k.startswith("convergence_") and isinstance(results[k], dict)
+           for k in results):   # convergence-only invocation
         keys = [k for k in ("convergence_mnist_fc", "convergence_cifar_conv",
                             "convergence_mnist_ae", "convergence_kohonen")
                 if isinstance(results.get(k), dict)]
@@ -1424,23 +1427,29 @@ def emit_summary(results):
         if key is None:   # convergence dicts with no known metric key
             key, suffix = keys[0], "record"
             value = None
-        print(json.dumps({
+        return {
             "metric": "%s_%s" % (key, suffix),
             "value": value,
             "unit": unit,
             "vs_baseline": None,
             "configs": results,
-        }))
-    else:   # everything failed: still emit the one JSON line with errors
-        print(json.dumps({
-            "metric": "bench_failed",
-            "value": None,
-            "unit": "",
-            "vs_baseline": None,
-            "configs": results,
-        }))
-        return 1
-    return 0
+        }, 0
+    # everything failed: still emit the one JSON line with errors
+    return {
+        "metric": "bench_failed",
+        "value": None,
+        "unit": "",
+        "vs_baseline": None,
+        "configs": results,
+    }, 1
+
+
+def emit_summary(results):
+    """Print the FINAL summary JSON line the driver records (the last
+    parseable line of stdout wins); returns the exit code."""
+    rec, code = summary_record(results)
+    print(json.dumps(rec), flush=True)
+    return code
 
 
 def collect_worker_output(stdout_bytes):
@@ -1518,6 +1527,17 @@ def orchestrate(wanted, args, argv, results=None, deadline=None):
     if results is None:
         results = {}
 
+    def stream_summary():
+        """One full summary line after EVERY completed leg — not only on
+        SIGTERM.  BENCH_r04/r05 lesson: `timeout -k` follows TERM with
+        KILL, and a KILLed process runs no handler — rc 124 landed with
+        "parsed": null even though legs had finished.  The driver takes
+        the LAST parseable stdout line, so streaming the running record
+        here means any kill, however rude, still leaves every completed
+        leg in the output JSON."""
+        rec, _ = summary_record(results)
+        print(json.dumps(rec), flush=True)
+
     def time_left():
         return (float("inf") if deadline is None
                 else deadline - time.monotonic())
@@ -1548,6 +1568,7 @@ def orchestrate(wanted, args, argv, results=None, deadline=None):
             results[name + "_error"] = (
                 "skipped: total bench deadline reached "
                 "(VELES_BENCH_TOTAL_S) — partial results emitted")
+            stream_summary()
             continue
         if tunnel_dead and name not in host_only:
             # wait out the relay grant timeout while budget remains —
@@ -1575,6 +1596,7 @@ def orchestrate(wanted, args, argv, results=None, deadline=None):
         if tunnel_dead and name not in host_only:
             results[name + "_error"] = ("skipped: device unreachable "
                                         "after an earlier config hung")
+            stream_summary()
             continue
         cmd = [sys.executable, os.path.abspath(__file__),
                "--worker", name] + argv
@@ -1615,6 +1637,7 @@ def orchestrate(wanted, args, argv, results=None, deadline=None):
             tunnel_dead = True
         except Exception as exc:   # worker crash / bad output
             results[name + "_error"] = "worker failed: %r" % (exc,)
+        stream_summary()
     return results
 
 

@@ -28,6 +28,21 @@ import jax  # noqa: E402
 if not _tpu_mode:
     jax.config.update("jax_platforms", "cpu")
 
+# Partition-invariant jax.random bits for the WHOLE suite (this jax
+# build defaults the flag off): parity tests compile replicated
+# references and sharded runs in one process, and the two must draw the
+# same dropout/augmentation bits (see veles_tpu.compat
+# ensure_partitionable_rng — make_mesh flips it anyway; setting it here
+# keeps every reference, whatever the test order, on one rng scheme).
+jax.config.update("jax_threefry_partitionable", True)
+
+# NOTE: do NOT arm the persistent jax compile cache here (bench.py's
+# enable_compile_cache trick): this jaxlib's CPU executable
+# deserialization segfaulted mid-suite when a warm .jax_cache was
+# reused across pytest processes.  The tunnel-facing bench keeps the
+# cache (TPU executables serialize fine and the 20-40s conv compiles
+# are what wedge the relay); the CPU test suite stays cold.
+
 import pytest  # noqa: E402
 
 

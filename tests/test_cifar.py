@@ -1,6 +1,7 @@
 """Tier-3 CIFAR conv-stack functional tests (BASELINE config[1] shape)."""
 
 import numpy
+import pytest
 
 from veles_tpu import prng
 from veles_tpu.config import root
@@ -51,6 +52,11 @@ def test_cifar_default_topology_converges():
     assert errs[-1] < 10.0, errs
 
 
+@pytest.mark.slow
+# ~28 s: repeats the fp32 convergence run above under bf16 casts; the
+# bf16 numerics themselves are unit-pinned and the convergence parity
+# is recorded in docs/PERF.md — heavy re-verification rides in the
+# slow suite (tier-1 runs within ~2% of its outer watchdog)
 def test_cifar_default_topology_converges_bf16():
     """Convergence PARITY under bf16 operand casts (the TPU fast path):
     the same sample-default conv stack, seed and data must reach the

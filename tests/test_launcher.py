@@ -10,6 +10,7 @@ import glob
 import os
 
 import numpy
+import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -35,6 +36,10 @@ def _build_tiny_mnist(seed=1, max_epochs=2):
 
 
 class TestLauncherProfile:
+    @pytest.mark.slow
+    # ~22 s of jax-profiler trace capture for an auxiliary diagnostic
+    # flag — rides in the slow suite (tier-1 runs within ~2% of its
+    # outer watchdog)
     def test_profile_writes_trace(self, tmp_path):
         from veles_tpu.launcher import Launcher
         wf = _build_tiny_mnist()

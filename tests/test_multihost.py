@@ -43,8 +43,56 @@ def _worker_env():
     # each worker re-adds its own 4-device flag; strip the conftest's 8
     env["XLA_FLAGS"] = ""
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # match the conftest's suite-wide rng scheme (sharded and
+    # single-process runs must draw identical random bits — see
+    # veles_tpu.compat.ensure_partitionable_rng)
+    env["JAX_THREEFRY_PARTITIONABLE"] = "1"
     env.pop("PALLAS_AXON_POOL_IPS", None)
     return env
+
+
+#: the error this jaxlib's CPU backend raises for any cross-process
+#: collective — the whole multihost suite is hardware-gated on it
+_NO_MULTIPROC = "Multiprocess computations aren't implemented on the CPU"
+
+
+@functools.lru_cache(maxsize=1)
+def _multiproc_skip_reason():
+    """Probe ONCE whether this jaxlib can run cross-process collectives
+    at all (one cheap 2-process broadcast instead of every test paying
+    a full worker pair to rediscover the same missing backend).
+    Returns the skip reason, or None when the backend is capable — any
+    OTHER probe failure also returns None so the real tests surface it
+    with their full diagnostics."""
+    port = _free_port()
+    code = ("import sys, jax\n"
+            "jax.distributed.initialize('127.0.0.1:%d', 2, "
+            "int(sys.argv[1]))\n"
+            "from jax.experimental import multihost_utils\n"
+            "multihost_utils.broadcast_one_to_all(jax.numpy.ones(1))\n"
+            % port)
+    procs = [subprocess.Popen([sys.executable, "-c", code, str(pid)],
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.PIPE, text=True,
+                              env=_worker_env(), cwd=REPO)
+             for pid in range(2)]
+    gated = False
+    try:
+        for p in procs:
+            _, stderr = p.communicate(timeout=120)
+            if p.returncode != 0 and _NO_MULTIPROC in stderr:
+                gated = True
+    except Exception:   # noqa: BLE001 — probe hang/crash: let tests run
+        return None
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    if gated:
+        return ("multi-process collectives unsupported by this jaxlib "
+                "CPU backend")
+    return None
 
 
 def _parse_metrics(stdout):
@@ -56,7 +104,12 @@ def _parse_metrics(stdout):
 
 def _spawn_workers(script, extra_args):
     """Launch 2 coordinated worker processes of ``script``; return their
-    stdouts (asserting rc=0), killing stragglers on the way out."""
+    stdouts (asserting rc=0), killing stragglers on the way out.
+    Hardware-gated environments (no cross-process collectives) skip —
+    explicitly, with the reason — instead of failing."""
+    reason = _multiproc_skip_reason()
+    if reason:
+        pytest.skip(reason)
     port = _free_port()
     procs = [
         subprocess.Popen(
@@ -70,6 +123,13 @@ def _spawn_workers(script, extra_args):
     try:
         for p in procs:
             stdout, stderr = p.communicate(timeout=300)
+            if p.returncode != 0 and _NO_MULTIPROC in stderr:
+                # hardware-gated, not broken: this jaxlib's CPU backend
+                # has no cross-process collectives (they need a TPU/GPU
+                # backend or a gloo-enabled jaxlib build).  Explicit
+                # skip so the suite stays honest on capable platforms.
+                pytest.skip("multi-process collectives unsupported by "
+                            "this jaxlib CPU backend")
             assert p.returncode == 0, (
                 "worker failed rc=%d\nstdout:\n%s\nstderr:\n%s"
                 % (p.returncode, stdout, stderr[-4000:]))

@@ -156,6 +156,9 @@ def test_tp_alexnet_fc_trunk_matches():
         assert not trainer.state[i]["w"].sharding.is_fully_replicated
         assert trainer.state[i]["w"].sharding.shard_shape(
             trainer.state[i]["w"].shape)[-1] == 2048
+    numpy.testing.assert_allclose(
+        float(trainer.fetch(metrics)["loss_sum"]),
+        float(ref_metrics["loss_sum"]), rtol=1e-4)
     assert int(trainer.fetch(metrics)["n_err"]) == int(ref_metrics["n_err"])
     for i, (ref_entry, entry) in enumerate(zip(ref_state, trainer.state)):
         for key in ref_entry:

@@ -33,7 +33,15 @@ def _setup(seed=3):
     return params, tokens, mask
 
 
-@pytest.mark.parametrize("n_stages,n_micro", [(4, 4), (2, 8), (4, 2)])
+# one geometry stays tier-1 (the deepest microbatching); the other two
+# re-verify the same loss/grad parity property at ~19 s apiece — the
+# tier-1 suite runs within ~2% of its outer watchdog, so the redundant
+# geometries ride in the slow suite
+@pytest.mark.parametrize("n_stages,n_micro", [
+    pytest.param(4, 4, marks=pytest.mark.slow),
+    (2, 8),
+    pytest.param(4, 2, marks=pytest.mark.slow),
+])
 def test_pipeline_matches_sequential_loss_and_grads(n_stages, n_micro):
     params, tokens, mask = _setup()
     mesh = make_pipeline_mesh(n_stages)

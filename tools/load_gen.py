@@ -8,14 +8,28 @@ side of the serving subsystem (ISSUE 1): mean dispatch batch size and
 429 behavior come from the server's ``/metrics.json``, client-side
 latency percentiles from here.
 
+LM MODE (ISSUE 4) makes this the ONE closed-loop generator the serving
+and LM benches share: ``--lm`` synthesizes token prompts with a
+configurable length distribution and a SHARED leading prefix across a
+fraction of requests (the system-prompt shape the radix prefix cache
+exists for), posts them as ``{"input": [[tok, ...]], "n_new": N}``
+against ``serve_lm``, and reports per-request generated-token counts
+and token throughput (client-side tokens/s) alongside the latency
+percentiles.  ``tools/lm_bench.py`` imports the same prompt generator
+so benchmark prompts and load-test prompts can never drift.
+
 Standalone::
 
     python tools/load_gen.py --url http://127.0.0.1:8180/predict \
         --payload '{"input": [[0.0, 0.0, 0.0, 0.0]]}' \
         --clients 8 --requests 50 [--qps 100] [--duration 5]
 
-Importable: :func:`run_load` is used by the serving load tests
-(``tests/test_serving.py``).
+    python tools/load_gen.py --url http://127.0.0.1:8180/predict \
+        --lm --lm-vocab 16 --lm-mean-len 48 --lm-shared-frac 0.5 \
+        --lm-n-new 32 --clients 8 --requests 20
+
+Importable: :func:`run_load` / :func:`run_lm_load` /
+:func:`lm_prompts` are used by the serving tests and benches.
 """
 
 from __future__ import annotations
@@ -79,7 +93,7 @@ def run_load(url, payload, clients=8, requests_per_client=20, qps=None,
                 out, code = None, 0
             dt = time.monotonic() - t0
             with lock:
-                results.append((code, dt, out))
+                results.append((code, dt, out, ci, n))
             n += 1
             if interval and dt < interval:
                 time.sleep(interval - dt)
@@ -96,9 +110,9 @@ def run_load(url, payload, clients=8, requests_per_client=20, qps=None,
     wall = time.monotonic() - t_start
 
     by_status = {}
-    for code, _, _ in results:
+    for code, _, _, _, _ in results:
         by_status[str(code)] = by_status.get(str(code), 0) + 1
-    lats = sorted(dt for code, dt, _ in results if code == 200)
+    lats = sorted(dt for code, dt, _, _, _ in results if code == 200)
     return {
         "url": url,
         "clients": clients,
@@ -114,8 +128,95 @@ def run_load(url, payload, clients=8, requests_per_client=20, qps=None,
             "p99": _percentile(lats, 0.99),
             "max": lats[-1] if lats else 0.0,
         },
-        "responses": [r for _, _, r in results],
+        "responses": [r for _, _, r, _, _ in results],
+        #: per-request facts aligned with ``responses`` — LM mode reads
+        #: these to pair each reply with its generating (client, index)
+        "records": [{"status": code, "latency_s": dt, "client": ci,
+                     "req": n} for code, dt, _, ci, n in results],
     }
+
+
+def lm_prompts(clients, requests_per_client, vocab=16, mean_len=48,
+               shared_frac=0.5, max_len=None, seed=0):
+    """Synthesize the LM serving workload's prompts: lengths drawn from
+    a lognormal around ``mean_len`` (the long-tail shape real prompt
+    traffic has), each prompt = one SHARED prefix of
+    ``int(mean_len * shared_frac)`` tokens (system prompt / few-shot
+    header — what the radix prefix cache deduplicates) + a unique
+    random tail.  Returns {(client, req): [tok, ...]} over the full
+    client/request grid, deterministic in ``seed`` so benches and
+    correctness checks can regenerate the same traffic."""
+    import numpy
+    rng = numpy.random.RandomState(seed)
+    shared_len = max(0, int(mean_len * shared_frac))
+    if max_len is not None:
+        # the cap is a hard promise (servers size their KV cache by
+        # it): the shared prefix must leave room for >= 1 unique tail
+        # token, else every prompt would silently exceed max_len
+        shared_len = min(shared_len, max(0, int(max_len) - 1))
+    cap = int(max_len) if max_len is not None else 4 * mean_len
+    cap = max(cap, shared_len + 1)
+    shared = rng.randint(0, vocab, shared_len).tolist()
+    out = {}
+    for ci in range(clients):
+        for n in range(requests_per_client):
+            length = int(numpy.clip(
+                rng.lognormal(numpy.log(max(mean_len, 2)), 0.35),
+                shared_len + 1, cap))
+            tail = rng.randint(0, vocab, max(1, length - shared_len))
+            out[(ci, n)] = shared + tail.tolist()
+    return out
+
+
+def run_lm_load(url, clients=8, requests_per_client=20, vocab=16,
+                mean_len=48, shared_frac=0.5, n_new=32, max_len=None,
+                qps=None, duration=None, timeout=30.0, seed=0):
+    """Closed-loop LM load: :func:`lm_prompts` traffic against a
+    ``serve_lm`` endpoint, with per-request token accounting on top of
+    :func:`run_load`'s latency summary — generated-token counts per
+    request ("token streaming" viewed from the client), aggregate
+    tokens/s, and TTFT-proxy stats (latency / tokens)."""
+    prompts = lm_prompts(clients, requests_per_client, vocab=vocab,
+                         mean_len=mean_len, shared_frac=shared_frac,
+                         max_len=max_len, seed=seed)
+
+    def payload_fn(ci, n):
+        return {"input": [prompts[(ci, n % requests_per_client)]],
+                "n_new": n_new}
+
+    summary = run_load(url, None, clients=clients,
+                       requests_per_client=requests_per_client,
+                       qps=qps, duration=duration, timeout=timeout,
+                       payload_fn=payload_fn)
+    gen_counts, rates = [], []
+    for rec, resp in zip(summary["records"], summary["responses"]):
+        if rec["status"] != 200 or not resp or "tokens" not in resp:
+            continue
+        prompt = prompts[(rec["client"],
+                          rec["req"] % requests_per_client)]
+        generated = len(resp["tokens"][0]) - len(prompt)
+        gen_counts.append(generated)
+        if rec["latency_s"] > 0:
+            rates.append(generated / rec["latency_s"])
+    summary["lm"] = {
+        "vocab": vocab, "mean_len": mean_len,
+        "shared_frac": shared_frac, "n_new": n_new,
+        "shared_prefix_len": max(0, int(mean_len * shared_frac)),
+        "generated_tokens": int(sum(gen_counts)),
+        "tokens_per_sec": (sum(gen_counts) / summary["wall_s"]
+                           if summary["wall_s"] > 0 else 0.0),
+        "per_request_tokens": {
+            "mean": (sum(gen_counts) / len(gen_counts)
+                     if gen_counts else 0.0),
+            "min": min(gen_counts) if gen_counts else 0,
+            "max": max(gen_counts) if gen_counts else 0,
+        },
+        "per_request_tokens_per_sec": {
+            "mean": sum(rates) / len(rates) if rates else 0.0,
+            "p50": _percentile(sorted(rates), 0.50),
+        },
+    }
+    return summary
 
 
 def main(argv=None):
@@ -123,8 +224,9 @@ def main(argv=None):
     parser.add_argument("--url", required=True,
                         help="serving endpoint, e.g. "
                              "http://127.0.0.1:8180/predict")
-    parser.add_argument("--payload", required=True,
-                        help="JSON request body (or @file to read one)")
+    parser.add_argument("--payload", default=None,
+                        help="JSON request body (or @file to read one); "
+                             "required unless --lm")
     parser.add_argument("--clients", type=int, default=8)
     parser.add_argument("--requests", type=int, default=20,
                         metavar="N", help="requests per client")
@@ -136,15 +238,48 @@ def main(argv=None):
                         help="run for a wall-clock window instead of a "
                              "fixed request count")
     parser.add_argument("--timeout", type=float, default=30.0)
+    parser.add_argument("--lm", action="store_true",
+                        help="LM mode: synthesize token prompts "
+                             "(length distribution + shared prefix) "
+                             "against serve_lm and report token "
+                             "throughput")
+    parser.add_argument("--lm-vocab", type=int, default=16)
+    parser.add_argument("--lm-mean-len", type=int, default=48,
+                        metavar="TOKENS",
+                        help="mean prompt length (lognormal tail)")
+    parser.add_argument("--lm-shared-frac", type=float, default=0.5,
+                        metavar="FRAC",
+                        help="fraction of the mean length every prompt "
+                             "shares as a common prefix (system-prompt "
+                             "shape; what the prefix cache dedups)")
+    parser.add_argument("--lm-n-new", type=int, default=32,
+                        metavar="N", help="tokens to generate per request")
+    parser.add_argument("--lm-max-len", type=int, default=None,
+                        metavar="TOKENS", help="prompt length cap")
+    parser.add_argument("--seed", type=int, default=0)
     args = parser.parse_args(argv)
-    raw = args.payload
-    if raw.startswith("@"):
-        with open(raw[1:], encoding="utf-8") as f:
-            raw = f.read()
-    summary = run_load(args.url, json.loads(raw), clients=args.clients,
-                       requests_per_client=args.requests, qps=args.qps,
-                       duration=args.duration, timeout=args.timeout)
+    if args.lm:
+        summary = run_lm_load(
+            args.url, clients=args.clients,
+            requests_per_client=args.requests, vocab=args.lm_vocab,
+            mean_len=args.lm_mean_len, shared_frac=args.lm_shared_frac,
+            n_new=args.lm_n_new, max_len=args.lm_max_len, qps=args.qps,
+            duration=args.duration, timeout=args.timeout,
+            seed=args.seed)
+    else:
+        if args.payload is None:
+            parser.error("--payload is required without --lm")
+        raw = args.payload
+        if raw.startswith("@"):
+            with open(raw[1:], encoding="utf-8") as f:
+                raw = f.read()
+        summary = run_load(args.url, json.loads(raw),
+                           clients=args.clients,
+                           requests_per_client=args.requests,
+                           qps=args.qps, duration=args.duration,
+                           timeout=args.timeout)
     summary.pop("responses")     # bodies are for the tests, not the CLI
+    summary.pop("records")
     json.dump(summary, sys.stdout, indent=2)
     print()
     return 0

@@ -171,6 +171,31 @@ def build_argparser():
                              "to SLOTS prompts concurrently over one "
                              "shared KV cache (continuous batching); "
                              "0 = one prompt batch at a time")
+    parser.add_argument("--serve-prefix-cache", type=int, default=0,
+                        metavar="CHUNKS",
+                        help="with --serve-slots: radix prefix cache "
+                             "over prompt KV, capacity CHUNKS cached "
+                             "chunks (LRU) — requests sharing a system "
+                             "prompt / few-shot header reuse its "
+                             "prefill instead of recomputing it; "
+                             "0 = off")
+    parser.add_argument("--serve-prefill-chunk", type=int, default=0,
+                        metavar="TOKENS",
+                        help="with --serve-slots: run prompt prefill "
+                             "as TOKENS-sized chunks interleaved with "
+                             "decode steps (bounded compile buckets, "
+                             "no head-of-line blocking behind long "
+                             "prompts); 0 = whole-prompt prefill at "
+                             "power-of-two buckets")
+    parser.add_argument("--serve-spec-k", type=int, default=0,
+                        metavar="K",
+                        help="with --serve-slots: prompt-lookup "
+                             "speculative decoding — draft K tokens "
+                             "from the sequence's own n-grams and "
+                             "verify them in one dispatch (multiple "
+                             "tokens/dispatch on repetitive text, "
+                             "output bit-identical to greedy); 0 = "
+                             "one token per dispatch")
     return parser
 
 
@@ -357,7 +382,10 @@ def main(argv=None):
                 hasattr(wf.trainer, "n_heads"):
             # transformer-trainer workflows serve token continuation
             from veles_tpu.restful_api import serve_lm
-            api = serve_lm(wf, port=args.serve, slots=args.serve_slots)
+            api = serve_lm(wf, port=args.serve, slots=args.serve_slots,
+                           prefix_cache=args.serve_prefix_cache,
+                           prefill_chunk=args.serve_prefill_chunk,
+                           spec_k=args.serve_spec_k)
         else:
             api = RESTfulAPI(
                 wf, normalizer=getattr(wf.loader, "normalizer", None))

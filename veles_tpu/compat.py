@@ -8,6 +8,24 @@ load time — callers stay lazy, matching the repo-wide convention.
 from __future__ import annotations
 
 
+def ensure_partitionable_rng():
+    """Force partition-invariant ``jax.random`` bits
+    (``jax_threefry_partitionable``, default-off in jax 0.4.x builds).
+
+    The parallel subsystem's contract is "sharding changes the wiring,
+    not the math" — but with the legacy threefry lowering, the SAME key
+    yields DIFFERENT random bits depending on how the consuming
+    computation is sharded, so a sharded run's dropout/augmentation
+    masks silently diverge from the replicated run it is supposed to
+    reproduce (observed: 4% loss drift on the TP AlexNet parity test).
+    Every mesh/trainer entry point calls this; call it BEFORE compiling
+    any replicated reference you intend to compare against, because the
+    flag changes the generated bits themselves."""
+    import jax
+    if not jax.config.jax_threefry_partitionable:
+        jax.config.update("jax_threefry_partitionable", True)
+
+
 def shard_map(f, mesh, in_specs, out_specs, check_vma=None):
     """``jax.shard_map`` (jax >= 0.5) or the ``jax.experimental``
     fallback (jax 0.4.x, where the replication-check kwarg is named

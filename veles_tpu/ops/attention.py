@@ -346,6 +346,68 @@ def _decode_attend(params, x, k_cache, v_cache, write_idx, live,
     return matmul(o, params["wo"]), k_cache, v_cache
 
 
+def chunk_live_mask(pos, c, cache_len, window=None, sinks=0):
+    """(c, cache_len) bool mask for ``c`` query positions starting at
+    traced ``pos`` attending a linear cache — the multi-query sibling of
+    the per-step mask in :func:`mha_decode_step` (same semantics at
+    c=1, same window/sink rules as :func:`band_bias`)."""
+    q_pos = pos + jnp.arange(c)
+    idx = jnp.arange(cache_len)
+    live = idx[None, :] <= q_pos[:, None]
+    if window:
+        in_window = idx[None, :] > q_pos[:, None] - window
+        if sinks:
+            in_window |= (idx < sinks)[None, :]
+        live &= in_window
+    return live
+
+
+def mha_chunk_step(params, x, k_cache, v_cache, pos, n_heads,
+                   rope=False, window=None, sinks=0):
+    """``c`` decode/prefill positions against the KV cache in ONE pass —
+    the multi-token generalization of :func:`mha_decode_step` (which is
+    the c=1 case) serving both CHUNKED PREFILL (a prompt slice lands in
+    the cache without recomputing what precedes it) and SPECULATIVE
+    VERIFICATION (a draft of tokens scored in one dispatch).
+
+    x: (batch, c, d_model) — activations for positions
+    [pos, pos + c); k_cache/v_cache: (batch, kv_heads, max_len,
+    head_dim) with positions [0, pos) filled; ``pos`` is traced.
+    Writes the c new K/V rows at [pos, pos + c) and attends each query
+    i causally over cache positions <= pos + i (window/sinks as in
+    :func:`mha_decode_step`), so position j's output is exactly what a
+    full prefill (or j one-token decode steps) would produce.  The
+    caller must guarantee ``pos + c <= max_len`` — dynamic_update_slice
+    CLAMPS out-of-range starts, which would silently shift the write
+    onto committed rows."""
+    b, c, d = x.shape
+    dh = d // n_heads
+    kv = kv_heads_of(params, n_heads, d)
+
+    def split(w, heads):
+        return matmul(x, w).reshape(b, c, heads, dh).transpose(0, 2, 1, 3)
+
+    q = split(params["wq"], n_heads)            # (b, h, c, dh)
+    k_new = split(params["wk"], kv)
+    if rope:
+        pos_arr = pos + jnp.arange(c)
+        q = rope_rotate(q, pos_arr)
+        k_new = rope_rotate(k_new, pos_arr)
+    k_cache = jax.lax.dynamic_update_slice(
+        k_cache, k_new, (0, 0, pos, 0))
+    v_cache = jax.lax.dynamic_update_slice(
+        v_cache, split(params["wv"], kv), (0, 0, pos, 0))
+    scores = matmul(q, jnp.swapaxes(_repeat_kv(k_cache, n_heads),
+                                    -1, -2)) / jnp.sqrt(
+        jnp.asarray(dh, q.dtype))               # (b, h, c, cache_len)
+    live = chunk_live_mask(pos, c, k_cache.shape[2], window, sinks)
+    scores = jnp.where(live[None, None, :, :], scores, NEG_INF)
+    o = matmul(jax.nn.softmax(scores, axis=-1),
+               _repeat_kv(v_cache, n_heads))
+    o = o.transpose(0, 2, 1, 3).reshape(b, c, d)
+    return matmul(o, params["wo"]), k_cache, v_cache
+
+
 def mha_decode_step(params, x, k_cache, v_cache, pos, n_heads,
                     rope=False, window=None, sinks=0):
     """One autoregressive decode step with a KV cache.

@@ -264,6 +264,59 @@ def block_decode_step(blk, h, k_cache, v_cache, pos, n_heads,
     return h + _block_ffn(blk, hn), k_cache, v_cache
 
 
+def block_chunk_step(blk, h, k_cache, v_cache, pos, n_heads,
+                     rope=False, window=None, sinks=0):
+    """One block over ``c`` consecutive positions against its KV cache —
+    the multi-token sibling of :func:`block_decode_step` (same wiring,
+    ``attention.mha_chunk_step`` core).  Serves chunked prefill and
+    speculative-draft verification; at c=1 it computes exactly what
+    ``block_decode_step`` computes."""
+    from veles_tpu.ops.attention import mha_chunk_step
+    hn = _layernorm(h, blk["ln1"]["g"], blk["ln1"]["b"])
+    attn, k_cache, v_cache = mha_chunk_step(blk["attn"], hn, k_cache,
+                                            v_cache, pos, n_heads,
+                                            rope=rope, window=window,
+                                            sinks=sinks)
+    h = h + attn
+    hn = _layernorm(h, blk["ln2"]["g"], blk["ln2"]["b"])
+    return h + _block_ffn(blk, hn), k_cache, v_cache
+
+
+def chunk_embed(params, tokens, pos):
+    """Token (+ positional at [pos, pos+c), absent under RoPE) embedding
+    for a mid-sequence chunk — :func:`embed_tokens` generalized to a
+    traced start position (the chunked-prefill / speculative entry
+    half)."""
+    import jax
+    import jax.numpy as jnp
+    c = tokens.shape[1]
+    h = jnp.take(params["embed"], tokens, axis=0)
+    if "pos" in params:
+        h = h + jax.lax.dynamic_slice_in_dim(params["pos"], pos, c,
+                                             axis=0)[None]
+    return h
+
+
+def chunk_apply(params, tokens, caches, pos, n_heads, rope=False,
+                window=None, sinks=0):
+    """Run ``c`` consecutive tokens through the whole stack against the
+    caches in ONE pass: embed at [pos, pos+c), every block via
+    :func:`block_chunk_step`.  Returns (h (b, c, d), caches) with the
+    chunk's K/V written at [pos, pos+c) — the building block of chunked
+    prefill (c = chunk size) and prompt-lookup speculative decoding
+    (c = 1 + draft length).  Position j's hidden state equals the full
+    ``prefill`` / step-by-step decode result for the same tokens, so
+    everything downstream stays bit-identical to ``generate``."""
+    h = chunk_embed(params, tokens, pos)
+    new_caches = []
+    for blk, (kc, vc) in zip(params["blocks"], caches):
+        h, kc, vc = block_chunk_step(blk, h, kc, vc, pos, n_heads,
+                                     rope=rope, window=window,
+                                     sinks=sinks)
+        new_caches.append((kc, vc))
+    return h, new_caches
+
+
 def _make_sampler(greedy, top_k, temperature):
     """Token sampler shared by the full-cache and rolling decoders (the
     top-k tie rule and traced-temperature handling must never drift

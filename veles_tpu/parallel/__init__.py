@@ -26,6 +26,10 @@ def make_mesh(n_devices=None, model_parallel=1, devices=None):
     """Build a (data, model) mesh over the first ``n_devices`` devices."""
     import jax
     from jax.sharding import Mesh
+    from veles_tpu.compat import ensure_partitionable_rng
+    # sharded runs must draw the SAME dropout/augmentation bits as the
+    # replicated runs they claim to reproduce (see compat)
+    ensure_partitionable_rng()
     devices = list(devices if devices is not None else jax.devices())
     n = n_devices or len(devices)
     if n > len(devices):

@@ -283,7 +283,9 @@ class RESTfulAPI(Logger):
 
 
 def serve_lm(workflow, host="127.0.0.1", port=8180, max_new=256,
-             slots=0, queue_depth=64, deadline_s=30.0):
+             slots=0, queue_depth=64, deadline_s=30.0,
+             prefix_cache=0, prefill_chunk=0, spec_k=0,
+             queue_tokens=0):
     """Serve a trained transformer-trainer workflow (e.g. char_lm) for
     autoregressive continuation: POST ``{"input": [[tok, ...]],
     "n_new": N, "temperature": T, "top_k": K, "seed": S}`` to
@@ -297,6 +299,15 @@ def serve_lm(workflow, host="127.0.0.1", port=8180, max_new=256,
     ``n_new`` (no tier overshoot), and output is bit-identical to the
     direct path.  Sampled requests (temperature > 0) always take the
     direct path below.
+
+    The LM serving FAST PATH (ISSUE 4) rides on the engine:
+    ``prefix_cache=N`` caches N chunks of prompt KV in a radix trie
+    (shared system prompts prefill once), ``prefill_chunk=C`` runs
+    prompts as C-token chunks interleaved with decode, ``spec_k=K``
+    enables prompt-lookup speculative decoding (several tokens per
+    dispatch on repetitive text), ``queue_tokens=T`` budgets admission
+    by queued prompt tokens.  All preserve bit-identical greedy output;
+    see ``veles_tpu/serving/lm_engine.py``.
 
     The direct path decodes one prompt batch at a time via the
     KV-cached ``transformer.generate``, one jitted dispatch per
@@ -334,6 +345,8 @@ def serve_lm(workflow, host="127.0.0.1", port=8180, max_new=256,
             window=getattr(trainer, "window", None),
             sinks=getattr(trainer, "attn_sinks", 0),
             queue_depth=queue_depth, deadline_s=deadline_s,
+            prefix_cache=prefix_cache, prefill_chunk=prefill_chunk,
+            spec_k=spec_k, queue_tokens=queue_tokens,
             metrics=metrics_mod.new("lm")).start()
 
     def handler(request):
@@ -347,11 +360,17 @@ def serve_lm(workflow, host="127.0.0.1", port=8180, max_new=256,
             raise ValueError("prompt length %d leaves no room to decode "
                              "(max_len %d)" % (s_true, cache_len))
         temperature = float(request.get("temperature", 0.0))
-        if engine is not None and temperature == 0.0:
+        # speculative decoding needs spec_k cache positions of write
+        # headroom; a prompt too close to the cache cap falls back to
+        # the direct path instead of being refused
+        eng_headroom = headroom - (engine.spec_k if engine is not None
+                                   else 0)
+        if engine is not None and temperature == 0.0 \
+                and eng_headroom >= 1:
             # continuous batching: exact n_new (no tier), concurrent
             # prompts share the decode step across slots
             return {"tokens": engine.generate(
-                prompt, min(want, headroom)).tolist()}
+                prompt, min(want, eng_headroom)).tolist()}
         # decode length: round the request UP to a tier; near the cache
         # cap fall back to the largest tier that fits (or the exact
         # headroom when even the smallest doesn't — rare, self-limiting)

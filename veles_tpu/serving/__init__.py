@@ -11,7 +11,9 @@ request, this package amortizes dispatch across concurrent clients.
   deadlines (:class:`DeadlineExceeded` / HTTP 503).
 - :mod:`veles_tpu.serving.lm_engine` — :class:`LMEngine`: slot-based
   continuous batching for autoregressive LM decode over one shared KV
-  cache (greedy path bit-identical to ``ops.transformer.generate``).
+  cache (greedy path bit-identical to ``ops.transformer.generate``),
+  plus the ISSUE 4 fast path: :class:`RadixPrefixCache` prompt-KV
+  reuse, chunked prefill, and prompt-lookup speculative decoding.
 - :mod:`veles_tpu.serving.metrics` — :class:`ServingMetrics`:
   lock-cheap counters/histograms (queue wait, batch size, latency
   percentiles, shed/429, slot occupancy) with a snapshot API and a
@@ -25,10 +27,12 @@ through here when asked (``RESTfulAPI.enable_batching``, ``serve_lm``'s
 
 from veles_tpu.serving.batcher import (DeadlineExceeded, MicroBatcher,
                                        Overloaded, batch_buckets)
-from veles_tpu.serving.lm_engine import LMEngine, prompt_bucket
+from veles_tpu.serving.lm_engine import (LMEngine, RadixPrefixCache,
+                                         prompt_bucket, propose_draft)
 from veles_tpu.serving.metrics import (ServingMetrics, get,
                                        render_prometheus)
 
-__all__ = ["MicroBatcher", "LMEngine", "ServingMetrics", "Overloaded",
-           "DeadlineExceeded", "batch_buckets", "prompt_bucket", "get",
+__all__ = ["MicroBatcher", "LMEngine", "RadixPrefixCache",
+           "ServingMetrics", "Overloaded", "DeadlineExceeded",
+           "batch_buckets", "prompt_bucket", "propose_draft", "get",
            "render_prometheus"]

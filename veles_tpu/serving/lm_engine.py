@@ -18,11 +18,39 @@ every active lane — vLLM-style continuous batching on a jit substrate:
   prompt takes it, so decode throughput scales with slot count instead
   of serializing per prompt.
 
+The SERVING FAST PATH (ISSUE 4) adds three independently-toggled
+optimizations, each preserving the greedy contract below:
+
+- ``prefix_cache=N`` — a chunk-granular RADIX PREFIX CACHE
+  (:class:`RadixPrefixCache`) over prompt tokens: prompts sharing a
+  prefix (system prompts, few-shot headers) reuse the already-computed
+  KV rows for their shared full chunks instead of re-running prefill
+  FLOPs.  Entries are ref-counted while a lane uses their trie path and
+  LRU-evicted at capacity ``N`` chunks; rows are COPIED into the lane's
+  shared-cache rows on install, so a later eviction (or poisoning
+  attempt) can never corrupt an in-flight decode — correctness never
+  depends on cache state, only speed does.
+- ``prefill_chunk=C`` — CHUNKED PREFILL: the prompt runs as
+  ceil(len/C) fixed-width chunk dispatches
+  (``ops/transformer.py::chunk_apply``) interleaved with decode steps,
+  so one long prompt neither head-of-line-blocks the decode lanes nor
+  forks a compile per prompt-length bucket (ONE chunk program total).
+- ``spec_k=K`` — PROMPT-LOOKUP SPECULATIVE DECODING: an n-gram match
+  against the lane's own prompt+output proposes K draft tokens (no
+  draft model), verified in ONE batched chunk dispatch; every accepted
+  token is by construction exactly the greedy token (acceptance
+  compares the draft against the verifier's own argmax), so accepted
+  runs yield multiple tokens per dispatch — sub-1 dispatches/token on
+  repetitive or structured text — while a full miss still yields the
+  one greedy token a plain step would have.
+
 Decoding is GREEDY (temperature 0) — bit-identical to
-``ops/transformer.py::generate`` for the same prompt, which is the
-serving contract (sampled requests fall back to the direct path
-upstream).  Compile count is bounded: one step program, one prefill
-program per prompt bucket, one install program.
+``ops/transformer.py::generate`` for the same prompt WHATEVER fast-path
+combination is enabled, which is the serving contract (sampled
+requests fall back to the direct path upstream).  Compile count is
+bounded: one step program, one prefill program per prompt bucket, one
+install program, plus (fast path) one chunk-prefill program, one
+chunk-install/extract pair, and one verify program per (engine) ``k``.
 """
 
 from __future__ import annotations
@@ -57,12 +85,20 @@ class _Request:
 class _Slot:
     """Host-side lane state; device state lives in the shared caches."""
 
-    __slots__ = ("request", "emitted", "remaining")
+    __slots__ = ("request", "emitted", "remaining", "pending", "pinned",
+                 "cursor")
 
     def __init__(self, request):
         self.request = request
         self.emitted = []
         self.remaining = request.n_new
+        #: chunked prefill still to run: [(tokens (C,), start, is_tail)]
+        self.pending = []
+        #: prefix-cache nodes pinned by this lane (released at finish)
+        self.pinned = []
+        #: trie node of the last matched/inserted chunk (None once the
+        #: cache refused an insert — stop extending this lane's path)
+        self.cursor = None
 
 
 def prompt_bucket(true_len, max_len, floor=16):
@@ -74,6 +110,148 @@ def prompt_bucket(true_len, max_len, floor=16):
     return min(bucket, max_len)
 
 
+def propose_draft(history, k, max_ngram=3):
+    """Prompt-lookup draft (arXiv:2304.04487 / prompt-lookup decoding):
+    find the most recent earlier occurrence of the sequence's final
+    n-gram (n = ``max_ngram`` down to 1) and propose the (up to ``k``)
+    tokens that followed it.  Returns (m,) int32 with 1 <= m <= k —
+    exactly the continuation that was found, unpadded, so callers can
+    meter real draft tokens — or None when no n-gram recurs.
+
+    Draft quality only affects SPEED: the verifier accepts a draft
+    token only when it equals the verifier's own greedy argmax, so even
+    an adversarial draft cannot change output."""
+    history = numpy.asarray(history, numpy.int32).reshape(-1)
+    n = len(history)
+    for g in range(min(max_ngram, n - 1), 0, -1):
+        # candidate windows must END strictly before the final position
+        # (the tail itself is not a match for itself)
+        if n - 1 < g:
+            continue
+        tail = history[n - g:]
+        windows = numpy.lib.stride_tricks.sliding_window_view(
+            history[:n - 1], g)
+        hits = numpy.flatnonzero((windows == tail).all(axis=1))
+        if not len(hits):
+            continue
+        s = int(hits[-1])               # most recent occurrence
+        cont = history[s + g:s + g + k]
+        if len(cont):
+            return numpy.asarray(cont, numpy.int32)
+    return None
+
+
+class _PrefixNode:
+    __slots__ = ("key", "rows", "children", "refs", "last_use", "parent")
+
+    def __init__(self, key, rows, parent):
+        self.key = key                # tuple of the chunk's tokens
+        self.rows = rows              # per-block [(k, v)] (1, H, C, D)
+        self.children = {}
+        self.refs = 0
+        self.last_use = 0
+        self.parent = parent
+
+
+class RadixPrefixCache:
+    """Radix trie over prompt tokens at CHUNK granularity.
+
+    A node holds the per-block KV rows of exactly ``chunk`` tokens whose
+    absolute positions are [depth·chunk, (depth+1)·chunk) — valid for
+    ANY prompt sharing that token prefix, because causal attention makes
+    a position's K/V depend only on the tokens at and before it.  Keys
+    are the chunk's literal tokens, so two prompts diverging mid-chunk
+    hash to different keys and can never cross-contaminate (the
+    poisoning case the parity suite pins).
+
+    Entries are PINNED (ref-counted) while a lane's admission walk or
+    insert path uses them and LRU-evicted leaf-first at ``capacity``
+    chunks.  Lookup/insert/evict all run on the single engine worker
+    thread — no locking.
+    """
+
+    def __init__(self, capacity, chunk):
+        if capacity < 1:
+            raise ValueError("prefix cache capacity must be >= 1")
+        self.capacity = int(capacity)
+        self.chunk = int(chunk)
+        self.root = _PrefixNode(None, None, None)
+        self.size = 0
+        self._tick = 0
+
+    def match(self, keys):
+        """Longest cached prefix along ``keys`` (chunk-token tuples);
+        returns the matched nodes in order, each pinned — pass them to
+        :meth:`release` when the lane finishes."""
+        self._tick += 1
+        node, out = self.root, []
+        for key in keys:
+            child = node.children.get(key)
+            if child is None:
+                break
+            child.refs += 1
+            child.last_use = self._tick
+            out.append(child)
+            node = child
+        return out
+
+    def insert(self, parent, key, rows):
+        """Add one computed chunk under ``parent`` (root or the lane's
+        previous node); returns the PINNED node — existing nodes are
+        reused (first writer wins; identical content by construction) —
+        or None when every entry is pinned and nothing can be evicted."""
+        self._tick += 1
+        node = parent.children.get(key)
+        if node is None:
+            while self.size >= self.capacity:
+                if not self._evict_one():
+                    return None
+            node = _PrefixNode(key, rows, parent)
+            parent.children[key] = node
+            self.size += 1
+        node.refs += 1
+        node.last_use = self._tick
+        return node
+
+    def lookup_child(self, parent, key):
+        """The one-chunk extension of ``parent`` by ``key``, PINNED, or
+        None.  Called per pending chunk right before computing it: a
+        sibling lane prefilling the same prompt may have inserted the
+        chunk since this lane was admitted, and late hits are what make
+        CONCURRENT shared-prefix arrivals converge on one prefill
+        instead of all missing the cache they are about to fill."""
+        node = parent.children.get(key)
+        if node is None:
+            return None
+        self._tick += 1
+        node.refs += 1
+        node.last_use = self._tick
+        return node
+
+    def release(self, nodes):
+        for node in nodes:
+            node.refs -= 1
+
+    def _evict_one(self):
+        """Evict the least-recently-used unpinned LEAF (interior nodes
+        keep their children's prefix reachable; they become leaves —
+        and evictable — once their subtree ages out)."""
+        best = None
+        stack = list(self.root.children.values())
+        while stack:
+            node = stack.pop()
+            if node.children:
+                stack.extend(node.children.values())
+            elif node.refs == 0 and (best is None
+                                     or node.last_use < best.last_use):
+                best = node
+        if best is None:
+            return False
+        del best.parent.children[best.key]
+        self.size -= 1
+        return True
+
+
 class LMEngine(Logger):
     """Slot-based continuous batching over ``params`` (a portable
     transformer param tree, see ``TransformerTrainer._to_portable``).
@@ -81,12 +259,26 @@ class LMEngine(Logger):
     One worker thread owns the device state; clients :meth:`submit`
     single prompts (or :meth:`generate` a batch) and block on futures.
     ``max_len`` pins the shared cache length: every request must satisfy
-    ``len(prompt) + n_new <= max_len``.
+    ``len(prompt) + n_new <= max_len`` (+ ``spec_k`` of speculation
+    headroom when ``spec_k > 0`` — a verify dispatch writes up to k
+    positions past the committed front).
+
+    Fast-path knobs (ISSUE 4, all default-off; see the module
+    docstring): ``prefill_chunk=C`` chunked prefill, ``prefix_cache=N``
+    radix KV reuse over N cached chunks (implies chunking; default
+    chunk 32), ``spec_k=K`` prompt-lookup speculative decoding with
+    ``spec_ngram`` match length.  ``queue_tokens=T`` budgets ADMISSION
+    by queued prompt tokens (not just request count): a long-prompt
+    flood 429s early instead of building an unbounded prefill backlog
+    (the head request always admits, so a single oversized prompt can
+    not wedge an empty queue).
     """
 
     def __init__(self, params, n_heads, max_len, slots=4, rope=False,
                  window=None, sinks=0, queue_depth=64, deadline_s=30.0,
-                 metrics=None, name="lm"):
+                 metrics=None, name="lm", prefill_chunk=0,
+                 prefix_cache=0, spec_k=0, spec_ngram=3,
+                 queue_tokens=0):
         import jax.numpy as jnp
         if slots < 1:
             raise ValueError("slots must be >= 1")
@@ -100,9 +292,33 @@ class LMEngine(Logger):
         self.sinks = int(sinks)
         self.queue_depth = int(queue_depth)
         self.deadline_s = float(deadline_s)
+        self.queue_tokens = int(queue_tokens)
+        if prefix_cache and not prefill_chunk:
+            prefill_chunk = min(32, self.max_len)   # cache granularity
+        self.prefill_chunk = int(prefill_chunk)
+        self.spec_k = int(spec_k)
+        self.spec_ngram = int(spec_ngram)
+        if self.prefill_chunk < 0 or self.prefill_chunk > self.max_len:
+            raise ValueError("prefill_chunk %d out of range (max_len %d)"
+                             % (self.prefill_chunk, self.max_len))
+        if self.spec_k < 0 or self.spec_k + 1 >= self.max_len:
+            raise ValueError("spec_k %d out of range (max_len %d)"
+                             % (self.spec_k, self.max_len))
+        if self.spec_k and self.prefill_chunk \
+                and self.spec_k + 1 > self.prefill_chunk:
+            # a prefilling lane parks its step position at the chunk
+            # frontier; the next chunk overwrites the verify dispatch's
+            # k+1 garbage writes only when they fit inside one chunk
+            raise ValueError("spec_k + 1 (%d) must not exceed "
+                             "prefill_chunk (%d)"
+                             % (self.spec_k + 1, self.prefill_chunk))
+        if self.spec_ngram < 1:
+            raise ValueError("spec_ngram must be >= 1")
         self.metrics = metrics or ServingMetrics(name)
         self.metrics.set_gauge("slots_total", self.slots)
         self.metrics.set_gauge("slots_busy", 0)
+        self._trie = (RadixPrefixCache(prefix_cache, self.prefill_chunk)
+                      if prefix_cache else None)
 
         embed = params["embed"]
         d_model = embed.shape[1]
@@ -119,6 +335,7 @@ class LMEngine(Logger):
         self._free = list(range(self.slots))
 
         self._queue = collections.deque()
+        self._queued_tokens = 0
         self._cond = threading.Condition()
         self._thread = None
         self._stop = False
@@ -129,9 +346,11 @@ class LMEngine(Logger):
         import jax
         import jax.numpy as jnp
         from veles_tpu.ops.transformer import (block_decode_step,
-                                               head_logits, prefill)
+                                               chunk_apply, head_logits,
+                                               prefill)
         n_heads, max_len = self.n_heads, self.max_len
         rope, window, sinks = self.rope, self.window, self.sinks
+        C, k1 = self.prefill_chunk, self.spec_k + 1
 
         def prefill_one(params, prompt, true_len):
             # prompt (1, bucket) int32, true_len traced: positions
@@ -173,19 +392,107 @@ class LMEngine(Logger):
         self._step_jit = jax.jit(jax.vmap(step_one,
                                           in_axes=(None, 0, 0, 0)))
 
+        self._chunk_jit = None
+        self._chunk_install_jit = None
+        self._chunk_extract_jit = None
+        if C:
+            def chunk_slot(params, caches, tokens, slot, start,
+                           last_idx):
+                # one prompt chunk for ONE lane, straight into the
+                # shared caches at a TRACED (slot, start): positions
+                # [start, start+C) computed against everything already
+                # committed below them.  ``last_idx`` picks the chunk
+                # offset whose next-token argmax to return (only read
+                # on the final chunk).  One compile for every chunk of
+                # every prompt length.
+                rows = [(jax.lax.dynamic_slice_in_dim(kc, slot, 1, 0),
+                         jax.lax.dynamic_slice_in_dim(vc, slot, 1, 0))
+                        for kc, vc in caches]
+                h, rows = chunk_apply(params, tokens[None], rows, start,
+                                      n_heads, rope=rope, window=window,
+                                      sinks=sinks)
+                caches = [
+                    (jax.lax.dynamic_update_slice(kc, rk,
+                                                  (slot, 0, 0, 0)),
+                     jax.lax.dynamic_update_slice(vc, rv,
+                                                  (slot, 0, 0, 0)))
+                    for (kc, vc), (rk, rv) in zip(caches, rows)]
+                logits = head_logits(
+                    params, jax.lax.dynamic_slice_in_dim(
+                        h, last_idx, 1, axis=1))[:, 0, :]
+                tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)[0]
+                return caches, tok
+
+            def chunk_extract(caches, slot, start):
+                # copy one lane's chunk rows OUT (prefix-cache insert)
+                return [
+                    (jax.lax.dynamic_slice(
+                        kc, (slot, 0, start, 0),
+                        (1, kc.shape[1], C, kc.shape[3])),
+                     jax.lax.dynamic_slice(
+                        vc, (slot, 0, start, 0),
+                        (1, vc.shape[1], C, vc.shape[3])))
+                    for kc, vc in caches]
+
+            def chunk_install(caches, rows, slot, start):
+                # copy cached chunk rows IN (copy-on-install: the trie
+                # entry and the lane's rows never alias)
+                return [
+                    (jax.lax.dynamic_update_slice(kc, rk,
+                                                  (slot, 0, start, 0)),
+                     jax.lax.dynamic_update_slice(vc, rv,
+                                                  (slot, 0, start, 0)))
+                    for (kc, vc), (rk, rv) in zip(caches, rows)]
+
+            self._chunk_jit = jax.jit(chunk_slot)
+            self._chunk_extract_jit = jax.jit(chunk_extract)
+            self._chunk_install_jit = jax.jit(chunk_install)
+
+        self._verify_jit = None
+        if self.spec_k:
+            def verify_one(params, cache_rows, toks, pos):
+                # toks (k+1,) = [last committed, draft…] fed at
+                # positions [pos, pos+k]; returns the greedy argmax
+                # AFTER each fed token — the host accepts the longest
+                # draft prefix that matches its own argmax, so output
+                # is greedy-exact by construction
+                rows = [(kc[None], vc[None]) for kc, vc in cache_rows]
+                h, rows = chunk_apply(params, toks[None], rows, pos,
+                                      n_heads, rope=rope, window=window,
+                                      sinks=sinks)
+                logits = head_logits(params, h)[0]      # (k+1, vocab)
+                out = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return [(kc[0], vc[0]) for kc, vc in rows], out
+
+            self._verify_jit = jax.jit(jax.vmap(
+                verify_one, in_axes=(None, 0, 0, 0)))
+
     # --------------------------------------------------------------- lifecycle
     def start(self):
         import jax.numpy as jnp
-        # warm the step program (and the smallest prompt bucket) before
-        # traffic: the discarded warmup writes land at pos 0 of free
-        # slots, which the next prefill overwrites before they are ever
-        # attended
+        # warm every program before traffic: the discarded warmup
+        # writes land at positions of free slots that the next
+        # prefill/chunk overwrites before they are ever attended
         tok, rows = self._prefill_jit(
             self.params,
             jnp.zeros((1, prompt_bucket(1, self.max_len)), jnp.int32),
             jnp.asarray(1, jnp.int32))
         self._caches = self._install_jit(self._caches, rows,
                                          jnp.asarray(0, jnp.int32))
+        if self._chunk_jit is not None:
+            zero = jnp.asarray(0, jnp.int32)
+            self._caches, _ = self._chunk_jit(
+                self.params, self._caches,
+                jnp.zeros(self.prefill_chunk, jnp.int32), zero, zero,
+                zero)
+            crows = self._chunk_extract_jit(self._caches, zero, zero)
+            self._caches = self._chunk_install_jit(self._caches, crows,
+                                                   zero, zero)
+        if self._verify_jit is not None:
+            self._caches, _ = self._verify_jit(
+                self.params, self._caches,
+                jnp.zeros((self.slots, self.spec_k + 1), jnp.int32),
+                jnp.zeros(self.slots, jnp.int32))
         self._caches, _ = self._step_jit(
             self.params, self._caches,
             jnp.zeros(self.slots, jnp.int32),
@@ -213,20 +520,33 @@ class LMEngine(Logger):
             raise ValueError("empty prompt")
         if n_new < 1:
             raise ValueError("n_new must be >= 1")
-        if len(prompt) + n_new > self.max_len:
-            raise ValueError("prompt %d + n_new %d exceeds the engine "
+        if len(prompt) + n_new + self.spec_k > self.max_len:
+            extra = (" (+%d speculative headroom, spec_k)" % self.spec_k
+                     if self.spec_k else "")
+            raise ValueError("prompt %d + n_new %d%s exceeds the engine "
                              "cache length %d"
-                             % (len(prompt), n_new, self.max_len))
+                             % (len(prompt), n_new, extra, self.max_len))
         with self._cond:
             if self._stop or self._thread is None:
                 raise RuntimeError("LM engine is not running")
             if len(self._queue) >= self.queue_depth:
                 self.metrics.record_reject()
                 raise Overloaded()
+            if self.queue_tokens and self._queue and \
+                    self._queued_tokens + len(prompt) > self.queue_tokens:
+                # prompt-length budgeting: queued PREFILL WORK is
+                # bounded, not just request count — a burst of long
+                # prompts sheds early instead of stacking seconds of
+                # head-of-line prefill behind the queue
+                self.metrics.record_reject()
+                self.metrics.inc("rejected_tokens", len(prompt))
+                raise Overloaded()
             req = _Request(prompt, int(n_new), self.deadline_s)
             self._queue.append(req)
+            self._queued_tokens += req.true_len
             self.metrics.record_enqueue()
             self.metrics.set_gauge("queue_depth", len(self._queue))
+            self.metrics.set_gauge("queue_tokens", self._queued_tokens)
             self._cond.notify()
         return req.future
 
@@ -259,18 +579,27 @@ class LMEngine(Logger):
         with self._cond:
             try:
                 self._queue.remove(req)
+                self._queued_tokens -= req.true_len
             except ValueError:
                 return           # admitted (or done) — worker handles it
         req.future.cancel()
 
     # ------------------------------------------------------------------ worker
     def _admit(self):
-        """Move queued prompts into free slots (prefill + install)."""
+        """Move queued prompts into free slots.  Feature-off requests
+        (and chunked-ineligible ones) prefill whole at a power-of-two
+        bucket as before; with ``prefill_chunk`` the lane only LOOKS UP
+        the prefix cache and installs its hits here — compute chunks run
+        one per tick, interleaved with decode (no head-of-line block)."""
         import jax.numpy as jnp
         while self._free:
             with self._cond:
                 req = self._queue.popleft() if self._queue else None
+                if req is not None:
+                    self._queued_tokens -= req.true_len
                 self.metrics.set_gauge("queue_depth", len(self._queue))
+                self.metrics.set_gauge("queue_tokens",
+                                       self._queued_tokens)
             if req is None:
                 return
             if req.cancelled:            # raced _cancel's dequeue
@@ -283,6 +612,10 @@ class LMEngine(Logger):
                         time.monotonic() - req.t_enq)))
                 continue
             slot = self._free.pop()
+            C = self.prefill_chunk
+            if C and ((req.true_len - 1) // C + 1) * C <= self.max_len:
+                self._admit_chunked(slot, req)
+                continue
             bucket = prompt_bucket(req.true_len, self.max_len)
             prompt = req.prompt
             if bucket > req.true_len:
@@ -305,73 +638,328 @@ class LMEngine(Logger):
                 continue
             self.metrics.record_queue_wait(
                 time.monotonic() - req.t_enq)
+            self.metrics.inc("prefill_tokens", req.true_len)
             lane = _Slot(req)
-            lane.emitted.append(int(tok))
-            lane.remaining -= 1
-            self._pos[slot] = req.true_len
-            self._last[slot] = int(tok)
             self._lanes[slot] = lane
-            if lane.remaining == 0:
-                self._finish(slot)
+            self._emit_first(slot, lane, int(tok))
+
+    def _admit_chunked(self, slot, req):
+        """Chunked admission: match the prefix cache (full chunks only,
+        never the chunk holding the last prompt token — the tail must
+        run to produce the first token's logits), COPY hits into the
+        lane's cache rows, and queue the rest as per-tick chunk work."""
+        import jax.numpy as jnp
+        C = self.prefill_chunk
+        n_full = (req.true_len - 1) // C
+        lane = _Slot(req)
+        matched = 0
+        if self._trie is not None:
+            keys = [tuple(int(t) for t in req.prompt[i * C:(i + 1) * C])
+                    for i in range(n_full)]
+            nodes = self._trie.match(keys)
+            lane.pinned.extend(nodes)
+            lane.cursor = nodes[-1] if nodes else self._trie.root
+            try:
+                for i, node in enumerate(nodes):
+                    self._caches = self._chunk_install_jit(
+                        self._caches, node.rows,
+                        jnp.asarray(slot, jnp.int32),
+                        jnp.asarray(i * C, jnp.int32))
+            except Exception as e:   # noqa: BLE001 — fails THIS request
+                self.metrics.record_error()
+                self.warning("prefix-cache install failed: %s", e)
+                self._teardown_slot(slot, lane, e)
+                return
+            matched = len(nodes)
+            self.metrics.inc("prefix_hit_chunks", matched)
+            self.metrics.inc("prefix_hit_tokens", matched * C)
+            self.metrics.set_gauge("prefix_cache_chunks",
+                                   self._trie.size)
+        for i in range(matched, n_full):
+            lane.pending.append((req.prompt[i * C:(i + 1) * C], i * C,
+                                 False))
+        tail = req.prompt[n_full * C:]
+        if len(tail) < C:
+            tail = numpy.pad(tail, (0, C - len(tail)))
+        lane.pending.append((tail, n_full * C, True))
+        self.metrics.record_queue_wait(time.monotonic() - req.t_enq)
+        self._lanes[slot] = lane
+        # park the step position at the chunk frontier: the vmapped
+        # decode dispatch steps EVERY slot, and a prefilling lane's
+        # garbage write must land where its own next chunk (<= C wide,
+        # and spec_k + 1 <= C) overwrites before anything attends it
+        self._pos[slot] = lane.pending[0][1]
+
+    def _advance_prefill(self, slot):
+        """Run ONE pending prompt chunk for this lane (a tick's worth of
+        prefill — decode lanes step in between, so a long prompt never
+        head-of-line-blocks them).  Computed full chunks feed the prefix
+        cache; the tail chunk yields the first generated token."""
+        import jax.numpy as jnp
+        lane = self._lanes[slot]
+        req = lane.request
+        if req.cancelled:
+            # withdrawn (generate() sibling cancellation) mid-prefill:
+            # free the slot now instead of finishing the prompt for a
+            # result nobody will read
+            self._teardown_slot(slot, lane)
+            return
+        tokens, start, is_tail = lane.pending.pop(0)
+        if not is_tail and self._trie is not None \
+                and lane.cursor is not None:
+            # LATE HIT: a sibling lane prefilling the same prompt may
+            # have inserted this very chunk since admission — install
+            # its rows instead of recomputing, so concurrent
+            # shared-prefix arrivals converge on ONE prefill
+            node = self._trie.lookup_child(
+                lane.cursor, tuple(int(t) for t in tokens))
+            if node is not None:
+                try:
+                    self._caches = self._chunk_install_jit(
+                        self._caches, node.rows,
+                        jnp.asarray(slot, jnp.int32),
+                        jnp.asarray(start, jnp.int32))
+                except Exception as e:   # noqa: BLE001 — this request
+                    self._trie.release([node])
+                    self.metrics.record_error()
+                    self.warning("prefix-cache install failed: %s", e)
+                    self._teardown_slot(slot, lane, e)
+                    return
+                lane.pinned.append(node)
+                lane.cursor = node
+                self.metrics.inc("prefix_hit_chunks")
+                self.metrics.inc("prefix_hit_tokens", len(tokens))
+                self._pos[slot] = lane.pending[0][1]
+                return
+        last_idx = (req.true_len - 1 - start) if is_tail else 0
+        t0 = time.monotonic()
+        try:
+            self._caches, tok = self._chunk_jit(
+                self.params, self._caches,
+                jnp.asarray(tokens, jnp.int32),
+                jnp.asarray(slot, jnp.int32),
+                jnp.asarray(start, jnp.int32),
+                jnp.asarray(last_idx, jnp.int32))
+            if not is_tail and self._trie is not None \
+                    and lane.cursor is not None:
+                rows = self._chunk_extract_jit(
+                    self._caches, jnp.asarray(slot, jnp.int32),
+                    jnp.asarray(start, jnp.int32))
+                node = self._trie.insert(
+                    lane.cursor, tuple(int(t) for t in tokens), rows)
+                if node is not None:
+                    lane.pinned.append(node)
+                lane.cursor = node
+                self.metrics.set_gauge("prefix_cache_chunks",
+                                       self._trie.size)
+        except Exception as e:   # noqa: BLE001 — fails THIS request
+            self.metrics.record_error()
+            self.warning("chunk prefill failed: %s", e)
+            self._teardown_slot(slot, lane, e)
+            return
+        self.metrics.inc("prefill_dispatches")
+        self.metrics.inc("prefill_tokens",
+                         (req.true_len - start) if is_tail
+                         else len(tokens))
+        self.metrics.record_decode_step(time.monotonic() - t0)
+        if is_tail:
+            self._emit_first(slot, lane, int(tok))
+        else:
+            self._pos[slot] = lane.pending[0][1]
+
+    def _emit_first(self, slot, lane, tok):
+        """First generated token (prefill just finished): the lane
+        becomes a decode lane (or finishes outright at n_new=1)."""
+        req = lane.request
+        lane.emitted.append(tok)
+        lane.remaining -= 1
+        self.metrics.inc("tokens_out")
+        self.metrics.record_ttft(time.monotonic() - req.t_enq)
+        self._pos[slot] = req.true_len
+        self._last[slot] = tok
+        self._lanes[slot] = lane
+        if lane.remaining == 0 or req.cancelled:
+            self._finish(slot)
+
+    def _release_lane(self, lane):
+        if self._trie is not None and lane.pinned:
+            self._trie.release(lane.pinned)
+            lane.pinned = []
+
+    def _teardown_slot(self, slot, lane, exc=None):
+        """THE failure/cancellation teardown (every fault path funnels
+        here so none can forget a step): release the lane's trie pins,
+        clear and free the slot, park the step position at 0 (a free
+        slot's garbage writes land where the next admission overwrites
+        them), and fail — or, when ``exc`` is None, cancel — the
+        request's future."""
+        self._release_lane(lane)
+        self._lanes[slot] = None
+        if slot not in self._free:
+            self._free.append(slot)
+        self._pos[slot] = 0
+        self._last[slot] = 0
+        fut = lane.request.future
+        if exc is None:
+            fut.cancel()
+        elif not fut.cancelled():
+            fut.set_exception(exc)
 
     def _finish(self, slot):
         lane = self._lanes[slot]
         self._lanes[slot] = None
         self._free.append(slot)
+        self._pos[slot] = 0
+        self._last[slot] = 0
+        self._release_lane(lane)
         fut = lane.request.future
         if not fut.cancelled():          # withdrawn mid-decode
             fut.set_result(numpy.asarray(lane.emitted, numpy.int32))
 
-    def _worker(self):
+    def _fail_active(self, active, exc):
+        """A step/verify fault poisons every in-flight decode lane; fail
+        them to their clients and keep serving — never wedge with
+        futures that no one will ever resolve."""
+        self.metrics.record_error()
+        self.warning("decode step failed: %s", exc)
+        for slot in active:
+            self._teardown_slot(slot, self._lanes[slot], exc)
+
+    def _step_plain(self, active):
+        """ONE dispatch advances every active lane by one token;
+        inactive lanes step too (their writes land at a frozen position
+        that the next prefill/chunk overwrites before attending — see
+        the module docstring), so the step program never respecializes
+        on the active set."""
         import jax.numpy as jnp
+        t0 = time.monotonic()
+        try:
+            self._caches, toks = self._step_jit(
+                self.params, self._caches,
+                jnp.asarray(self._last), jnp.asarray(self._pos))
+            toks = numpy.asarray(toks)
+        except Exception as e:   # noqa: BLE001 — fails the lanes
+            self._fail_active(active, e)
+            return
+        self.metrics.record_dispatch(len(active))
+        self.metrics.record_decode_step(time.monotonic() - t0)
+        self.metrics.inc("decode_dispatches")
+        for slot in active:
+            lane = self._lanes[slot]
+            lane.emitted.append(int(toks[slot]))
+            lane.remaining -= 1
+            self.metrics.inc("tokens_out")
+            self._pos[slot] += 1
+            self._last[slot] = int(toks[slot])
+            if lane.remaining == 0 or lane.request.cancelled:
+                self._finish(slot)
+
+    def _step_speculative(self, active):
+        """ONE verify dispatch advances every active lane by 1..k+1
+        tokens: each lane feeds [last, draft…] (draft = prompt-lookup
+        n-gram continuation, zeros when none) and accepts the longest
+        draft prefix matching the verifier's own greedy argmax, plus
+        the correction/bonus token after it — bit-identical to plain
+        greedy decode by construction, at < 1 dispatch/token whenever
+        drafts hit."""
+        import jax.numpy as jnp
+        k = self.spec_k
+        toks_in = numpy.zeros((self.slots, k + 1), numpy.int32)
+        drafts = [None] * self.slots
+        real_lens = [0] * self.slots
+        for slot in active:
+            lane = self._lanes[slot]
+            toks_in[slot, 0] = self._last[slot]
+            history = numpy.concatenate(
+                [lane.request.prompt,
+                 numpy.asarray(lane.emitted, numpy.int32)])
+            draft = propose_draft(history, k, self.spec_ngram)
+            if draft is not None:
+                # zero-pad to the program's fixed k (padding is free:
+                # a pad only "accepts" when it IS the greedy token) but
+                # METER only the real continuation — acceptance rates
+                # must not be diluted by padding nor inflated by
+                # coincidental token-0 matches
+                padded = numpy.zeros(k, numpy.int32)
+                padded[:len(draft)] = draft
+                toks_in[slot, 1:] = padded
+                drafts[slot] = padded
+                real_lens[slot] = len(draft)
+                self.metrics.inc("draft_tokens", len(draft))
+        t0 = time.monotonic()
+        try:
+            self._caches, out = self._verify_jit(
+                self.params, self._caches, jnp.asarray(toks_in),
+                jnp.asarray(self._pos))
+            out = numpy.asarray(out)
+        except Exception as e:   # noqa: BLE001 — fails the lanes
+            self._fail_active(active, e)
+            return
+        self.metrics.record_dispatch(len(active))
+        self.metrics.record_decode_step(time.monotonic() - t0)
+        self.metrics.inc("decode_dispatches")
+        for slot in active:
+            lane = self._lanes[slot]
+            draft = drafts[slot]
+            accepted = 0
+            if draft is not None:
+                while accepted < k and \
+                        out[slot, accepted] == draft[accepted]:
+                    accepted += 1
+                self.metrics.inc("draft_accepted",
+                                 min(accepted, real_lens[slot]))
+            # accepted drafts ARE the greedy tokens (they matched the
+            # verifier's argmax); out[accepted] is the greedy token
+            # after them (correction on mismatch, bonus on full hit)
+            emit = [int(t) for t in
+                    (draft[:accepted].tolist() if draft is not None
+                     else [])]
+            emit.append(int(out[slot, accepted]))
+            take = min(len(emit), lane.remaining)
+            lane.emitted.extend(emit[:take])
+            lane.remaining -= take
+            self.metrics.inc("tokens_out", take)
+            self._pos[slot] += accepted + 1
+            self._last[slot] = int(out[slot, accepted])
+            if lane.remaining == 0 or lane.request.cancelled:
+                self._finish(slot)
+
+    def _worker(self):
+        rr = 0
         while True:
             self._admit()
-            active = [i for i, lane in enumerate(self._lanes)
-                      if lane is not None]
-            self.metrics.set_gauge("slots_busy", len(active))
-            if not active:
+            busy = [i for i, lane in enumerate(self._lanes)
+                    if lane is not None]
+            self.metrics.set_gauge("slots_busy", len(busy))
+            if not busy:
                 with self._cond:
                     if self._stop:
                         break
                     if not self._queue:
                         self._cond.wait(0.5)
                 continue
-            # ONE dispatch advances every active lane by one token;
-            # inactive lanes step too (their writes land at a frozen
-            # position that the next prefill/decode overwrites before
-            # attending — see the module docstring), so the step program
-            # never respecializes on the active set
-            try:
-                self._caches, toks = self._step_jit(
-                    self.params, self._caches,
-                    jnp.asarray(self._last), jnp.asarray(self._pos))
-                toks = numpy.asarray(toks)
-            except Exception as e:   # noqa: BLE001 — fails the lanes
-                # a step fault poisons every in-flight lane; fail them
-                # to their clients and keep serving — never wedge with
-                # futures that no one will ever resolve
-                self.metrics.record_error()
-                self.warning("decode step failed: %s", e)
-                for slot in active:
-                    lane = self._lanes[slot]
-                    self._lanes[slot] = None
-                    self._free.append(slot)
-                    if not lane.request.future.cancelled():
-                        lane.request.future.set_exception(e)
+            # chunked prefill interleaving: at most ONE prompt chunk per
+            # tick (round-robin across prefilling lanes), then one
+            # decode dispatch for the lanes that are past prefill — a
+            # long prompt costs the decode lanes one chunk of latency
+            # per token, never its whole prefill
+            prefilling = [i for i in busy if self._lanes[i].pending]
+            if prefilling:
+                rr += 1
+                self._advance_prefill(prefilling[rr % len(prefilling)])
+            active = [i for i, lane in enumerate(self._lanes)
+                      if lane is not None and not lane.pending]
+            if not active:
                 continue
-            self.metrics.record_dispatch(len(active))
-            for slot in active:
-                lane = self._lanes[slot]
-                lane.emitted.append(int(toks[slot]))
-                lane.remaining -= 1
-                self._pos[slot] += 1
-                self._last[slot] = int(toks[slot])
-                if lane.remaining == 0 or lane.request.cancelled:
-                    self._finish(slot)
+            if self._verify_jit is not None:
+                self._step_speculative(active)
+            else:
+                self._step_plain(active)
         # drain: engine stopping fails whatever is still queued
         with self._cond:
             pending = list(self._queue)
             self._queue.clear()
+            self._queued_tokens = 0
         for req in pending:
             req.future.set_exception(RuntimeError("LM engine stopped"))
         for slot, lane in enumerate(self._lanes):

@@ -90,6 +90,14 @@ class ServingMetrics:
         self.queue_wait = Histogram(TIME_BOUNDS)
         self.batch_size = Histogram(SIZE_BOUNDS)
         self.latency = Histogram(TIME_BOUNDS)
+        #: LM fast-path histograms (ISSUE 4): time-to-first-token per
+        #: request and wall seconds per decode dispatch
+        self.ttft = Histogram(TIME_BOUNDS)
+        self.decode_step = Histogram(TIME_BOUNDS)
+        #: named event counters (prefix-cache hits, draft acceptance,
+        #: ...) — engines add theirs via :meth:`inc`; rendered as
+        #: ``veles_serving_<name>_total`` counter families
+        self.counters = {}
         #: bounded reservoir of recent end-to-end latencies (percentiles)
         self._recent = collections.deque(maxlen=latency_window)
         #: point-in-time values (queue depth, slot occupancy, ...)
@@ -126,6 +134,27 @@ class ServingMetrics:
         with self._lock:
             self.queue_wait.observe(wait_s)
 
+    def record_ttft(self, seconds):
+        """Time from enqueue to the request's FIRST generated token."""
+        with self._lock:
+            self.ttft.observe(seconds)
+
+    def record_decode_step(self, seconds):
+        """Wall seconds of one decode/verify dispatch."""
+        with self._lock:
+            self.decode_step.observe(seconds)
+
+    def inc(self, name, n=1):
+        """Bump the named counter by ``n`` (created at zero on first
+        use) — the LM fast-path facts (prefix_hit_tokens,
+        draft_accepted, ...) that are not worth a dedicated slot."""
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + n
+
+    def counter(self, name):
+        with self._lock:
+            return self.counters.get(name, 0)
+
     def record_response(self, latency_s):
         with self._lock:
             self.responses += 1
@@ -156,6 +185,9 @@ class ServingMetrics:
                                 p50=_percentile(recent, 0.50),
                                 p95=_percentile(recent, 0.95),
                                 p99=_percentile(recent, 0.99)),
+                "ttft": self.ttft.snapshot(),
+                "decode_step": self.decode_step.snapshot(),
+                "counters": dict(self.counters),
                 "gauges": dict(self.gauges),
             }
 
@@ -173,7 +205,12 @@ class ServingMetrics:
                 fams.append((metric, "counter",
                              ["%s%s %d" % (metric, label,
                                            getattr(self, cname))]))
-            for hname in ("queue_wait", "batch_size", "latency"):
+            for name, value in sorted(self.counters.items()):
+                metric = "veles_serving_%s_total" % name
+                fams.append((metric, "counter",
+                             ["%s%s %d" % (metric, label, value)]))
+            for hname in ("queue_wait", "batch_size", "latency",
+                          "ttft", "decode_step"):
                 hist = getattr(self, hname)
                 metric = "veles_serving_%s" % hname
                 lines = ['%s_bucket{engine="%s",le="%s"} %d'
