@@ -1,0 +1,304 @@
+"""Paged KV-cache allocator lifecycle (ISSUE 6).
+
+``serving/kv_pool.py::KVPagePool`` is pure host-side bookkeeping, so
+most of this file is device-free unit coverage of its invariants:
+all-or-nothing allocation, ref-counted sharing, pins refusing release,
+and the scratch page never entering circulation.  The engine-level legs
+pin the three lifecycle behaviors serving correctness leans on —
+ref-count release when a lane finishes (shared pages survive in the
+trie, owned pages return to the free list), copy-on-write leaving the
+shared page bit-identical for its other referents, and pool exhaustion
+resolving as 429 (PoolExhausted) or 503 (deadline shed) — never a
+hang.
+"""
+
+import time
+
+import numpy
+import pytest
+
+from veles_tpu.serving.kv_pool import KVPagePool
+
+
+def _params(max_len=96, vocab=16, n_heads=2, n_layers=2, d_model=32):
+    import jax
+    import jax.numpy as jnp
+    from veles_tpu import prng
+    from veles_tpu.ops.transformer import init_transformer_params
+    host = init_transformer_params(prng.get("init"), vocab,
+                                   d_model=d_model, n_heads=n_heads,
+                                   n_layers=n_layers, max_len=max_len)
+    return jax.tree.map(jnp.asarray, host)
+
+
+class TestPoolUnit:
+    def test_alloc_all_or_nothing(self):
+        pool = KVPagePool(4, 8)
+        assert pool.alloc(0) == []
+        got = pool.alloc(3)
+        assert len(got) == 3 and len(set(got)) == 3
+        assert pool.free_pages == 1
+        # 2 > 1 free: refused WITHOUT touching the pool
+        assert pool.alloc(2) is None
+        assert pool.free_pages == 1
+        assert pool.alloc(1) is not None
+        assert pool.free_pages == 0
+
+    def test_scratch_page_never_allocated(self):
+        pool = KVPagePool(3, 8)
+        pages = pool.alloc(3)
+        assert KVPagePool.SCRATCH not in pages
+        assert pool.alloc(1) is None     # nothing left — 0 stayed out
+
+    def test_refcount_share_and_release(self):
+        pool = KVPagePool(2, 8)
+        (p,) = pool.alloc(1)
+        assert not pool.shared(p)
+        pool.retain(p)                   # second referent (trie / lane)
+        assert pool.shared(p) and pool.refs(p) == 2
+        assert pool.release(p) is False  # survivor keeps it
+        assert pool.free_pages == 1
+        assert pool.release(p) is True   # last referent frees it
+        assert pool.free_pages == 2
+
+    def test_release_unallocated_raises(self):
+        pool = KVPagePool(2, 8)
+        with pytest.raises(RuntimeError, match="unallocated"):
+            pool.release(1)              # never allocated
+        (p,) = pool.alloc(1)
+        pool.release(p)
+        with pytest.raises(RuntimeError, match="unallocated"):
+            pool.release(p)              # double free
+        with pytest.raises(RuntimeError, match="unallocated"):
+            pool.retain(KVPagePool.SCRATCH)
+
+    def test_pinned_page_refuses_free(self):
+        """A lane's pin turns freeing the page it still reads into a
+        loud error (and leaves the reference intact) instead of a
+        silent use-after-free recycle."""
+        pool = KVPagePool(2, 8)
+        (p,) = pool.alloc(1)
+        pool.pin(p)
+        with pytest.raises(RuntimeError, match="pinned"):
+            pool.release(p)
+        assert pool.refs(p) == 1         # reference restored
+        assert pool.free_pages == 1      # not recycled
+        pool.unpin(p)
+        assert pool.release(p) is True
+        with pytest.raises(RuntimeError, match="unpinned"):
+            pool.unpin(p)
+
+    def test_pin_unallocated_raises(self):
+        pool = KVPagePool(2, 8)
+        with pytest.raises(RuntimeError, match="pin of unallocated"):
+            pool.pin(1)
+
+    def test_constructor_validation(self):
+        with pytest.raises(ValueError):
+            KVPagePool(0, 8)
+        with pytest.raises(ValueError):
+            KVPagePool(4, 0)
+        with pytest.raises(ValueError):
+            KVPagePool(4, 8).alloc(-1)
+
+
+class TestTrieEvictionReleasesPages:
+    def test_on_evict_returns_pages_pinned_entries_refuse(self):
+        """The paged engine wires ``RadixPrefixCache(on_evict=
+        pool.release)``: evicting an unpinned entry returns its page to
+        the pool, while entries a lane still pins (trie refs > 0) are
+        refused — the reclamation path can never steal pages out from
+        under an active lane."""
+        from veles_tpu.serving import RadixPrefixCache
+        pool = KVPagePool(4, 4)
+        trie = RadixPrefixCache(capacity=8, chunk=4,
+                                on_evict=pool.release)
+        (pa,) = pool.alloc(1)
+        (pb,) = pool.alloc(1)
+        na = trie.insert(trie.root, (1,) * 4, pa)    # pinned by insert
+        nb = trie.insert(na, (2,) * 4, pb)
+        trie.release([nb])                           # b evictable
+        assert pool.free_pages == 2
+        assert trie.evict_one() is True              # drops b → pool
+        assert pool.free_pages == 3
+        assert trie.evict_one() is False             # a still pinned
+        assert pool.free_pages == 3
+        trie.release([na])
+        assert trie.evict_one() is True
+        assert pool.free_pages == 4
+
+
+class TestEngineLifecycle:
+    def test_refcount_release_on_lane_finish(self):
+        """Two shared-prefix requests through a paged engine: while the
+        trie holds the shared chunks their pages stay allocated (refs
+        from the trie), every lane-owned page returns to the free list
+        at finish, and evicting the trie drains the pool back to
+        FULL — no page leaks across the request lifecycle."""
+        from veles_tpu.serving import LMEngine
+        params = _params()
+        rng = numpy.random.RandomState(7)
+        shared = rng.randint(0, 16, 16).tolist()     # 2 full chunks
+        prompts = [shared + rng.randint(0, 16, 3).tolist()
+                   for _ in range(2)]
+        engine = LMEngine(params, n_heads=2, max_len=96, slots=2,
+                          paged_kv=True, prefill_chunk=8,
+                          prefix_cache=16, name="kv_life").start()
+        try:
+            for p in prompts:
+                engine.submit(p, 4).result(timeout=60)
+            pool, trie = engine._pool, engine._trie
+            # only the trie's references remain
+            assert pool.used_pages == trie.size == 2
+            assert pool.pinned_pages == 0            # no active lane
+            while trie.evict_one():
+                pass
+            assert pool.free_pages == pool.num_pages
+        finally:
+            engine.stop()
+
+    def test_hopeless_reservation_keeps_cache_warm(self):
+        """Pool-pressure eviction is bounded by what it can actually
+        reclaim: a reservation that even a FULL trie flush could not
+        cover evicts nothing (the cache stays warm for the lanes that
+        will run), while a reachable one evicts just enough."""
+        from veles_tpu.serving import LMEngine
+        params = _params()
+        engine = LMEngine(params, n_heads=2, max_len=96, slots=1,
+                          paged_kv=4, prefill_chunk=8, prefix_cache=8,
+                          name="kv_warm")
+        pool, trie = engine._pool, engine._trie
+        (pa,) = pool.alloc(1)
+        node = trie.insert(trie.root, (1,) * 8, pa)
+        trie.release([node])             # evictable, page refs=1
+        assert trie.evictable() == 1
+        # free 3 + evictable 1 < 5: hopeless — entry must survive
+        assert engine._alloc_pages(5) is None
+        assert trie.size == 1
+        # free 3 + evictable 1 >= 4: evicts exactly what it needs
+        got = engine._alloc_pages(4)
+        assert got is not None and len(got) == 4
+        assert trie.size == 0
+
+    def test_cow_leaves_shared_page_bit_identical(self):
+        """COPY-ON-WRITE: a lane about to append into a page another
+        referent shares gets a private copy; the original page's rows
+        stay bit-identical for the other referent, the copy starts
+        bit-identical too, and the ref/pin bookkeeping moves the lane
+        (not the sibling) onto the fresh page."""
+        import jax.numpy as jnp
+        from veles_tpu.serving import LMEngine
+        from veles_tpu.serving.lm_engine import _Request, _Slot
+        params = _params()
+        engine = LMEngine(params, n_heads=2, max_len=96, slots=1,
+                          paged_kv=4, prefill_chunk=8, name="kv_cow")
+        pool = engine._pool
+        (p,) = pool.alloc(1)
+        # fill page p with recognizable rows on every block
+        engine._kv_pools = [
+            (kp.at[p].set(float(i + 1)), vp.at[p].set(float(-i - 1)))
+            for i, (kp, vp) in enumerate(engine._kv_pools)]
+        before = [(numpy.asarray(kp[p]), numpy.asarray(vp[p]))
+                  for kp, vp in engine._kv_pools]
+        pool.retain(p)                   # the sibling's reference
+        pool.pin(p)                      # this lane's pin
+        lane = _Slot(_Request([1, 2, 3], 4, 30.0, pages=1))
+        lane.pages = [p]
+        engine._page_tables[0, 0] = p
+        engine._cow_guard(0, lane, 0, 1)
+        q = lane.pages[0]
+        assert q != p and engine._page_tables[0, 0] == q
+        for (kb, vb), (kp_, vp_) in zip(before, engine._kv_pools):
+            numpy.testing.assert_array_equal(kb, numpy.asarray(kp_[p]))
+            numpy.testing.assert_array_equal(vb, numpy.asarray(vp_[p]))
+            numpy.testing.assert_array_equal(kb, numpy.asarray(kp_[q]))
+            numpy.testing.assert_array_equal(vb, numpy.asarray(vp_[q]))
+        assert pool.refs(p) == 1 and not pool.pinned(p)   # sibling's
+        assert pool.refs(q) == 1 and pool.pinned(q)       # the lane's
+        assert engine.metrics.counter("kv_cow_copies") == 1
+        # a second write into the now-exclusive page copies nothing
+        engine._cow_guard(0, lane, 1, 2)
+        assert engine.metrics.counter("kv_cow_copies") == 1
+
+    @pytest.mark.slow
+    def test_sustained_pool_churn_no_leaks(self):
+        """SLOW: sustained pool-stress — 32 mixed-length requests
+        (some sharing a prefix, some unique) churn through a pool far
+        smaller than their total demand, with trie eviction reclaiming
+        pages throughout.  Every request completes exactly greedy, and
+        the pool drains back to FULL once the trie is emptied — no
+        page leaks under sustained pressure."""
+        import jax
+        import jax.numpy as jnp
+        from veles_tpu.ops.transformer import generate
+        from veles_tpu.serving import LMEngine
+        params = _params()
+        rng = numpy.random.RandomState(11)
+        shared = rng.randint(0, 16, 16).tolist()
+        prompts = []
+        for i in range(32):
+            tail = rng.randint(0, 16, rng.randint(1, 24)).tolist()
+            prompts.append((shared + tail) if i % 2 else tail)
+        expected = [numpy.asarray(generate(
+            params, jnp.asarray([p], jnp.int32), 6, 2,
+            temperature=0.0, max_len=96))[0] for p in prompts]
+        from veles_tpu.serving import PoolExhausted
+        engine = LMEngine(params, n_heads=2, max_len=96, slots=4,
+                          paged_kv=10, prefill_chunk=8, prefix_cache=4,
+                          queue_depth=64, deadline_s=120.0,
+                          name="kv_churn").start()
+        try:
+            futures = []
+            for p in prompts:
+                # closed-loop client: honor the 429's Retry-After when
+                # the backlog bound trips (the stress IS the point)
+                for _ in range(400):
+                    try:
+                        futures.append(engine.submit(p, 6))
+                        break
+                    except PoolExhausted as e:
+                        time.sleep(min(e.retry_after, 0.05))
+                else:
+                    raise AssertionError("submit never admitted")
+            for p, f, exp in zip(prompts, futures, expected):
+                got = numpy.concatenate([p, f.result(timeout=300)])
+                numpy.testing.assert_array_equal(got, exp)
+            pool, trie = engine._pool, engine._trie
+            assert pool.pinned_pages == 0
+            assert pool.used_pages == trie.size <= 4
+            while trie.evict_one():
+                pass
+            assert pool.free_pages == pool.num_pages
+        finally:
+            engine.stop()
+
+    def test_pool_exhaustion_sheds_503_never_hangs(self):
+        """A request queued on pool pressure whose pages never free in
+        time sheds DeadlineExceeded (503) at its deadline — it does not
+        wedge the queue, and the lane holding the pool finishes
+        normally."""
+        from veles_tpu.serving import DeadlineExceeded, LMEngine
+        params = _params()
+        engine = LMEngine(params, n_heads=2, max_len=96, slots=2,
+                          paged_kv=3, prefill_chunk=8, deadline_s=1.0,
+                          name="kv_shed").start()
+        real_step = engine._step_jit
+
+        def slow_step(*a):
+            time.sleep(0.08)
+            return real_step(*a)
+
+        engine._step_jit = slow_step
+        try:
+            # A takes all 3 pages and decodes ~2.6s; B (3 pages) can
+            # only wait — its 1s deadline fires first
+            fut_a = engine.submit(list(range(1, 9)), 16)
+            fut_b = engine.submit(list(range(2, 10)), 16)
+            with pytest.raises(DeadlineExceeded):
+                fut_b.result(timeout=30)
+            assert len(fut_a.result(timeout=60)) == 16
+            assert engine.metrics.snapshot()["shed"] == 1
+            assert engine._pool.free_pages == engine._pool.num_pages
+        finally:
+            engine._step_jit = real_step
+            engine.stop()

@@ -13,9 +13,22 @@ numbers docs/PERF.md records:
   -friendly shape: templated text, code, logs), measuring decode
   dispatches per generated token and tokens/s.
 
+The PAGED KV legs (ISSUE 6) toggle ``paged_kv`` against the same
+workloads plus a ``mixed_length`` one, and report the memory facts:
+KV bytes resident, row copies performed on prefix hits (ZERO on the
+paged path — asserted, not just reported), pages served by reference,
+and — the acceptance headline — the lane count achievable at FIXED KV
+memory on a mixed-length prompt distribution vs the contiguous layout
+(``fixed_kv_memory``: same bytes, ≥2× the lanes).
+
 Every leg ALSO asserts its outputs bit-identical to the direct greedy
 ``ops/transformer.py::generate`` — a fast path that changed tokens
 would be a bug, not a speedup, so the bench refuses to report it.
+
+A full summary JSON line (``summary_record`` — the same record shape
+as ``bench.py``) streams to stdout after EVERY completed leg,
+last-line-wins: a tunneled TPU run killed by the outer watchdog still
+banks a parseable record (the BENCH_r04/r05 failure mode).
 
 Standalone (CPU is fine; the dispatches/token and hit-rate evidence is
 platform-independent, wall-clock numbers scale with the platform)::
@@ -67,6 +80,15 @@ def repetitive_prompts(n, vocab, length, seed=3):
     return out
 
 
+def mixed_length_prompts(n, vocab, lo, hi, seed=13):
+    """Lengths spread uniformly across [lo, hi] — the distribution
+    where per-lane paging pays: a contiguous layout charges every one
+    of these the worst case, a paged one only its own span."""
+    rng = numpy.random.RandomState(seed)
+    return [rng.randint(0, vocab, int(length)).tolist()
+            for length in rng.randint(lo, hi + 1, n)]
+
+
 def expected_rows(params, prompts, n_new, n_heads, max_len):
     import jax.numpy as jnp
     from veles_tpu.ops.transformer import generate
@@ -114,6 +136,15 @@ def run_leg(params, n_heads, max_len, prompts, n_new, expect,
         cc, c = cold["counters"], warm["counters"]
         tokens = c.get("tokens_out", 0)
         dispatches = c.get("decode_dispatches", 0)
+        if engine_kw.get("paged_kv"):
+            # the paged layout has NO row-copy install path — a prefix
+            # hit is a page reference; any copy counted here is a bug
+            if cc.get("kv_row_copies", 0) or c.get("kv_row_copies", 0):
+                raise AssertionError(
+                    "paged leg performed %d KV row copies under %r — "
+                    "prefix hits must be page references"
+                    % (cc.get("kv_row_copies", 0)
+                       + c.get("kv_row_copies", 0), engine_kw))
         return {
             "features": {k: v for k, v in engine_kw.items() if v},
             "requests": len(prompts),
@@ -132,16 +163,72 @@ def run_leg(params, n_heads, max_len, prompts, n_new, expect,
                 round(c["draft_accepted"] / c["draft_tokens"], 3)
                 if c.get("draft_tokens") else None),
             "ttft_mean_s": round(warm["ttft"]["mean"], 5),
+            # paged-KV memory facts (contiguous legs report them too,
+            # for the side-by-side): device KV footprint, row copies
+            # paid installing prefix hits (cold pass — 0 when paged),
+            # pages served by reference, copy-on-write count, and the
+            # peak concurrent lanes the layout actually sustained
+            "kv_bytes_resident": engine.kv_bytes_resident(),
+            "kv_row_copies": cc.get("kv_row_copies", 0),
+            "kv_pages_referenced": cc.get("kv_pages_referenced", 0),
+            "kv_cow_copies": (cc.get("kv_cow_copies", 0)
+                              + c.get("kv_cow_copies", 0)),
+            "slots_busy_peak": int(warm["gauges"].get(
+                "slots_busy_peak", 0)),
             "parity_vs_generate": True,     # asserted above, both passes
         }
     finally:
         engine.stop()
 
 
+def fixed_kv_memory_comparison(params, n_heads, max_len, chunk, n_new,
+                               vocab, budget_slots=4, requests=16):
+    """ACCEPTANCE leg: the SAME mixed-length workload through (a) the
+    contiguous layout sized to ``budget_slots`` worst-case lanes and
+    (b) a paged pool of EXACTLY the same KV bytes
+    (``budget_slots·max_len/chunk`` pages) — reporting the lane count
+    each layout sustains.  The contiguous layout is structurally capped
+    at ``budget_slots``; the paged pool turns the headroom between the
+    mixed lengths and the worst case into extra concurrent lanes."""
+    lo, hi = max(4, chunk // 2), max(chunk, (max_len - n_new) // 2)
+    prompts = mixed_length_prompts(requests, vocab, lo, hi)
+    expect = expected_rows(params, prompts, n_new, n_heads, max_len)
+    contig = run_leg(params, n_heads, max_len, prompts, n_new, expect,
+                     slots=budget_slots)
+    # -1: the reserved scratch page counts against the byte budget, so
+    # both layouts hold EXACTLY budget_slots·max_len KV rows per block
+    pool_pages = budget_slots * max_len // chunk - 1
+    paged = run_leg(params, n_heads, max_len, prompts, n_new, expect,
+                    slots=min(requests, pool_pages),
+                    paged_kv=pool_pages, prefill_chunk=chunk)
+    ratio = paged["slots_busy_peak"] / float(budget_slots)
+    return {
+        "budget_slots_contiguous": budget_slots,
+        "kv_bytes_contiguous": contig["kv_bytes_resident"],
+        "kv_bytes_paged": paged["kv_bytes_resident"],
+        "pool_pages": pool_pages,
+        "prompt_lengths": sorted(len(p) for p in prompts),
+        "slots_peak_contiguous": contig["slots_busy_peak"],
+        "slots_peak_paged": paged["slots_busy_peak"],
+        "slots_ratio_vs_contiguous": round(ratio, 2),
+        "contiguous": contig,
+        "paged": paged,
+    }
+
+
+def bench_max_len(smoke):
+    """THE bench max_len — main()'s --chunk divisibility pre-check and
+    run_bench() must read the same value, or the check validates a
+    geometry the run doesn't use."""
+    return 128 if smoke else 256
+
+
 def run_bench(smoke=False, slots=4, chunk=16, cache=256, spec_k=4,
-              n_new=32, requests=8, vocab=32, max_len=256):
+              n_new=32, requests=8, vocab=32, max_len=None):
+    if max_len is None:
+        max_len = bench_max_len(smoke)
     if smoke:
-        n_new, requests, max_len = 8, 4, 128
+        n_new, requests = 8, 4
     params = build_params(vocab=vocab, max_len=max_len)
     n_heads = 4
     feature_sets = {
@@ -151,6 +238,12 @@ def run_bench(smoke=False, slots=4, chunk=16, cache=256, spec_k=4,
         "spec": {"spec_k": spec_k},
         "all": {"prefix_cache": cache, "prefill_chunk": chunk,
                 "spec_k": spec_k},
+        # ISSUE 6: the paged KV pool, alone and under the full fast
+        # path — same workloads, so the row-copy and footprint columns
+        # read off directly against the contiguous legs above
+        "paged": {"paged_kv": True, "prefill_chunk": chunk},
+        "paged_all": {"paged_kv": True, "prefix_cache": cache,
+                      "prefill_chunk": chunk, "spec_k": spec_k},
     }
     # workload A: shared system prompt (load_gen's generator — one
     # request per "client", every prompt shares the prefix)
@@ -162,30 +255,50 @@ def run_bench(smoke=False, slots=4, chunk=16, cache=256, spec_k=4,
     # workload B: repetitive text (prompt-lookup's home turf)
     rep = repetitive_prompts(requests, vocab,
                              min(48, max_len - n_new - spec_k - 1))
+    # workload C: mixed lengths (where per-lane paging pays)
+    mixed = mixed_length_prompts(
+        requests, vocab, max(4, chunk // 2),
+        max(chunk, (max_len - n_new - spec_k - 1) // 2))
     results = {"model": {"vocab": vocab, "d_model": 64, "n_layers": 2,
                          "max_len": max_len},
                "slots": slots, "n_new": n_new,
                "workloads": {}}
+
+    def stream_summary():
+        """Bank everything completed so far as ONE stdout JSON line —
+        an outer watchdog kill keeps the last one (the bench.py
+        per-leg streaming discipline)."""
+        record, _ = summary_record(results)
+        print(json.dumps(record), flush=True)
+
     # the single-lane repetitive workload ISOLATES speculation: with
     # one slot the baseline is exactly 1 dispatch/token, so any value
     # below 1 is the draft acceptance and nothing else (multi-slot
     # continuous batching is already sub-1 across lanes)
     for wname, prompts, wslots in (
             ("shared_prefix", shared, slots),
+            ("mixed_length", mixed, slots),
             ("repetitive", rep, slots),
             ("repetitive_single_lane", rep[:max(2, requests // 2)], 1)):
         expect = expected_rows(params, prompts, n_new, n_heads, max_len)
-        legs = {}
+        legs = results["workloads"].setdefault(wname, {})
         for fname, kw in feature_sets.items():
             legs[fname] = run_leg(params, n_heads, max_len, prompts,
                                   n_new, expect, slots=wslots, **kw)
             print("%s/%s: %s" % (wname, fname, json.dumps(legs[fname])),
                   file=sys.stderr)
-        results["workloads"][wname] = legs
+            stream_summary()
+    # the fixed-KV-memory acceptance leg: same bytes, how many lanes?
+    results["fixed_kv_memory"] = fixed_kv_memory_comparison(
+        params, n_heads, max_len, chunk, n_new, vocab,
+        budget_slots=2 if smoke else 4, requests=requests * 2)
+    stream_summary()
     # headline facts the acceptance criteria name
     lane1 = results["workloads"]["repetitive_single_lane"]
     sp_cache = results["workloads"]["shared_prefix"]["prefix_cache"]
+    sp_paged = results["workloads"]["shared_prefix"]["paged_all"]
     sp_base = results["workloads"]["shared_prefix"]["baseline"]
+    fixed = results["fixed_kv_memory"]
     results["headline"] = {
         "dispatches_per_token_plain_single_lane":
             lane1["baseline"]["dispatches_per_token"],
@@ -197,8 +310,66 @@ def run_bench(smoke=False, slots=4, chunk=16, cache=256, spec_k=4,
         "prefill_flops_saved_frac": round(
             1 - sp_cache["prefill_tokens"]
             / max(sp_base["prefill_tokens"], 1), 3),
+        # ISSUE 6: zero-copy prefix sharing + fixed-memory lane count
+        "kv_row_copies_contiguous_shared_prefix":
+            sp_cache["kv_row_copies"],
+        "kv_row_copies_paged_shared_prefix": sp_paged["kv_row_copies"],
+        "kv_pages_referenced_shared_prefix":
+            sp_paged["kv_pages_referenced"],
+        "slots_at_fixed_kv_memory_ratio":
+            fixed["slots_ratio_vs_contiguous"],
     }
     return results
+
+
+def summary_record(results):
+    """Build (record, exit_code) for the driver's summary JSON line —
+    same shape as ``bench.py::summary_record`` (metric/value/unit/
+    vs_baseline/configs), with the metric-selection priority in ONE
+    place so the per-leg partial stream and the final emit can never
+    disagree: the fixed-KV-memory slot ratio once that leg has run
+    (the ISSUE 6 acceptance headline), any paged shared-prefix leg's
+    zero-row-copy fact before that, tokens/s of the newest completed
+    leg as the early-partial fallback."""
+    fixed = results.get("fixed_kv_memory") or {}
+    if fixed.get("slots_ratio_vs_contiguous") is not None:
+        return {
+            "metric": "lm_paged_slots_at_fixed_kv_memory_ratio",
+            "value": fixed["slots_ratio_vs_contiguous"],
+            "unit": "x_vs_contiguous",
+            "vs_baseline": 1.0,
+            "configs": results,
+        }, 0
+    workloads = results.get("workloads") or {}
+    paged_sp = (workloads.get("shared_prefix") or {}).get("paged_all") \
+        or (workloads.get("shared_prefix") or {}).get("paged")
+    if paged_sp is not None:
+        return {
+            "metric": "lm_paged_shared_prefix_kv_row_copies",
+            "value": paged_sp["kv_row_copies"],
+            "unit": "rows",
+            "vs_baseline": None,
+            "configs": results,
+        }, 0
+    latest = None
+    for legs in workloads.values():
+        for leg in legs.values():
+            latest = leg
+    if latest is not None:
+        return {
+            "metric": "lm_fastpath_tokens_per_sec",
+            "value": latest["tokens_per_sec"],
+            "unit": "tokens/sec",
+            "vs_baseline": None,
+            "configs": results,
+        }, 0
+    return {
+        "metric": "lm_fastpath_no_legs_completed",
+        "value": None,
+        "unit": None,
+        "vs_baseline": None,
+        "configs": results,
+    }, 1
 
 
 def main(argv=None):
@@ -217,16 +388,30 @@ def main(argv=None):
     parser.add_argument("--json", default=None, metavar="FILE",
                         help="also write the record here")
     args = parser.parse_args(argv)
+    max_len = bench_max_len(args.smoke)
+    if args.chunk < 1 or max_len % args.chunk:
+        # the paged legs run unconditionally and LMEngine requires the
+        # page size (= chunk) to divide max_len — refuse up front
+        # instead of crashing mid-run with the summary unwritten
+        parser.error("--chunk %d must divide max_len %d (paged legs)"
+                     % (args.chunk, max_len))
+    if args.spec_k and args.spec_k + 1 > args.chunk:
+        # same up-front rule for the combined legs: LMEngine requires
+        # the verify span (spec_k + 1) to fit in one chunk
+        parser.error("--spec-k %d + 1 must fit in --chunk %d "
+                     "(the combined 'all'/'paged_all' legs)"
+                     % (args.spec_k, args.chunk))
     results = run_bench(smoke=args.smoke, slots=args.slots,
                         chunk=args.chunk, cache=args.cache,
                         spec_k=args.spec_k, n_new=args.n_new,
-                        requests=args.requests)
-    line = json.dumps(results)
-    print(line)
+                        requests=args.requests, max_len=max_len)
+    record, rc = summary_record(results)
+    line = json.dumps(record)
+    print(line)                  # final full record — last line wins
     if args.json:
         with open(args.json, "w", encoding="utf-8") as f:
             f.write(line + "\n")
-    return 0
+    return rc
 
 
 if __name__ == "__main__":

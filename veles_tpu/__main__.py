@@ -196,6 +196,22 @@ def build_argparser():
                              "tokens/dispatch on repetitive text, "
                              "output bit-identical to greedy); 0 = "
                              "one token per dispatch")
+    parser.add_argument("--serve-paged-kv", type=int, default=0,
+                        metavar="PAGES",
+                        help="with --serve-slots: paged KV cache — "
+                             "store decode KV in PAGES fixed-size "
+                             "pages (page = the prefill chunk; "
+                             "max_len must divide by it) shared by "
+                             "every lane through per-lane page "
+                             "tables; prefix-cache hits become "
+                             "zero-copy page references and slot "
+                             "count stops being bounded by "
+                             "slots*max_len memory (output still "
+                             "bit-identical to greedy); -1 = size "
+                             "the pool to the contiguous footprint "
+                             "(slots * max_len / chunk pages, + the "
+                             "reserved scratch page); 0 = "
+                             "contiguous KV")
     return parser
 
 
@@ -385,7 +401,9 @@ def main(argv=None):
             api = serve_lm(wf, port=args.serve, slots=args.serve_slots,
                            prefix_cache=args.serve_prefix_cache,
                            prefill_chunk=args.serve_prefill_chunk,
-                           spec_k=args.serve_spec_k)
+                           spec_k=args.serve_spec_k,
+                           paged_kv=(True if args.serve_paged_kv < 0
+                                     else args.serve_paged_kv))
         else:
             api = RESTfulAPI(
                 wf, normalizer=getattr(wf.loader, "normalizer", None))

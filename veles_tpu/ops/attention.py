@@ -77,6 +77,19 @@ def rope_rotate(x, positions, theta=10000.0):
                             x2 * cos + x1 * sin], axis=-1)
 
 
+def rope_rotate_batched(x, positions, theta=10000.0):
+    """:func:`rope_rotate` with PER-SEQUENCE positions — x
+    (batch, heads, c, head_dim) with ``positions`` (batch, c), each
+    batch row rotated at its own (traced) positions.  The paged decode
+    path needs this: every lane in the batched step sits at a different
+    depth, so one shared (seq,) position vector cannot serve them.
+    THE contiguous math, vmapped — not a reimplementation, so the two
+    paths cannot drift (the parity suite pins the combination end to
+    end)."""
+    return jax.vmap(lambda xi, pi: rope_rotate(xi, pi, theta))(
+        x, positions)
+
+
 def band_bias(q_pos, k_pos, causal, window, dtype, sinks=0):
     """Additive score bias for the global-position causal/sliding-window
     band — THE shared mask the dense, blockwise and ring decompositions
@@ -432,3 +445,93 @@ def mha_decode_step(params, x, k_cache, v_cache, pos, n_heads,
         live &= in_window
     return _decode_attend(params, x, k_cache, v_cache, pos, live,
                           pos if rope else None, n_heads)
+
+
+# ------------------------------------------------------------- paged KV
+def paged_view(pool, ptab):
+    """Gather a lane's LINEAR cache view out of the shared page pool.
+
+    pool: (n_pages, kv_heads, page, head_dim) — ONE region shared by
+    every lane; ptab: (..., m) int32 page table mapping lane-local page
+    j to its pool row.  Returns (..., kv_heads, m·page, head_dim) — the
+    exact array a contiguous per-lane cache would hold, so the
+    attention math downstream is the contiguous math unchanged (the
+    indirection-tolerance argument of Flex-TPU: reconfigure the
+    dataflow, keep the kernel).  Table entries past a lane's allocated
+    pages point at the reserved scratch page; the caller's live mask
+    must cover them (it does: live positions never exceed the lane's
+    reservation)."""
+    g = pool[ptab]                       # (..., m, kv, page, dh)
+    g = jnp.moveaxis(g, -4, -3)          # (..., kv, m, page, dh)
+    return g.reshape(g.shape[:-3] + (g.shape[-3] * g.shape[-2],
+                                     g.shape[-1]))
+
+
+def paged_write(pool, ptab, pos, rows):
+    """Scatter ``c`` new K (or V) rows into the pool at the lanes'
+    LINEAR positions [pos, pos+c) — the paged sibling of the contiguous
+    ``dynamic_update_slice`` write.
+
+    rows: (..., kv_heads, c, head_dim); ptab (..., m); pos (...,) —
+    leading dims are the lane batch (absent for a single lane).  Each
+    position p maps to (page ptab[p // page], offset p % page), so a
+    write may straddle two pages; the scatter handles that uniformly.
+    Duplicate targets (every free lane parks on the scratch page) are
+    resolved arbitrarily — by construction only garbage rows collide,
+    and nothing live ever attends them."""
+    page = pool.shape[2]
+    c = rows.shape[-2]
+    linear = jnp.asarray(pos)[..., None] + jnp.arange(c)   # (..., c)
+    page_ids = jnp.take_along_axis(ptab, linear // page, axis=-1)
+    offsets = linear % page
+    # advanced indices split by the head slice: index dims move to the
+    # front (numpy rules), so the update is (..., c, kv, dh)
+    return pool.at[page_ids, :, offsets, :].set(
+        jnp.moveaxis(rows, -3, -2))
+
+
+def mha_paged_chunk_step(params, x, k_pool, v_pool, ptab, pos, n_heads,
+                         rope=False, window=None, sinks=0):
+    """``c`` positions per lane against the PAGED KV pool in one pass —
+    :func:`mha_chunk_step` with the storage indirected through a page
+    table, batched over lanes (each at its own traced ``pos``).
+
+    x: (b, c, d_model) — b lanes' activations for their positions
+    [pos[i], pos[i]+c); k_pool/v_pool: (n_pages, kv_heads, page,
+    head_dim) shared across lanes; ptab: (b, m) per-lane page tables;
+    pos: (b,) traced.  Writes the c new K/V rows through the table and
+    attends each lane's query j causally over its own linear view
+    (window/sinks exactly as :func:`chunk_live_mask`).  At c=1 this is
+    the paged decode step; at c=k+1 the paged speculative verify; with
+    b=1, c=chunk the paged prefill chunk — ONE core, so the paged
+    decompositions can never drift from each other.  The gathered view
+    has the same (kv, m·page, dh) shape for every lane, so with
+    m·page == max_len the scores matrix is shape-identical to the
+    contiguous path and greedy outputs stay bit-identical."""
+    b, c, d = x.shape
+    dh = d // n_heads
+    kv = kv_heads_of(params, n_heads, d)
+
+    def split(w, heads):
+        return matmul(x, w).reshape(b, c, heads, dh).transpose(0, 2, 1, 3)
+
+    q = split(params["wq"], n_heads)            # (b, h, c, dh)
+    k_new = split(params["wk"], kv)
+    if rope:
+        positions = jnp.asarray(pos)[:, None] + jnp.arange(c)   # (b, c)
+        q = rope_rotate_batched(q, positions)
+        k_new = rope_rotate_batched(k_new, positions)
+    k_pool = paged_write(k_pool, ptab, pos, k_new)
+    v_pool = paged_write(v_pool, ptab, pos, split(params["wv"], kv))
+    kx = paged_view(k_pool, ptab)               # (b, kv, L, dh)
+    vx = paged_view(v_pool, ptab)
+    scores = matmul(q, jnp.swapaxes(_repeat_kv(kx, n_heads),
+                                    -1, -2)) / jnp.sqrt(
+        jnp.asarray(dh, q.dtype))               # (b, h, c, L)
+    live = jax.vmap(lambda p: chunk_live_mask(
+        p, c, kx.shape[-2], window, sinks))(jnp.asarray(pos))
+    scores = jnp.where(live[:, None, :, :], scores, NEG_INF)
+    o = matmul(jax.nn.softmax(scores, axis=-1),
+               _repeat_kv(vx, n_heads))
+    o = o.transpose(0, 2, 1, 3).reshape(b, c, d)
+    return matmul(o, params["wo"]), k_pool, v_pool

@@ -317,6 +317,63 @@ def chunk_apply(params, tokens, caches, pos, n_heads, rope=False,
     return h, new_caches
 
 
+def block_paged_chunk_step(blk, h, k_pool, v_pool, ptab, pos, n_heads,
+                           rope=False, window=None, sinks=0):
+    """One block over ``c`` positions per lane against the PAGED KV
+    pool — :func:`block_chunk_step` with storage indirected through a
+    per-lane page table (``attention.mha_paged_chunk_step`` core), and
+    batched over lanes so decode/verify advance every lane in ONE
+    dispatch without vmapping the shared pool."""
+    from veles_tpu.ops.attention import mha_paged_chunk_step
+    hn = _layernorm(h, blk["ln1"]["g"], blk["ln1"]["b"])
+    attn, k_pool, v_pool = mha_paged_chunk_step(
+        blk["attn"], hn, k_pool, v_pool, ptab, pos, n_heads, rope=rope,
+        window=window, sinks=sinks)
+    h = h + attn
+    hn = _layernorm(h, blk["ln2"]["g"], blk["ln2"]["b"])
+    return h + _block_ffn(blk, hn), k_pool, v_pool
+
+
+def paged_chunk_embed(params, tokens, pos):
+    """Token (+ positional, absent under RoPE) embedding for ``c``
+    positions per lane starting at PER-LANE traced ``pos`` (b,) —
+    :func:`chunk_embed` generalized to the batched paged step, where
+    every lane sits at its own depth.  Positional rows are gathered
+    (clipped at the table edge — only a tail chunk's pad positions can
+    exceed it, and their outputs are never read)."""
+    import jax.numpy as jnp
+    c = tokens.shape[1]
+    h = jnp.take(params["embed"], tokens, axis=0)
+    if "pos" in params:
+        idx = jnp.asarray(pos)[:, None] + jnp.arange(c)      # (b, c)
+        h = h + jnp.take(params["pos"], idx, axis=0)
+    return h
+
+
+def paged_chunk_apply(params, tokens, pools, ptab, pos, n_heads,
+                      rope=False, window=None, sinks=0):
+    """Run ``c`` consecutive tokens PER LANE through the whole stack
+    against the paged KV pools in one pass — :func:`chunk_apply` with
+    (pools, page table) in place of per-lane contiguous caches.
+
+    tokens: (b, c) int32; pools: per-block [(k_pool, v_pool)] each
+    (n_pages, kv_heads, page, head_dim); ptab: (b, m); pos: (b,)
+    traced.  Returns (h (b, c, d), pools) with each lane's K/V written
+    through its table at [pos, pos+c).  Serves ALL THREE paged shapes —
+    prefill chunk (b=1, c=chunk), decode step (c=1, b=slots),
+    speculative verify (c=k+1, b=slots) — so one function carries the
+    whole paged fast path and position j's hidden state equals the
+    contiguous path's bit for bit."""
+    h = paged_chunk_embed(params, tokens, pos)
+    new_pools = []
+    for blk, (kp, vp) in zip(params["blocks"], pools):
+        h, kp, vp = block_paged_chunk_step(blk, h, kp, vp, ptab, pos,
+                                           n_heads, rope=rope,
+                                           window=window, sinks=sinks)
+        new_pools.append((kp, vp))
+    return h, new_pools
+
+
 def _make_sampler(greedy, top_k, temperature):
     """Token sampler shared by the full-cache and rolling decoders (the
     top-k tie rule and traced-temperature handling must never drift

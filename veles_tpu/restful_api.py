@@ -285,7 +285,7 @@ class RESTfulAPI(Logger):
 def serve_lm(workflow, host="127.0.0.1", port=8180, max_new=256,
              slots=0, queue_depth=64, deadline_s=30.0,
              prefix_cache=0, prefill_chunk=0, spec_k=0,
-             queue_tokens=0):
+             queue_tokens=0, paged_kv=0):
     """Serve a trained transformer-trainer workflow (e.g. char_lm) for
     autoregressive continuation: POST ``{"input": [[tok, ...]],
     "n_new": N, "temperature": T, "top_k": K, "seed": S}`` to
@@ -306,8 +306,14 @@ def serve_lm(workflow, host="127.0.0.1", port=8180, max_new=256,
     prompts as C-token chunks interleaved with decode, ``spec_k=K``
     enables prompt-lookup speculative decoding (several tokens per
     dispatch on repetitive text), ``queue_tokens=T`` budgets admission
-    by queued prompt tokens.  All preserve bit-identical greedy output;
-    see ``veles_tpu/serving/lm_engine.py``.
+    by queued prompt tokens, and ``paged_kv=N`` (ISSUE 6) switches KV
+    storage to N fixed-size pages (page = ``prefill_chunk`` tokens,
+    requires ``max_len`` divisible by it; ``True`` sizes the pool to
+    the contiguous footprint) behind per-lane page tables — lanes
+    reserve only their own span, prefix hits are zero-copy page
+    references with copy-on-write, and a request the pool cannot place
+    queues or sheds (429/503) instead of wedging.  All preserve
+    bit-identical greedy output; see ``veles_tpu/serving/lm_engine.py``.
 
     The direct path decodes one prompt batch at a time via the
     KV-cached ``transformer.generate``, one jitted dispatch per
@@ -347,6 +353,7 @@ def serve_lm(workflow, host="127.0.0.1", port=8180, max_new=256,
             queue_depth=queue_depth, deadline_s=deadline_s,
             prefix_cache=prefix_cache, prefill_chunk=prefill_chunk,
             spec_k=spec_k, queue_tokens=queue_tokens,
+            paged_kv=paged_kv,
             metrics=metrics_mod.new("lm")).start()
 
     def handler(request):

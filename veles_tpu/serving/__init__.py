@@ -14,6 +14,12 @@ request, this package amortizes dispatch across concurrent clients.
   cache (greedy path bit-identical to ``ops.transformer.generate``),
   plus the ISSUE 4 fast path: :class:`RadixPrefixCache` prompt-KV
   reuse, chunked prefill, and prompt-lookup speculative decoding.
+- :mod:`veles_tpu.serving.kv_pool` — :class:`KVPagePool`: the paged
+  KV-cache allocator (ISSUE 6).  ``LMEngine(paged_kv=N)`` stores KV in
+  fixed-size pages from one global pool behind per-lane page tables;
+  prefix-cache hits become zero-copy page references (ref-counts +
+  copy-on-write), and slot count is bounded by the pool, not by
+  ``slots × max_len``.
 - :mod:`veles_tpu.serving.metrics` — :class:`ServingMetrics`:
   lock-cheap counters/histograms (queue wait, batch size, latency
   percentiles, shed/429, slot occupancy) with a snapshot API and a
@@ -26,13 +32,16 @@ through here when asked (``RESTfulAPI.enable_batching``, ``serve_lm``'s
 """
 
 from veles_tpu.serving.batcher import (DeadlineExceeded, MicroBatcher,
-                                       Overloaded, batch_buckets)
+                                       Overloaded, PoolExhausted,
+                                       batch_buckets)
+from veles_tpu.serving.kv_pool import KVPagePool
 from veles_tpu.serving.lm_engine import (LMEngine, RadixPrefixCache,
                                          prompt_bucket, propose_draft)
 from veles_tpu.serving.metrics import (ServingMetrics, get,
                                        render_prometheus)
 
 __all__ = ["MicroBatcher", "LMEngine", "RadixPrefixCache",
-           "ServingMetrics", "Overloaded", "DeadlineExceeded",
-           "batch_buckets", "prompt_bucket", "propose_draft", "get",
+           "KVPagePool", "ServingMetrics", "Overloaded",
+           "DeadlineExceeded", "PoolExhausted", "batch_buckets",
+           "prompt_bucket", "propose_draft", "get",
            "render_prometheus"]

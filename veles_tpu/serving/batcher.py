@@ -44,6 +44,27 @@ class Overloaded(RuntimeError):
         self.retry_after = retry_after
 
 
+class PoolExhausted(Overloaded):
+    """Admission refused on RESOURCE pressure, not queue length: the
+    paged KV pool (serving/kv_pool.py) cannot cover the page demand
+    already queued ahead of this request, so admitting it would only
+    let it sit until its deadline.  An :class:`Overloaded` subclass —
+    upstream handlers serve the same HTTP 429 + ``Retry-After`` — but
+    distinguishable, so clients and tests can tell "queue full" from
+    "KV memory full".  A request admitted BEFORE the pool tightened
+    still queues (the engine retries its page reservation every tick)
+    and sheds 503 at its deadline: pressure never wedges a lane."""
+
+    def __init__(self, needed, budget, retry_after=0.25):
+        RuntimeError.__init__(
+            self, "kv page pool exhausted: request needs %d pages but "
+                  "the queued demand already covers the %d-page budget; "
+                  "retry after %.3fs" % (needed, budget, retry_after))
+        self.needed = needed
+        self.budget = budget
+        self.retry_after = retry_after
+
+
 class DeadlineExceeded(RuntimeError):
     """Request spent longer than its deadline queued (serve as 503)."""
 

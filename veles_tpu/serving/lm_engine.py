@@ -44,13 +44,41 @@ optimizations, each preserving the greedy contract below:
   repetitive or structured text — while a full miss still yields the
   one greedy token a plain step would have.
 
+The PAGED KV CACHE (ISSUE 6, ``paged_kv=N``) replaces the contiguous
+per-slot KV region with fixed-size PAGES (page = ``prefill_chunk``
+tokens) drawn from one global pool per block, indexed through a
+per-lane page table (``ops/attention.py::paged_view``/``paged_write``;
+allocator in ``serving/kv_pool.py``):
+
+- a lane RESERVES only the pages its own ``len(prompt) + n_new +
+  spec_k`` span needs, so slot count is bounded by the POOL, not by
+  ``slots × max_len`` — lanes of wildly different lengths share one
+  region and the mixed-length bench fits ≥2× the lanes in the same KV
+  bytes;
+- prefix-cache hits become page REFERENCES: the trie stores page ids,
+  a hit bumps a ref-count and writes the id into the lane's table —
+  zero device copies, zero dispatches (the contiguous path's row-copy
+  install is metered as ``kv_row_copies`` for contrast, and stays);
+- appends into a SHARED page copy-on-write first (one page-copy
+  dispatch; the other referents keep bit-identical rows) — structurally
+  rare, because shared pages are exactly full prompt chunks and lanes
+  append past their prompt;
+- a request whose reservation cannot be met QUEUES (its page demand is
+  re-tried every tick, after pressing the prefix cache to drop
+  unpinned entries) and sheds 503 at its deadline; a backlog already
+  covering the whole pool rejects new arrivals with
+  ``PoolExhausted`` (HTTP 429) — pool pressure never wedges a lane.
+
 Decoding is GREEDY (temperature 0) — bit-identical to
 ``ops/transformer.py::generate`` for the same prompt WHATEVER fast-path
 combination is enabled, which is the serving contract (sampled
 requests fall back to the direct path upstream).  Compile count is
 bounded: one step program, one prefill program per prompt bucket, one
 install program, plus (fast path) one chunk-prefill program, one
-chunk-install/extract pair, and one verify program per (engine) ``k``.
+chunk-install/extract pair, and one verify program per (engine) ``k``;
+paged mode compiles one chunk, one step, one verify and one page-copy
+program TOTAL (the page-table indirection is traced data, never a
+shape).
 """
 
 from __future__ import annotations
@@ -63,15 +91,17 @@ from concurrent.futures import Future
 import numpy
 
 from veles_tpu.logger import Logger
-from veles_tpu.serving.batcher import DeadlineExceeded, Overloaded
+from veles_tpu.serving.batcher import (DeadlineExceeded, Overloaded,
+                                       PoolExhausted)
+from veles_tpu.serving.kv_pool import KVPagePool
 from veles_tpu.serving.metrics import ServingMetrics
 
 
 class _Request:
     __slots__ = ("prompt", "true_len", "n_new", "future", "t_enq",
-                 "deadline", "cancelled")
+                 "deadline", "cancelled", "pages")
 
-    def __init__(self, prompt, n_new, deadline_s):
+    def __init__(self, prompt, n_new, deadline_s, pages=0):
         self.prompt = prompt          # (s,) int32, unpadded
         self.true_len = len(prompt)
         self.n_new = n_new
@@ -80,13 +110,15 @@ class _Request:
         self.t_enq = time.monotonic()
         self.deadline = self.t_enq + deadline_s
         self.cancelled = False
+        #: paged mode: worst-case page demand (admission reservation)
+        self.pages = pages
 
 
 class _Slot:
     """Host-side lane state; device state lives in the shared caches."""
 
     __slots__ = ("request", "emitted", "remaining", "pending", "pinned",
-                 "cursor")
+                 "cursor", "pages")
 
     def __init__(self, request):
         self.request = request
@@ -99,6 +131,9 @@ class _Slot:
         #: trie node of the last matched/inserted chunk (None once the
         #: cache refused an insert — stop extending this lane's path)
         self.cursor = None
+        #: paged mode: page ids backing this lane's table row, in
+        #: lane-local order (owned AND referenced; released at finish)
+        self.pages = []
 
 
 def prompt_bucket(true_len, max_len, floor=16):
@@ -168,13 +203,21 @@ class RadixPrefixCache:
     insert path uses them and LRU-evicted leaf-first at ``capacity``
     chunks.  Lookup/insert/evict all run on the single engine worker
     thread — no locking.
+
+    ``rows`` is opaque to the trie: the contiguous engine stores device
+    ROW COPIES, the paged engine stores a PAGE ID (zero-copy sharing).
+    ``on_evict(rows)`` fires whenever an entry is dropped — the paged
+    engine releases the page's pool reference there, so trie eviction
+    IS the pool's reclamation path under pressure (and pinned entries
+    refusing eviction is what keeps lane-held pages safe).
     """
 
-    def __init__(self, capacity, chunk):
+    def __init__(self, capacity, chunk, on_evict=None):
         if capacity < 1:
             raise ValueError("prefix cache capacity must be >= 1")
         self.capacity = int(capacity)
         self.chunk = int(chunk)
+        self.on_evict = on_evict
         self.root = _PrefixNode(None, None, None)
         self.size = 0
         self._tick = 0
@@ -232,6 +275,27 @@ class RadixPrefixCache:
         for node in nodes:
             node.refs -= 1
 
+    def evict_one(self):
+        """Drop the LRU unpinned leaf NOW (pool-pressure reclamation:
+        the paged engine calls this until its page reservation fits or
+        nothing more can go).  Returns True when an entry was dropped."""
+        return self._evict_one()
+
+    def evictable(self):
+        """Upper bound on entries pool-pressure eviction can reclaim:
+        the UNPINNED count (an unpinned interior node above a pinned
+        child is counted but unreachable — close enough, since lanes
+        pin whole root-anchored paths).  The paged engine checks this
+        BEFORE evicting, so a hopeless reservation cannot flush the
+        whole cache for nothing."""
+        count, stack = 0, [self.root]
+        while stack:
+            for child in stack.pop().children.values():
+                if child.refs == 0:
+                    count += 1
+                stack.append(child)
+        return count
+
     def _evict_one(self):
         """Evict the least-recently-used unpinned LEAF (interior nodes
         keep their children's prefix reachable; they become leaves —
@@ -249,6 +313,8 @@ class RadixPrefixCache:
             return False
         del best.parent.children[best.key]
         self.size -= 1
+        if self.on_evict is not None:
+            self.on_evict(best.rows)
         return True
 
 
@@ -278,7 +344,7 @@ class LMEngine(Logger):
                  window=None, sinks=0, queue_depth=64, deadline_s=30.0,
                  metrics=None, name="lm", prefill_chunk=0,
                  prefix_cache=0, spec_k=0, spec_ngram=3,
-                 queue_tokens=0):
+                 queue_tokens=0, paged_kv=0):
         import jax.numpy as jnp
         if slots < 1:
             raise ValueError("slots must be >= 1")
@@ -293,8 +359,14 @@ class LMEngine(Logger):
         self.queue_depth = int(queue_depth)
         self.deadline_s = float(deadline_s)
         self.queue_tokens = int(queue_tokens)
-        if prefix_cache and not prefill_chunk:
+        self._paged = bool(paged_kv)
+        if (prefix_cache or self._paged) and not prefill_chunk:
             prefill_chunk = min(32, self.max_len)   # cache granularity
+            if self._paged:
+                # the page size must divide max_len (the bit-parity
+                # condition below) — default to the largest divisor
+                while self.max_len % prefill_chunk:
+                    prefill_chunk -= 1
         self.prefill_chunk = int(prefill_chunk)
         self.spec_k = int(spec_k)
         self.spec_ngram = int(spec_ngram)
@@ -314,20 +386,51 @@ class LMEngine(Logger):
                              % (self.spec_k + 1, self.prefill_chunk))
         if self.spec_ngram < 1:
             raise ValueError("spec_ngram must be >= 1")
+        if self._paged and self.max_len % self.prefill_chunk:
+            # the paged lane view is max_pages·page wide; only when that
+            # EQUALS max_len is every score matrix shape-identical to
+            # the contiguous path — the bit-parity contract's condition
+            raise ValueError(
+                "paged_kv needs max_len (%d) divisible by the page size "
+                "(prefill_chunk, %d)" % (self.max_len,
+                                         self.prefill_chunk))
         self.metrics = metrics or ServingMetrics(name)
         self.metrics.set_gauge("slots_total", self.slots)
         self.metrics.set_gauge("slots_busy", 0)
-        self._trie = (RadixPrefixCache(prefix_cache, self.prefill_chunk)
-                      if prefix_cache else None)
 
         embed = params["embed"]
         d_model = embed.shape[1]
         head_dim = d_model // self.n_heads
         kv_heads = params["blocks"][0]["attn"]["wk"].shape[1] // head_dim
-        cache_shape = (self.slots, kv_heads, self.max_len, head_dim)
-        self._caches = [(jnp.zeros(cache_shape, embed.dtype),
-                         jnp.zeros(cache_shape, embed.dtype))
-                        for _ in params["blocks"]]
+        self._caches = None
+        self._kv_pools = None
+        self._pool = None
+        self._page_tables = None
+        self._max_pages = 0
+        if self._paged:
+            self._max_pages = self.max_len // self.prefill_chunk
+            num_pages = (self.slots * self._max_pages
+                         if paged_kv is True else int(paged_kv))
+            if num_pages < 1:
+                raise ValueError("paged_kv pool must hold >= 1 page")
+            self._pool = KVPagePool(num_pages, self.prefill_chunk)
+            pool_shape = (num_pages + 1, kv_heads, self.prefill_chunk,
+                          head_dim)          # +1: the scratch page
+            self._kv_pools = [(jnp.zeros(pool_shape, embed.dtype),
+                               jnp.zeros(pool_shape, embed.dtype))
+                              for _ in params["blocks"]]
+            self._page_tables = numpy.zeros(
+                (self.slots, self._max_pages), numpy.int32)
+            self.metrics.set_gauge("kv_pages_total", num_pages)
+        else:
+            cache_shape = (self.slots, kv_heads, self.max_len, head_dim)
+            self._caches = [(jnp.zeros(cache_shape, embed.dtype),
+                             jnp.zeros(cache_shape, embed.dtype))
+                            for _ in params["blocks"]]
+        self._trie = (RadixPrefixCache(
+            prefix_cache, self.prefill_chunk,
+            on_evict=self._pool.release if self._paged else None)
+            if prefix_cache else None)
         #: per-slot device-facing scalars, host-owned between ticks
         self._pos = numpy.zeros(self.slots, numpy.int32)
         self._last = numpy.zeros(self.slots, numpy.int32)
@@ -336,10 +439,14 @@ class LMEngine(Logger):
 
         self._queue = collections.deque()
         self._queued_tokens = 0
+        self._queued_pages = 0
+        self._pool_blocked = False
         self._cond = threading.Condition()
         self._thread = None
         self._stop = False
         self._build_jits()
+        if self._paged:
+            self._update_pool_gauges()
 
     # ------------------------------------------------------------- jitted core
     def _build_jits(self):
@@ -351,6 +458,9 @@ class LMEngine(Logger):
         n_heads, max_len = self.n_heads, self.max_len
         rope, window, sinks = self.rope, self.window, self.sinks
         C, k1 = self.prefill_chunk, self.spec_k + 1
+        if self._paged:
+            self._build_paged_jits()
+            return
 
         def prefill_one(params, prompt, true_len):
             # prompt (1, bucket) int32, true_len traced: positions
@@ -395,6 +505,7 @@ class LMEngine(Logger):
         self._chunk_jit = None
         self._chunk_install_jit = None
         self._chunk_extract_jit = None
+        self._page_copy_jit = None
         if C:
             def chunk_slot(params, caches, tokens, slot, start,
                            last_idx):
@@ -467,36 +578,124 @@ class LMEngine(Logger):
             self._verify_jit = jax.jit(jax.vmap(
                 verify_one, in_axes=(None, 0, 0, 0)))
 
+    def _build_paged_jits(self):
+        """The PAGED program set — every shape is fixed by (slots,
+        max_pages, chunk, k), so the whole mixed-length workload
+        compiles exactly one program per family: ``_chunk_jit`` (one
+        lane, one prompt chunk), ``_step_jit`` (every lane, one token,
+        batched over the shared pool — vmap cannot carry a shared
+        mutable pool, so the batching is explicit), ``_verify_jit``
+        (every lane, k+1 speculative positions) and ``_page_copy_jit``
+        (copy-on-write).  The whole-prompt prefill/install/extract
+        programs have no paged counterpart (prefill is always chunked;
+        prefix hits install page IDS, not rows)."""
+        import jax
+        import jax.numpy as jnp
+        from veles_tpu.ops.transformer import (head_logits,
+                                               paged_chunk_apply)
+        n_heads = self.n_heads
+        rope, window, sinks = self.rope, self.window, self.sinks
+
+        def chunk_slot(params, pools, ptab, tokens, start, last_idx):
+            # one lane's prompt chunk through its page table; returns
+            # the argmax after ``last_idx`` (read on the tail chunk)
+            h, pools = paged_chunk_apply(
+                params, tokens[None], pools, ptab[None], start[None],
+                n_heads, rope=rope, window=window, sinks=sinks)
+            logits = head_logits(params, jax.lax.dynamic_slice_in_dim(
+                h, last_idx, 1, axis=1))[:, 0, :]
+            tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)[0]
+            return pools, tok
+
+        def step_all(params, pools, ptabs, toks, pos):
+            # ONE dispatch advances every lane by one token at its own
+            # position through its own page table
+            h, pools = paged_chunk_apply(
+                params, toks[:, None], pools, ptabs, pos, n_heads,
+                rope=rope, window=window, sinks=sinks)
+            logits = head_logits(params, h)[:, 0, :]
+            return pools, jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+        def page_copy(pools, src, dst):
+            # copy-on-write: duplicate one page across every block so
+            # the writer owns ``dst`` exclusively and the other
+            # referents of ``src`` keep bit-identical rows
+            return [(kp.at[dst].set(kp[src]), vp.at[dst].set(vp[src]))
+                    for kp, vp in pools]
+
+        self._chunk_jit = jax.jit(chunk_slot)
+        self._step_jit = jax.jit(step_all)
+        self._page_copy_jit = jax.jit(page_copy)
+        self._prefill_jit = None
+        self._install_jit = None
+        self._chunk_install_jit = None
+        self._chunk_extract_jit = None
+        self._verify_jit = None
+        if self.spec_k:
+            def verify_all(params, pools, ptabs, toks, pos):
+                # toks (slots, k+1) = [last committed, draft…] per lane;
+                # returns the greedy argmax AFTER each fed position
+                h, pools = paged_chunk_apply(
+                    params, toks, pools, ptabs, pos, n_heads, rope=rope,
+                    window=window, sinks=sinks)
+                logits = head_logits(params, h)      # (slots, k+1, v)
+                return pools, jnp.argmax(
+                    logits, axis=-1).astype(jnp.int32)
+
+            self._verify_jit = jax.jit(verify_all)
+
     # --------------------------------------------------------------- lifecycle
     def start(self):
         import jax.numpy as jnp
         # warm every program before traffic: the discarded warmup
-        # writes land at positions of free slots that the next
-        # prefill/chunk overwrites before they are ever attended
-        tok, rows = self._prefill_jit(
-            self.params,
-            jnp.zeros((1, prompt_bucket(1, self.max_len)), jnp.int32),
-            jnp.asarray(1, jnp.int32))
-        self._caches = self._install_jit(self._caches, rows,
-                                         jnp.asarray(0, jnp.int32))
-        if self._chunk_jit is not None:
+        # writes land at positions of free slots (paged: the scratch
+        # page) that the next prefill/chunk overwrites — or a live
+        # mask excludes — before they are ever attended
+        if self._paged:
             zero = jnp.asarray(0, jnp.int32)
-            self._caches, _ = self._chunk_jit(
-                self.params, self._caches,
-                jnp.zeros(self.prefill_chunk, jnp.int32), zero, zero,
-                zero)
-            crows = self._chunk_extract_jit(self._caches, zero, zero)
-            self._caches = self._chunk_install_jit(self._caches, crows,
-                                                   zero, zero)
-        if self._verify_jit is not None:
-            self._caches, _ = self._verify_jit(
-                self.params, self._caches,
-                jnp.zeros((self.slots, self.spec_k + 1), jnp.int32),
+            ptabs = jnp.zeros((self.slots, self._max_pages), jnp.int32)
+            self._kv_pools, _ = self._chunk_jit(
+                self.params, self._kv_pools, ptabs[0],
+                jnp.zeros(self.prefill_chunk, jnp.int32), zero, zero)
+            self._kv_pools = self._page_copy_jit(self._kv_pools, zero,
+                                                 zero)
+            if self._verify_jit is not None:
+                self._kv_pools, _ = self._verify_jit(
+                    self.params, self._kv_pools, ptabs,
+                    jnp.zeros((self.slots, self.spec_k + 1), jnp.int32),
+                    jnp.zeros(self.slots, jnp.int32))
+            self._kv_pools, _ = self._step_jit(
+                self.params, self._kv_pools, ptabs,
+                jnp.zeros(self.slots, jnp.int32),
                 jnp.zeros(self.slots, jnp.int32))
-        self._caches, _ = self._step_jit(
-            self.params, self._caches,
-            jnp.zeros(self.slots, jnp.int32),
-            jnp.ones(self.slots, jnp.int32))
+        else:
+            tok, rows = self._prefill_jit(
+                self.params,
+                jnp.zeros((1, prompt_bucket(1, self.max_len)),
+                          jnp.int32),
+                jnp.asarray(1, jnp.int32))
+            self._caches = self._install_jit(self._caches, rows,
+                                             jnp.asarray(0, jnp.int32))
+            if self._chunk_jit is not None:
+                zero = jnp.asarray(0, jnp.int32)
+                self._caches, _ = self._chunk_jit(
+                    self.params, self._caches,
+                    jnp.zeros(self.prefill_chunk, jnp.int32), zero,
+                    zero, zero)
+                crows = self._chunk_extract_jit(self._caches, zero,
+                                                zero)
+                self._caches = self._chunk_install_jit(self._caches,
+                                                       crows, zero,
+                                                       zero)
+            if self._verify_jit is not None:
+                self._caches, _ = self._verify_jit(
+                    self.params, self._caches,
+                    jnp.zeros((self.slots, self.spec_k + 1), jnp.int32),
+                    jnp.zeros(self.slots, jnp.int32))
+            self._caches, _ = self._step_jit(
+                self.params, self._caches,
+                jnp.zeros(self.slots, jnp.int32),
+                jnp.ones(self.slots, jnp.int32))
         self._stop = False
         self._thread = threading.Thread(target=self._worker, daemon=True,
                                         name="lm-engine-%s" % self.name)
@@ -526,6 +725,16 @@ class LMEngine(Logger):
             raise ValueError("prompt %d + n_new %d%s exceeds the engine "
                              "cache length %d"
                              % (len(prompt), n_new, extra, self.max_len))
+        demand = 0
+        if self._paged:
+            span = len(prompt) + n_new + self.spec_k
+            demand = -(-span // self.prefill_chunk)
+            if demand > self._pool.num_pages:
+                raise ValueError(
+                    "prompt %d + n_new %d needs %d KV pages but the "
+                    "pool holds %d — this request can never be placed"
+                    % (len(prompt), n_new, demand,
+                       self._pool.num_pages))
         with self._cond:
             if self._stop or self._thread is None:
                 raise RuntimeError("LM engine is not running")
@@ -541,12 +750,29 @@ class LMEngine(Logger):
                 self.metrics.record_reject()
                 self.metrics.inc("rejected_tokens", len(prompt))
                 raise Overloaded()
-            req = _Request(prompt, int(n_new), self.deadline_s)
+            if self._paged and self._queue and \
+                    self._queued_pages + demand > 2 * self._pool.num_pages:
+                # pool-pressure admission: once TWO full pools' worth
+                # of page demand is queued (one generation decoding,
+                # one waiting), a new arrival would only sit until its
+                # deadline — 429 it NOW with an exception that names
+                # the resource (never a hang; the head request always
+                # enqueues, so a single large request cannot wedge an
+                # empty queue)
+                self.metrics.record_reject()
+                self.metrics.inc("rejected_pages", demand)
+                raise PoolExhausted(demand, 2 * self._pool.num_pages)
+            req = _Request(prompt, int(n_new), self.deadline_s,
+                           pages=demand)
             self._queue.append(req)
             self._queued_tokens += req.true_len
+            self._queued_pages += req.pages
             self.metrics.record_enqueue()
             self.metrics.set_gauge("queue_depth", len(self._queue))
             self.metrics.set_gauge("queue_tokens", self._queued_tokens)
+            if self._paged:
+                self.metrics.set_gauge("queue_pages",
+                                       self._queued_pages)
             self._cond.notify()
         return req.future
 
@@ -580,6 +806,7 @@ class LMEngine(Logger):
             try:
                 self._queue.remove(req)
                 self._queued_tokens -= req.true_len
+                self._queued_pages -= req.pages
             except ValueError:
                 return           # admitted (or done) — worker handles it
         req.future.cancel()
@@ -590,16 +817,25 @@ class LMEngine(Logger):
         (and chunked-ineligible ones) prefill whole at a power-of-two
         bucket as before; with ``prefill_chunk`` the lane only LOOKS UP
         the prefix cache and installs its hits here — compute chunks run
-        one per tick, interleaved with decode (no head-of-line block)."""
+        one per tick, interleaved with decode (no head-of-line block).
+        Paged mode additionally RESERVES the lane's worst-case pages;
+        when the pool cannot cover them the request goes BACK to the
+        queue head (FIFO — retried next tick as lanes free pages, shed
+        at its deadline) instead of wedging or being skipped."""
         import jax.numpy as jnp
+        self._pool_blocked = False
         while self._free:
             with self._cond:
                 req = self._queue.popleft() if self._queue else None
                 if req is not None:
                     self._queued_tokens -= req.true_len
+                    self._queued_pages -= req.pages
                 self.metrics.set_gauge("queue_depth", len(self._queue))
                 self.metrics.set_gauge("queue_tokens",
                                        self._queued_tokens)
+                if self._paged:
+                    self.metrics.set_gauge("queue_pages",
+                                           self._queued_pages)
             if req is None:
                 return
             if req.cancelled:            # raced _cancel's dequeue
@@ -613,6 +849,24 @@ class LMEngine(Logger):
                 continue
             slot = self._free.pop()
             C = self.prefill_chunk
+            if self._paged:
+                if not self._admit_paged(slot, req):
+                    # pool pressure: back to the HEAD (order preserved;
+                    # deadline still sheds it) and stop admitting
+                    self._free.append(slot)
+                    self._pool_blocked = True
+                    with self._cond:
+                        self._queue.appendleft(req)
+                        self._queued_tokens += req.true_len
+                        self._queued_pages += req.pages
+                        self.metrics.set_gauge("queue_depth",
+                                               len(self._queue))
+                        self.metrics.set_gauge("queue_tokens",
+                                               self._queued_tokens)
+                        self.metrics.set_gauge("queue_pages",
+                                               self._queued_pages)
+                    return
+                continue
             if C and ((req.true_len - 1) // C + 1) * C <= self.max_len:
                 self._admit_chunked(slot, req)
                 continue
@@ -673,6 +927,9 @@ class LMEngine(Logger):
             matched = len(nodes)
             self.metrics.inc("prefix_hit_chunks", matched)
             self.metrics.inc("prefix_hit_tokens", matched * C)
+            # every contiguous hit is a device ROW COPY install — the
+            # cost the paged layout's page references eliminate
+            self.metrics.inc("kv_row_copies", matched * C)
             self.metrics.set_gauge("prefix_cache_chunks",
                                    self._trie.size)
         for i in range(matched, n_full):
@@ -690,6 +947,149 @@ class LMEngine(Logger):
         # and spec_k + 1 <= C) overwrites before anything attends it
         self._pos[slot] = lane.pending[0][1]
 
+    # -------------------------------------------------------------- paged mode
+    def _admit_paged(self, slot, req):
+        """Paged admission: reserve the lane's WORST-CASE page span up
+        front (no mid-decode allocation, so decode can never deadlock
+        on pages), with prefix-cache hits substituting page REFERENCES
+        (ref-count bump, no device work at all) for fresh pages.
+        Returns False — nothing committed — when the pool cannot cover
+        the reservation even after pressing the prefix cache."""
+        C = self.prefill_chunk
+        n_full = (req.true_len - 1) // C
+        lane = _Slot(req)
+        nodes = []
+        if self._trie is not None:
+            keys = [tuple(int(t) for t in req.prompt[i * C:(i + 1) * C])
+                    for i in range(n_full)]
+            nodes = self._trie.match(keys)
+        fresh = self._alloc_pages(req.pages - len(nodes))
+        if fresh is None:
+            if nodes:            # nothing committed — undo the pins
+                self._trie.release(nodes)
+            return False
+        lane.pinned.extend(nodes)
+        lane.cursor = (nodes[-1] if nodes else
+                       self._trie.root if self._trie is not None
+                       else None)
+        for node in nodes:
+            self._pool.retain(node.rows)     # the lane's reference
+            self._pool.pin(node.rows)
+            lane.pages.append(node.rows)
+        for p in fresh:
+            self._pool.pin(p)
+        lane.pages.extend(fresh)
+        self._page_tables[slot, :len(lane.pages)] = lane.pages
+        self._page_tables[slot, len(lane.pages):] = KVPagePool.SCRATCH
+        if nodes:
+            self.metrics.inc("prefix_hit_chunks", len(nodes))
+            self.metrics.inc("prefix_hit_tokens", len(nodes) * C)
+            self.metrics.inc("kv_pages_referenced", len(nodes))
+            self.metrics.set_gauge("prefix_cache_chunks",
+                                   self._trie.size)
+        for i in range(len(nodes), n_full):
+            lane.pending.append((req.prompt[i * C:(i + 1) * C], i * C,
+                                 False))
+        tail = req.prompt[n_full * C:]
+        if len(tail) < C:
+            tail = numpy.pad(tail, (0, C - len(tail)))
+        lane.pending.append((tail, n_full * C, True))
+        self.metrics.record_queue_wait(time.monotonic() - req.t_enq)
+        self._lanes[slot] = lane
+        self._pos[slot] = lane.pending[0][1]
+        self._update_pool_gauges()
+        return True
+
+    def _alloc_pages(self, n):
+        """``n`` pages from the pool, pressing the prefix cache to drop
+        LRU unpinned entries (each eviction releases its page) until
+        the allocation fits or nothing more can be evicted.  Returns
+        the page list or None; never blocks."""
+        if n <= 0:
+            return []
+        pages = self._pool.alloc(n)
+        if pages is None and self._trie is not None:
+            # each eviction frees at most ONE page — when even a full
+            # flush cannot cover the deficit, keep the cache warm (the
+            # request is only ever placed by lanes finishing anyway)
+            if self._pool.free_pages + self._trie.evictable() < n:
+                return None
+            while pages is None and self._trie.evict_one():
+                self.metrics.set_gauge("prefix_cache_chunks",
+                                       self._trie.size)
+                pages = self._pool.alloc(n)
+        return pages
+
+    def _cow_guard(self, slot, lane, lo, hi):
+        """COPY-ON-WRITE: before a device write covering linear
+        positions [lo, hi), replace any SHARED page in that range with
+        a private copy (one page-copy dispatch) so the other referents
+        — sibling lanes, the prefix cache — keep their rows
+        bit-identical.  Structurally rare (shared pages are full prompt
+        chunks; appends land past the prompt), kept as the safety net
+        that makes sharing unconditionally sound.  Raises on pool
+        exhaustion — the caller fails THIS lane, never wedges."""
+        import jax.numpy as jnp
+        P = self.prefill_chunk
+        for j in range(lo // P, (hi - 1) // P + 1):
+            p = lane.pages[j]
+            if not self._pool.shared(p):
+                continue
+            fresh = self._alloc_pages(1)
+            if fresh is None:
+                raise Overloaded()
+            q = fresh[0]
+            try:
+                self._kv_pools = self._page_copy_jit(
+                    self._kv_pools, jnp.asarray(p, jnp.int32),
+                    jnp.asarray(q, jnp.int32))
+            except Exception:
+                # nobody owns q yet (not in lane.pages) — hand it back
+                # or a faulting device shrinks the pool for good
+                self._pool.release(q)
+                raise
+            self._pool.pin(q)
+            self._pool.unpin(p)
+            self._pool.release(p)
+            lane.pages[j] = q
+            self._page_tables[slot, j] = q
+            self.metrics.inc("kv_cow_copies")
+            self._update_pool_gauges()
+
+    def _cow_guard_active(self, active, span):
+        """:meth:`_cow_guard` over every active lane's next
+        ``span``-position write, BEFORE the batched dispatch: a lane
+        whose copy cannot be made (pool exhausted on the safety-net
+        path) is torn down ALONE — its siblings keep decoding, per the
+        engine's fault-isolation discipline.  Returns the surviving
+        active list (a torn-down lane's table row parks on scratch, so
+        the batched step stays safe to run)."""
+        alive = []
+        for slot in active:
+            lane = self._lanes[slot]
+            try:
+                self._cow_guard(slot, lane, int(self._pos[slot]),
+                                int(self._pos[slot]) + span)
+            except Exception as e:   # noqa: BLE001 — fails THIS lane
+                self.metrics.record_error()
+                self.warning("copy-on-write failed: %s", e)
+                self._teardown_slot(slot, lane, e)
+                continue
+            alive.append(slot)
+        return alive
+
+    def _update_pool_gauges(self):
+        self.metrics.set_gauge("kv_pages_free", self._pool.free_pages)
+        self.metrics.set_gauge("kv_pages_pinned",
+                               self._pool.pinned_pages)
+
+    def kv_bytes_resident(self):
+        """Device bytes held for KV storage — the pool (paged) or the
+        contiguous slot caches; what the bench reports as footprint."""
+        arrs = [a for pair in (self._kv_pools if self._paged
+                               else self._caches) for a in pair]
+        return sum(a.size * a.dtype.itemsize for a in arrs)
+
     def _advance_prefill(self, slot):
         """Run ONE pending prompt chunk for this lane (a tick's worth of
         prefill — decode lanes step in between, so a long prompt never
@@ -703,6 +1103,9 @@ class LMEngine(Logger):
             # free the slot now instead of finishing the prompt for a
             # result nobody will read
             self._teardown_slot(slot, lane)
+            return
+        if self._paged:
+            self._advance_prefill_paged(slot, lane, req)
             return
         tokens, start, is_tail = lane.pending.pop(0)
         if not is_tail and self._trie is not None \
@@ -729,6 +1132,7 @@ class LMEngine(Logger):
                 lane.cursor = node
                 self.metrics.inc("prefix_hit_chunks")
                 self.metrics.inc("prefix_hit_tokens", len(tokens))
+                self.metrics.inc("kv_row_copies", len(tokens))
                 self._pos[slot] = lane.pending[0][1]
                 return
         last_idx = (req.true_len - 1 - start) if is_tail else 0
@@ -767,6 +1171,78 @@ class LMEngine(Logger):
         else:
             self._pos[slot] = lane.pending[0][1]
 
+    def _advance_prefill_paged(self, slot, lane, req):
+        """One pending prompt chunk, paged: a LATE HIT swaps the lane's
+        reserved page for a REFERENCE to the sibling's page (release
+        one, retain the other — still zero device work); a computed
+        full chunk SHARES the lane's own page with the trie (retain —
+        the insert itself copies nothing)."""
+        import jax.numpy as jnp
+        C = self.prefill_chunk
+        tokens, start, is_tail = lane.pending.pop(0)
+        page_idx = start // C
+        if not is_tail and self._trie is not None \
+                and lane.cursor is not None:
+            node = self._trie.lookup_child(
+                lane.cursor, tuple(int(t) for t in tokens))
+            if node is not None:
+                # late hit: drop the page reserved for this chunk and
+                # reference the already-computed one instead
+                own = lane.pages[page_idx]
+                self._pool.unpin(own)
+                self._pool.release(own)
+                self._pool.retain(node.rows)
+                self._pool.pin(node.rows)
+                lane.pages[page_idx] = node.rows
+                self._page_tables[slot, page_idx] = node.rows
+                lane.pinned.append(node)
+                lane.cursor = node
+                self.metrics.inc("prefix_hit_chunks")
+                self.metrics.inc("prefix_hit_tokens", len(tokens))
+                self.metrics.inc("kv_pages_referenced")
+                self._update_pool_gauges()
+                self._pos[slot] = lane.pending[0][1]
+                return
+        last_idx = (req.true_len - 1 - start) if is_tail else 0
+        t0 = time.monotonic()
+        try:
+            self._cow_guard(slot, lane, start, start + C)
+            self._kv_pools, tok = self._chunk_jit(
+                self.params, self._kv_pools,
+                jnp.asarray(self._page_tables[slot]),
+                jnp.asarray(tokens, jnp.int32),
+                jnp.asarray(start, jnp.int32),
+                jnp.asarray(last_idx, jnp.int32))
+            if not is_tail and self._trie is not None \
+                    and lane.cursor is not None:
+                page = lane.pages[page_idx]
+                node = self._trie.insert(
+                    lane.cursor, tuple(int(t) for t in tokens), page)
+                if node is not None:
+                    lane.pinned.append(node)
+                    if node.rows == page:
+                        # fresh entry: the trie now references the
+                        # lane's own page (released on trie eviction)
+                        self._pool.retain(page)
+                lane.cursor = node
+                self.metrics.set_gauge("prefix_cache_chunks",
+                                       self._trie.size)
+                self._update_pool_gauges()
+        except Exception as e:   # noqa: BLE001 — fails THIS request
+            self.metrics.record_error()
+            self.warning("paged chunk prefill failed: %s", e)
+            self._teardown_slot(slot, lane, e)
+            return
+        self.metrics.inc("prefill_dispatches")
+        self.metrics.inc("prefill_tokens",
+                         (req.true_len - start) if is_tail
+                         else len(tokens))
+        self.metrics.record_decode_step(time.monotonic() - t0)
+        if is_tail:
+            self._emit_first(slot, lane, int(tok))
+        else:
+            self._pos[slot] = lane.pending[0][1]
+
     def _emit_first(self, slot, lane, tok):
         """First generated token (prefill just finished): the lane
         becomes a decode lane (or finishes outright at n_new=1)."""
@@ -785,6 +1261,15 @@ class LMEngine(Logger):
         if self._trie is not None and lane.pinned:
             self._trie.release(lane.pinned)
             lane.pinned = []
+        if self._paged and lane.pages:
+            # ref-count release on lane finish: owned pages return to
+            # the free list; shared (trie/sibling-referenced) pages
+            # just lose this lane's reference and survive
+            for p in lane.pages:
+                self._pool.unpin(p)
+                self._pool.release(p)
+            lane.pages = []
+            self._update_pool_gauges()
 
     def _teardown_slot(self, slot, lane, exc=None):
         """THE failure/cancellation teardown (every fault path funnels
@@ -799,6 +1284,8 @@ class LMEngine(Logger):
             self._free.append(slot)
         self._pos[slot] = 0
         self._last[slot] = 0
+        if self._paged:
+            self._page_tables[slot, :] = KVPagePool.SCRATCH
         fut = lane.request.future
         if exc is None:
             fut.cancel()
@@ -811,6 +1298,8 @@ class LMEngine(Logger):
         self._free.append(slot)
         self._pos[slot] = 0
         self._last[slot] = 0
+        if self._paged:
+            self._page_tables[slot, :] = KVPagePool.SCRATCH
         self._release_lane(lane)
         fut = lane.request.future
         if not fut.cancelled():          # withdrawn mid-decode
@@ -832,11 +1321,21 @@ class LMEngine(Logger):
         the module docstring), so the step program never respecializes
         on the active set."""
         import jax.numpy as jnp
+        if self._paged:
+            active = self._cow_guard_active(active, 1)
+            if not active:
+                return
         t0 = time.monotonic()
         try:
-            self._caches, toks = self._step_jit(
-                self.params, self._caches,
-                jnp.asarray(self._last), jnp.asarray(self._pos))
+            if self._paged:
+                self._kv_pools, toks = self._step_jit(
+                    self.params, self._kv_pools,
+                    jnp.asarray(self._page_tables),
+                    jnp.asarray(self._last), jnp.asarray(self._pos))
+            else:
+                self._caches, toks = self._step_jit(
+                    self.params, self._caches,
+                    jnp.asarray(self._last), jnp.asarray(self._pos))
             toks = numpy.asarray(toks)
         except Exception as e:   # noqa: BLE001 — fails the lanes
             self._fail_active(active, e)
@@ -864,6 +1363,10 @@ class LMEngine(Logger):
         drafts hit."""
         import jax.numpy as jnp
         k = self.spec_k
+        if self._paged:
+            active = self._cow_guard_active(active, k + 1)
+            if not active:
+                return
         toks_in = numpy.zeros((self.slots, k + 1), numpy.int32)
         drafts = [None] * self.slots
         real_lens = [0] * self.slots
@@ -888,9 +1391,15 @@ class LMEngine(Logger):
                 self.metrics.inc("draft_tokens", len(draft))
         t0 = time.monotonic()
         try:
-            self._caches, out = self._verify_jit(
-                self.params, self._caches, jnp.asarray(toks_in),
-                jnp.asarray(self._pos))
+            if self._paged:
+                self._kv_pools, out = self._verify_jit(
+                    self.params, self._kv_pools,
+                    jnp.asarray(self._page_tables),
+                    jnp.asarray(toks_in), jnp.asarray(self._pos))
+            else:
+                self._caches, out = self._verify_jit(
+                    self.params, self._caches, jnp.asarray(toks_in),
+                    jnp.asarray(self._pos))
             out = numpy.asarray(out)
         except Exception as e:   # noqa: BLE001 — fails the lanes
             self._fail_active(active, e)
@@ -931,12 +1440,19 @@ class LMEngine(Logger):
             busy = [i for i, lane in enumerate(self._lanes)
                     if lane is not None]
             self.metrics.set_gauge("slots_busy", len(busy))
+            self.metrics.set_gauge_max("slots_busy_peak", len(busy))
             if not busy:
                 with self._cond:
                     if self._stop:
                         break
                     if not self._queue:
                         self._cond.wait(0.5)
+                    elif self._pool_blocked:
+                        # head request waiting on pages with no lane
+                        # running to free any: only trie eviction or
+                        # its deadline can resolve it — poll briefly so
+                        # the shed fires on time without a hot spin
+                        self._cond.wait(0.05)
                 continue
             # chunked prefill interleaving: at most ONE prompt chunk per
             # tick (round-robin across prefilling lanes), then one
@@ -960,6 +1476,7 @@ class LMEngine(Logger):
             pending = list(self._queue)
             self._queue.clear()
             self._queued_tokens = 0
+            self._queued_pages = 0
         for req in pending:
             req.future.set_exception(RuntimeError("LM engine stopped"))
         for slot, lane in enumerate(self._lanes):

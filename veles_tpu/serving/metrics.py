@@ -165,6 +165,16 @@ class ServingMetrics:
         with self._lock:
             self.gauges[name] = value
 
+    def set_gauge_max(self, name, value):
+        """High-water-mark gauge: keeps the largest value ever set —
+        peak concurrent slot occupancy is what the fixed-KV-memory
+        bench compares across layouts, and a sampled gauge would
+        under-read it between scrapes."""
+        with self._lock:
+            prev = self.gauges.get(name)
+            self.gauges[name] = value if prev is None \
+                else max(prev, value)
+
     # --------------------------------------------------------------- reading
     def snapshot(self):
         """Plain-dict snapshot (JSON-safe) with latency percentiles."""
