@@ -50,6 +50,11 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: sustained/heavy tests excluded from tier-1 "
                    "(deselected by -m 'not slow')")
+    config.addinivalue_line(
+        "markers", "kernel_parity: interpret-mode Pallas-vs-XLA parity "
+                   "tests for the serving attention kernels (ISSUE 7) "
+                   "— tier-1, and runnable standalone in <60s via "
+                   "tools/check_kernel_parity.py")
 
 
 @pytest.fixture(autouse=True)

@@ -48,15 +48,20 @@ def jit_guard():
         if engine._paged:
             # paged mode (ISSUE 6): the page-table indirection is
             # traced DATA, so the whole mixed-length workload owns
-            # exactly one chunk, one step, one page-copy and (spec_k)
-            # one verify program — no prefill bucket ladder at all
+            # exactly one chunk and one page-copy program; step/verify
+            # own one program PER LIVE-WIDTH LADDER ENTRY (ISSUE 7
+            # satellite — the table is sliced to the batch's live page
+            # span, the paged analogue of the contiguous prompt
+            # buckets), still a static bound independent of the
+            # workload's prompt-length mix
+            widths = len(engine._width_ladder)
             progs = {
-                "step": (engine._step_jit, 1),
+                "step": (engine._step_jit, widths),
                 "chunk": (engine._chunk_jit, 1),
                 "page_copy": (engine._page_copy_jit, 1),
             }
             if engine._verify_jit is not None:
-                progs["verify"] = (engine._verify_jit, 1)
+                progs["verify"] = (engine._verify_jit, widths)
             for name, (fn, bound) in progs.items():
                 size = fn._cache_size()
                 assert size <= bound, (
@@ -98,6 +103,15 @@ FEATURE_SETS = [
     {"paged_kv": True, "prefill_chunk": 8, "spec_k": 3},
     {"paged_kv": True, "prefill_chunk": 8, "prefix_cache": 32,
      "spec_k": 3},
+    # Pallas serving kernels (ISSUE 7): 'force' runs the REAL kernels
+    # in interpret mode on CPU — the end-to-end kernel parity leg (the
+    # full fast-path combination, so chunked prefill, prefix installs
+    # and speculative verify all route through the kernels); 'auto'
+    # off-TPU exercises the automatic XLA fallback end to end (parity
+    # via the fallback, counter asserted in TestAttnKernelRouting)
+    {"paged_kv": True, "prefill_chunk": 8, "prefix_cache": 32,
+     "spec_k": 3, "attn_kernel": "force"},
+    {"paged_kv": True, "prefill_chunk": 8, "attn_kernel": True},
 ]
 
 
@@ -341,6 +355,10 @@ class TestPagedKV:
     @pytest.mark.parametrize("attn", [
         {"rope": True},
         {"rope": True, "window": 24, "sinks": 2},
+        # the Pallas kernels must reproduce the window/sink band and
+        # batched rope IN-KERNEL — the masking-edge end-to-end leg
+        {"rope": True, "window": 24, "sinks": 2,
+         "_attn_kernel": "force"},
     ], ids=lambda a: "+".join(sorted(a)))
     def test_rope_window_sinks_parity(self, attn):
         """serve_lm forwards the trainer's rope/window/sinks into the
@@ -352,6 +370,8 @@ class TestPagedKV:
         from veles_tpu.ops.transformer import generate
         from veles_tpu.serving import LMEngine
         params = _params()
+        attn = dict(attn)
+        attn_kernel = attn.pop("_attn_kernel", 0)
         prompts = [[1, 2, 3], [2, 4, 6, 8, 10, 12, 14],
                    [5, 1] * 9, list(range(1, 14))]
         n_new = 7
@@ -364,7 +384,8 @@ class TestPagedKV:
         expected = [greedy(p) for p in prompts]
         engine = LMEngine(params, n_heads=2, max_len=96, slots=2,
                           paged_kv=True, prefill_chunk=8, spec_k=2,
-                          name="pg_attn", **attn).start()
+                          name="pg_attn", attn_kernel=attn_kernel,
+                          **attn).start()
         try:
             futures = [engine.submit(p, n_new) for p in prompts]
             for p, f, exp in zip(prompts, futures, expected):
@@ -489,6 +510,145 @@ class TestPagedKV:
                 in text
         finally:
             engine.stop()
+
+
+class TestAttnKernelRouting:
+    """ISSUE 7: the serving-kernel switch — fallback rules, the
+    per-dispatch counters, the live-width ladder, and the engine-level
+    validation."""
+
+    def test_cpu_auto_falls_back_and_counts(self):
+        """On CPU, attn_kernel='auto' must serve through the XLA path
+        (parity trivially intact), increment attn_kernel_fallbacks per
+        dispatch, record the reason, and render the counter on
+        /metrics with one # TYPE line."""
+        from veles_tpu.serving import LMEngine
+        from veles_tpu.serving import metrics as metrics_mod
+        from veles_tpu.ops.pallas_kernels import on_tpu
+        if on_tpu():
+            pytest.skip("on-TPU: auto resolves to the kernel path")
+        params = _params()
+        engine = LMEngine(params, n_heads=2, max_len=96, slots=1,
+                          paged_kv=True, prefill_chunk=8,
+                          attn_kernel="auto", name="ak_auto",
+                          metrics=metrics_mod.new("ak_auto")).start()
+        try:
+            assert not engine._kernel_active
+            assert "TPU" in engine._kernel_fallback_reason
+            got = numpy.concatenate(
+                [[1, 2, 3], engine.submit([1, 2, 3], 4).result(
+                    timeout=60)])
+            numpy.testing.assert_array_equal(
+                got, _greedy(params, [1, 2, 3], 4, 96))
+            snap = engine.metrics.snapshot()
+            assert snap["counters"]["attn_kernel_fallbacks"] > 0
+            assert "attn_kernel_dispatches" not in snap["counters"]
+            assert snap["gauges"]["attn_kernel_active"] == 0
+            text = metrics_mod.render_prometheus()
+            assert text.count("# TYPE veles_serving_"
+                              "attn_kernel_fallbacks_total counter") == 1
+            assert ('veles_serving_attn_kernel_fallbacks_total'
+                    '{engine="ak_auto"}') in text
+        finally:
+            engine.stop()
+
+    def test_contiguous_geometry_falls_back(self):
+        """attn_kernel on a CONTIGUOUS engine is an unsupported
+        geometry — fallback with a reason naming paged_kv, never an
+        error, and the serving output stays exactly greedy."""
+        from veles_tpu.serving import LMEngine
+        params = _params()
+        engine = LMEngine(params, n_heads=2, max_len=96, slots=1,
+                          prefill_chunk=8, attn_kernel="force",
+                          name="ak_contig").start()
+        try:
+            assert not engine._kernel_active
+            assert "paged_kv" in engine._kernel_fallback_reason
+            got = numpy.concatenate(
+                [[7, 7, 7], engine.submit([7, 7, 7], 4).result(
+                    timeout=60)])
+            numpy.testing.assert_array_equal(
+                got, _greedy(params, [7, 7, 7], 4, 96))
+            c = engine.metrics.snapshot()["counters"]
+            assert c["attn_kernel_fallbacks"] > 0
+        finally:
+            engine.stop()
+
+    def test_force_counts_kernel_dispatches(self):
+        """'force' on CPU runs the interpret-mode kernels for real:
+        every decode/prefill dispatch lands in attn_kernel_dispatches
+        and none in the fallback counter."""
+        from veles_tpu.serving import LMEngine
+        params = _params()
+        engine = LMEngine(params, n_heads=2, max_len=96, slots=1,
+                          paged_kv=True, prefill_chunk=8,
+                          attn_kernel="force", name="ak_force").start()
+        try:
+            assert engine._kernel_active
+            got = numpy.concatenate(
+                [[1, 2, 3], engine.submit([1, 2, 3], 3).result(
+                    timeout=120)])
+            numpy.testing.assert_array_equal(
+                got, _greedy(params, [1, 2, 3], 3, 96))
+            c = engine.metrics.snapshot()["counters"]
+            assert c["attn_kernel_dispatches"] > 0
+            assert "attn_kernel_fallbacks" not in c
+        finally:
+            engine.stop()
+
+    def test_flash_serve_backend_default(self):
+        """set_attention_backend('flash_serve') flips the DEFAULT for
+        engines built while it is set (attn_kernel=None follows it;
+        explicit 0 still wins), without touching mha_forward's path."""
+        from veles_tpu.ops import attention as A
+        from veles_tpu.serving import LMEngine
+        params = _params()
+        A.set_attention_backend("flash_serve")
+        try:
+            eng = LMEngine(params, n_heads=2, max_len=96, slots=1,
+                           paged_kv=True, prefill_chunk=8,
+                           name="ak_glob")
+            assert eng.attn_kernel == "auto"
+            off = LMEngine(params, n_heads=2, max_len=96, slots=1,
+                           paged_kv=True, prefill_chunk=8,
+                           attn_kernel=0, name="ak_glob_off")
+            assert off.attn_kernel == 0
+        finally:
+            A.set_attention_backend("xla")
+        plain = LMEngine(params, n_heads=2, max_len=96, slots=1,
+                         paged_kv=True, prefill_chunk=8,
+                         name="ak_glob_plain")
+        assert plain.attn_kernel == 0
+
+    def test_invalid_mode_rejected(self):
+        from veles_tpu.serving import LMEngine
+        params = _params()
+        with pytest.raises(ValueError, match="attn_kernel"):
+            LMEngine(params, n_heads=2, max_len=96, slots=1,
+                     paged_kv=True, prefill_chunk=8,
+                     attn_kernel="sometimes", name="ak_bad")
+
+    def test_live_width_ladder(self):
+        """The decode/verify table slice (ISSUE 7 satellite): the
+        width ladder is the power-of-two chain capped at max_pages,
+        and _live_width covers every slot's frontier — including a
+        prefilling lane parked deep in its prompt — so no write can
+        clamp onto a live page."""
+        from veles_tpu.serving import LMEngine
+        params = _params()
+        engine = LMEngine(params, n_heads=2, max_len=96, slots=2,
+                          paged_kv=True, prefill_chunk=8,
+                          name="ak_width")
+        assert engine._width_ladder == [1, 2, 4, 8, 12]
+        engine._pos[:] = 0
+        assert engine._live_width(1) == 1
+        engine._pos[0] = 7          # page 0 frontier
+        assert engine._live_width(1) == 1
+        assert engine._live_width(2) == 2   # straddles into page 1
+        engine._pos[1] = 40         # a lane parked 5 pages deep
+        assert engine._live_width(1) == 8
+        engine._pos[1] = 88         # deepest legal frontier
+        assert engine._live_width(8) == 12  # capped at max_pages
 
 
 class TestPromptLookup:

@@ -243,3 +243,305 @@ class TestPallasLRN:
             return (PK.lrn_forward(a) ** 2).sum()
         g = jax.grad(loss)(x)
         assert numpy.isfinite(numpy.asarray(g)).all()
+
+
+@pytest.mark.kernel_parity
+class TestPagedFlashDecode:
+    """ISSUE 7: the flash-decode serving kernel (interpret mode = the
+    SAME kernel code the TPU compiles) against the XLA paged path —
+    ``paged_view`` gather + dense masked softmax — which the serving
+    parity matrix has already pinned bit-identical to ``generate``."""
+
+    def _setup(self, b=2, h=4, kv=2, c=1, dh=16, page=8, m=4,
+               n_pages=9, seed=0):
+        rng = numpy.random.RandomState(seed)
+        q = jnp.asarray(rng.randn(b, h, c, dh), jnp.float32)
+        kp = jnp.asarray(rng.randn(n_pages, kv, page, dh), jnp.float32)
+        vp = jnp.asarray(rng.randn(n_pages, kv, page, dh), jnp.float32)
+        ptab = jnp.asarray(rng.choice(
+            n_pages, size=(b, m), replace=False).reshape(b, m),
+            jnp.int32)
+        pos = jnp.asarray(rng.randint(0, m * page - c + 1, b),
+                          jnp.int32)
+        return q, kp, vp, ptab, pos
+
+    def _xla(self, q, kp, vp, ptab, pos, c, window=None, sinks=0):
+        from veles_tpu.ops import attention as A
+        h, kv = q.shape[1], kp.shape[1]
+        kx, vx = A.paged_view(kp, ptab), A.paged_view(vp, ptab)
+        kr = A._repeat_kv(kx, h)
+        vr = A._repeat_kv(vx, h)
+        s = jnp.einsum("bhcd,bhld->bhcl", q, kr) / jnp.sqrt(
+            jnp.float32(q.shape[-1]))
+        live = jax.vmap(lambda p: A.chunk_live_mask(
+            p, c, kx.shape[-2], window, sinks))(pos)
+        s = jnp.where(live[:, None], s, A.NEG_INF)
+        return jnp.einsum("bhcl,bhld->bhcd",
+                          jax.nn.softmax(s, axis=-1), vr)
+
+    @pytest.mark.parametrize("c,window,sinks", [
+        (1, None, 0),          # decode step
+        (4, None, 0),          # speculative verify (k+1)
+        (1, 10, 0),            # sliding window
+        (4, 10, 2),            # window + sinks, multi-query
+        (1, 10, 1),            # single query at the sink edge
+    ])
+    def test_matches_xla_paged_path(self, c, window, sinks):
+        from veles_tpu.ops import pallas_kernels as PK
+        q, kp, vp, ptab, pos = self._setup(c=c, m=6, n_pages=13,
+                                           seed=c + (window or 0))
+        got = PK.paged_flash_decode(q, kp, vp, ptab, pos,
+                                    window=window, sinks=sinks)
+        ref = self._xla(q, kp, vp, ptab, pos, c, window, sinks)
+        numpy.testing.assert_allclose(numpy.asarray(got),
+                                      numpy.asarray(ref),
+                                      rtol=1e-5, atol=1e-6)
+
+    @pytest.mark.parametrize("h,kv", [(4, 1), (4, 4), (8, 2)])
+    def test_grouped_query_layouts(self, h, kv):
+        """GQA folds into the kernel as a (kv, g·c) row reshape — every
+        grouping must agree with jnp.repeat's head mapping."""
+        from veles_tpu.ops import pallas_kernels as PK
+        q, kp, vp, ptab, pos = self._setup(h=h, kv=kv, c=3, seed=h * kv)
+        got = PK.paged_flash_decode(q, kp, vp, ptab, pos)
+        ref = self._xla(q, kp, vp, ptab, pos, 3)
+        numpy.testing.assert_allclose(numpy.asarray(got),
+                                      numpy.asarray(ref),
+                                      rtol=1e-5, atol=1e-6)
+
+    def test_early_position_masks_garbage_pages(self):
+        """A lane at pos=0 attends ONE row; the other pages hold
+        garbage the NEG_INF band + online rescale must zero exactly
+        (the blockwise_attention transient-term argument, in-kernel)."""
+        from veles_tpu.ops import pallas_kernels as PK
+        q, kp, vp, ptab, _ = self._setup(c=1, seed=5)
+        pos = jnp.zeros(q.shape[0], jnp.int32)
+        got = PK.paged_flash_decode(q, kp, vp, ptab, pos)
+        ref = self._xla(q, kp, vp, ptab, pos, 1)
+        numpy.testing.assert_allclose(numpy.asarray(got),
+                                      numpy.asarray(ref),
+                                      rtol=1e-5, atol=1e-6)
+
+    def test_mha_paged_chunk_step_kernel_route(self):
+        """attention.mha_paged_chunk_step(attn_kernel='decode') —
+        the wired route the engine's step/verify programs take —
+        matches its own XLA path: same projections, same rope, same
+        pool writes (bit-identical), attention to fp32 roundoff."""
+        from veles_tpu import prng
+        from veles_tpu.ops.attention import (init_mha_params,
+                                             mha_paged_chunk_step)
+        rng = numpy.random.RandomState(3)
+        d_model, n_heads, page, m, n_pages, b, c = 32, 4, 8, 4, 9, 2, 2
+        params = jax.tree.map(
+            jnp.asarray, init_mha_params(prng.get("init"), d_model,
+                                         n_heads, n_kv_heads=2))
+        x = jnp.asarray(rng.randn(b, c, d_model), jnp.float32)
+        kp = jnp.asarray(rng.randn(n_pages, 2, page, 8), jnp.float32)
+        vp = jnp.asarray(rng.randn(n_pages, 2, page, 8), jnp.float32)
+        ptab = jnp.asarray(rng.choice(n_pages, (b, m), replace=False)
+                           .reshape(b, m), jnp.int32)
+        pos = jnp.asarray([5, 13], jnp.int32)
+        ref_o, ref_k, ref_v = mha_paged_chunk_step(
+            params, x, kp, vp, ptab, pos, n_heads, rope=True,
+            window=16, sinks=1)
+        got_o, got_k, got_v = mha_paged_chunk_step(
+            params, x, kp, vp, ptab, pos, n_heads, rope=True,
+            window=16, sinks=1, attn_kernel="decode")
+        numpy.testing.assert_array_equal(numpy.asarray(got_k),
+                                         numpy.asarray(ref_k))
+        numpy.testing.assert_array_equal(numpy.asarray(got_v),
+                                         numpy.asarray(ref_v))
+        numpy.testing.assert_allclose(numpy.asarray(got_o),
+                                      numpy.asarray(ref_o),
+                                      rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.kernel_parity
+class TestPagedFlashPrefill:
+    """ISSUE 7: the fused chunked-prefill kernel — history streamed
+    below the frontier, the chunk's K/V attended from VMEM, and the
+    page install folded into the kernel epilogue (aliased outputs)."""
+
+    def _setup(self, b=1, h=4, kv=2, dh=16, page=8, m=4, n_pages=9,
+               n_hist=2, seed=0):
+        rng = numpy.random.RandomState(seed)
+        q = jnp.asarray(rng.randn(b, h, page, dh), jnp.float32)
+        kn = jnp.asarray(rng.randn(b, kv, page, dh), jnp.float32)
+        vn = jnp.asarray(rng.randn(b, kv, page, dh), jnp.float32)
+        kp = jnp.asarray(rng.randn(n_pages, kv, page, dh), jnp.float32)
+        vp = jnp.asarray(rng.randn(n_pages, kv, page, dh), jnp.float32)
+        ptab = jnp.asarray(rng.permutation(n_pages)[:b * m]
+                           .reshape(b, m), jnp.int32)
+        pos = jnp.asarray([n_hist * page] * b, jnp.int32)
+        return q, kn, vn, kp, vp, ptab, pos
+
+    def _xla(self, q, kn, vn, kp, vp, ptab, pos, window=None, sinks=0):
+        from veles_tpu.ops import attention as A
+        h, c = q.shape[1], q.shape[2]
+        kp = A.paged_write(kp, ptab, pos, kn)
+        vp = A.paged_write(vp, ptab, pos, vn)
+        kx, vx = A.paged_view(kp, ptab), A.paged_view(vp, ptab)
+        s = jnp.einsum("bhcd,bhld->bhcl", q, A._repeat_kv(kx, h)) \
+            / jnp.sqrt(jnp.float32(q.shape[-1]))
+        live = jax.vmap(lambda p: A.chunk_live_mask(
+            p, c, kx.shape[-2], window, sinks))(pos)
+        s = jnp.where(live[:, None], s, A.NEG_INF)
+        o = jnp.einsum("bhcl,bhld->bhcd", jax.nn.softmax(s, axis=-1),
+                       A._repeat_kv(vx, h))
+        return o, kp, vp
+
+    @pytest.mark.parametrize("n_hist,window,sinks", [
+        (0, None, 0),          # FIRST chunk: empty history
+        (2, None, 0),
+        (3, 20, 2),            # window reaching into history + sinks
+    ])
+    def test_matches_xla_and_installs(self, n_hist, window, sinks):
+        from veles_tpu.ops import pallas_kernels as PK
+        q, kn, vn, kp, vp, ptab, pos = self._setup(
+            n_hist=n_hist, seed=n_hist + (window or 0))
+        got_o, got_k, got_v = PK.paged_flash_prefill(
+            q, kn, vn, kp, vp, ptab, pos, window=window, sinks=sinks)
+        ref_o, ref_k, ref_v = self._xla(q, kn, vn, kp, vp, ptab, pos,
+                                        window, sinks)
+        # the install is a ROW COPY — bit-identical, and pages outside
+        # the chunk's target untouched (the aliasing contract)
+        numpy.testing.assert_array_equal(numpy.asarray(got_k),
+                                         numpy.asarray(ref_k))
+        numpy.testing.assert_array_equal(numpy.asarray(got_v),
+                                         numpy.asarray(ref_v))
+        numpy.testing.assert_allclose(numpy.asarray(got_o),
+                                      numpy.asarray(ref_o),
+                                      rtol=1e-5, atol=1e-6)
+
+    def test_batched_lanes_install_their_own_pages(self):
+        from veles_tpu.ops import pallas_kernels as PK
+        q, kn, vn, kp, vp, ptab, _ = self._setup(b=2, m=4, n_pages=11,
+                                                 seed=9)
+        pos = jnp.asarray([8, 24], jnp.int32)   # different frontiers
+        got_o, got_k, got_v = PK.paged_flash_prefill(
+            q, kn, vn, kp, vp, ptab, pos)
+        ref_o, ref_k, ref_v = self._xla(q, kn, vn, kp, vp, ptab, pos)
+        numpy.testing.assert_array_equal(numpy.asarray(got_k),
+                                         numpy.asarray(ref_k))
+        numpy.testing.assert_allclose(numpy.asarray(got_o),
+                                      numpy.asarray(ref_o),
+                                      rtol=1e-5, atol=1e-6)
+
+    def test_chunk_must_equal_page(self):
+        from veles_tpu.ops import pallas_kernels as PK
+        q, kn, vn, kp, vp, ptab, pos = self._setup()
+        with pytest.raises(ValueError, match="page"):
+            PK.paged_flash_prefill(q[:, :, :4], kn[:, :, :4],
+                                   vn[:, :, :4], kp, vp, ptab, pos)
+
+    def test_mha_paged_chunk_step_prefill_route(self):
+        """The engine's chunk program route ('prefill') against the
+        XLA path at a page-aligned frontier — outputs to roundoff,
+        pool installs bit-identical."""
+        from veles_tpu import prng
+        from veles_tpu.ops.attention import (init_mha_params,
+                                             mha_paged_chunk_step)
+        rng = numpy.random.RandomState(4)
+        d_model, n_heads, page, m, n_pages = 32, 4, 8, 4, 9
+        params = jax.tree.map(
+            jnp.asarray, init_mha_params(prng.get("init"), d_model,
+                                         n_heads))
+        x = jnp.asarray(rng.randn(1, page, d_model), jnp.float32)
+        kp = jnp.asarray(rng.randn(n_pages, 4, page, 8), jnp.float32)
+        vp = jnp.asarray(rng.randn(n_pages, 4, page, 8), jnp.float32)
+        ptab = jnp.asarray(rng.permutation(n_pages)[:m].reshape(1, m),
+                           jnp.int32)
+        pos = jnp.asarray([2 * page], jnp.int32)
+        ref_o, ref_k, ref_v = mha_paged_chunk_step(
+            params, x, kp, vp, ptab, pos, n_heads, rope=True)
+        got_o, got_k, got_v = mha_paged_chunk_step(
+            params, x, kp, vp, ptab, pos, n_heads, rope=True,
+            attn_kernel="prefill")
+        numpy.testing.assert_array_equal(numpy.asarray(got_k),
+                                         numpy.asarray(ref_k))
+        numpy.testing.assert_array_equal(numpy.asarray(got_v),
+                                         numpy.asarray(ref_v))
+        numpy.testing.assert_allclose(numpy.asarray(got_o),
+                                      numpy.asarray(ref_o),
+                                      rtol=1e-4, atol=1e-5)
+
+
+class TestServingKernelSupport:
+    def test_structural_checks(self):
+        from veles_tpu.ops import pallas_kernels as PK
+        assert PK.serving_kernels_supported(True, 4, 2, 16, 8) \
+            == (True, None)
+        ok, reason = PK.serving_kernels_supported(False, 4, 2, 16, 8)
+        assert not ok and "paged_kv" in reason
+        ok, reason = PK.serving_kernels_supported(True, 4, 3, 16, 8)
+        assert not ok and "divisible" in reason
+
+
+class TestFlashAttentionTPUCoverage:
+    """Satellite (ISSUE 7): flash_attention_tpu — the bundled jax TPU
+    kernel — pinned at its edges.  The kernel itself has no CPU
+    lowering in this jax (its interpret path trips a discharge-rule
+    bug upstream), so off-TPU coverage pins the ROUTING: the loud
+    error and the window/sink fallback; numerics are pinned by the
+    TPU-marked leg."""
+
+    def test_window_routes_away_from_kernel(self):
+        """mha_forward under backend 'flash_pallas' with a window (or
+        sinks) must take the XLA band path — bit-identical to backend
+        'xla', even off-TPU where the kernel itself would raise."""
+        from veles_tpu import prng
+        from veles_tpu.ops import attention as A
+        params = jax.tree.map(jnp.asarray, A.init_mha_params(
+            prng.get("init"), 32, 4))
+        x = jnp.asarray(numpy.random.RandomState(0).randn(2, 16, 32),
+                        jnp.float32)
+        ref = numpy.asarray(A.mha_forward(params, x, 4, causal=True,
+                                          window=8, sinks=2))
+        A.set_attention_backend("flash_pallas")
+        try:
+            got = numpy.asarray(A.mha_forward(params, x, 4,
+                                              causal=True, window=8,
+                                              sinks=2))
+            if not PK.on_tpu():
+                with pytest.raises(RuntimeError, match="TPU"):
+                    A.mha_forward(params, x, 4, causal=True)
+        finally:
+            A.set_attention_backend("xla")
+        numpy.testing.assert_array_equal(got, ref)
+
+    def test_flash_serve_backend_keeps_mha_on_xla(self):
+        """'flash_serve' only flips the SERVING engines' default —
+        mha_forward's path stays the XLA one (bit-identical), on any
+        platform."""
+        from veles_tpu import prng
+        from veles_tpu.ops import attention as A
+        params = jax.tree.map(jnp.asarray, A.init_mha_params(
+            prng.get("init"), 32, 4))
+        x = jnp.asarray(numpy.random.RandomState(1).randn(2, 16, 32),
+                        jnp.float32)
+        ref = numpy.asarray(A.mha_forward(params, x, 4, causal=True))
+        A.set_attention_backend("flash_serve")
+        try:
+            assert A.serving_kernel_default()
+            got = numpy.asarray(A.mha_forward(params, x, 4,
+                                              causal=True))
+        finally:
+            A.set_attention_backend("xla")
+        assert not A.serving_kernel_default()
+        numpy.testing.assert_array_equal(got, ref)
+
+    @pytest.mark.skipif(not PK.on_tpu(),
+                        reason="the bundled kernel has no CPU lowering")
+    def test_matches_attention_on_tpu(self):
+        """The hardware parity pin: the bundled kernel vs our
+        ``attention`` oracle at serving-ish shape."""
+        from veles_tpu.ops import attention as A
+        key = jax.random.PRNGKey(2)
+        q = jax.random.normal(key, (2, 4, 256, 64), jnp.float32)
+        k = jax.random.normal(jax.random.fold_in(key, 1), q.shape)
+        v = jax.random.normal(jax.random.fold_in(key, 2), q.shape)
+        ref = A.attention(q, k, v, causal=True)
+        got = A.flash_attention_tpu(q, k, v, causal=True)
+        numpy.testing.assert_allclose(numpy.asarray(got),
+                                      numpy.asarray(ref),
+                                      rtol=2e-3, atol=2e-3)

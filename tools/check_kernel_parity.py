@@ -1,0 +1,57 @@
+"""Standalone smoke target for the serving attention kernels (ISSUE 7).
+
+Runs the interpret-mode Pallas-vs-XLA parity suite — every test marked
+``kernel_parity`` in tests/test_pallas.py — as ONE fast pytest
+invocation on CPU, and refuses (exit 1) if the suite exceeds the
+60-second budget the CI wiring promises.  The marker set is tier-1
+(``-m 'not slow'`` runs it too); this entry point exists so a kernel
+change can be validated in seconds without the whole tier-1 ladder,
+and so an external CI lane has one command to call::
+
+    python tools/check_kernel_parity.py [--budget-s 60] [--list]
+
+Exit code: pytest's (0 = parity holds), or 1 on budget overrun.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--budget-s", type=float, default=60.0,
+                        help="wall-clock budget; overrun fails even if "
+                             "every test passed (the <60s smoke "
+                             "contract)")
+    parser.add_argument("--list", action="store_true",
+                        help="collect-only: show the parity tests "
+                             "without running them")
+    args = parser.parse_args(argv)
+    cmd = [sys.executable, "-m", "pytest",
+           os.path.join(REPO, "tests", "test_pallas.py"),
+           "-m", "kernel_parity", "-q",
+           "-p", "no:cacheprovider", "-p", "no:randomly"]
+    if args.list:
+        cmd.append("--collect-only")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    t0 = time.monotonic()
+    rc = subprocess.call(cmd, cwd=REPO, env=env)
+    wall = time.monotonic() - t0
+    print("kernel-parity suite: rc=%d in %.1fs (budget %.0fs)"
+          % (rc, wall, args.budget_s), flush=True)
+    if rc == 0 and not args.list and wall > args.budget_s:
+        print("FAIL: parity suite exceeded its smoke budget — trim it "
+              "or move cases to the slow suite", file=sys.stderr)
+        return 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -53,6 +53,56 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 from load_gen import lm_prompts  # noqa: E402
 
 
+#: advertised peak FLOPs by TPU device kind (bf16 matmul peak — the
+#: MFU denominator convention; fp32 serving reads lower, which only
+#: makes the reported MFU conservative).  Overridable via
+#: VELES_PEAK_FLOPS for new silicon or calibrated CPU baselines.
+TPU_PEAK_FLOPS = (
+    ("v5 lite", 197e12), ("v5e", 197e12), ("v5p", 459e12),
+    ("v4", 275e12), ("v6", 918e12),
+)
+#: nominal single-core CPU matmul ceiling — keeps the MFU column
+#: well-defined (and honestly tiny) on CPU runs; real MFU claims come
+#: from TPU sessions (docs/PERF.md)
+CPU_NOMINAL_FLOPS = 1e11
+
+
+def peak_flops_estimate():
+    """(peak_flops, source_label) for the MFU denominator: the env
+    override wins, then the TPU device-kind table, then the CPU
+    nominal.  The label travels in every record so a reader can tell a
+    calibrated number from a nominal one."""
+    import jax
+    env = os.environ.get("VELES_PEAK_FLOPS")
+    if env:
+        return float(env), "env:VELES_PEAK_FLOPS"
+    from veles_tpu.ops.pallas_kernels import on_tpu
+    if on_tpu():
+        kind = jax.devices()[0].device_kind.lower()
+        for name, peak in TPU_PEAK_FLOPS:
+            if name in kind:
+                return peak, "tpu:%s" % name
+        return 197e12, "tpu:unknown-kind-default"
+    return CPU_NOMINAL_FLOPS, "cpu:nominal"
+
+
+def decode_flops_per_token(vocab, d_model, n_layers, ctx,
+                           n_heads=4, kv_heads=None, d_ff=None):
+    """Model FLOPs one KV-cached greedy token costs (forward only):
+    the qkvo projections, FFN and head matmuls plus the two attention
+    matmuls against ``ctx`` resident rows — the numerator of the MFU
+    column (matmul FLOPs only; layernorms/softmax are noise at these
+    widths)."""
+    kv = kv_heads or n_heads
+    d_kv = d_model // n_heads * kv
+    d_ff = d_ff or 4 * d_model
+    proj = 2 * d_model * (2 * d_model + 2 * d_kv)      # wq, wo, wk, wv
+    ffn = 4 * d_model * d_ff
+    attn = 4 * ctx * d_model                           # q·K + p·V
+    head = 2 * d_model * vocab
+    return n_layers * (proj + ffn + attn) + head
+
+
 def build_params(vocab=32, d_model=64, n_heads=4, n_layers=2,
                  max_len=256, seed=7):
     import jax
@@ -98,9 +148,12 @@ def expected_rows(params, prompts, n_new, n_heads, max_len):
 
 
 def run_leg(params, n_heads, max_len, prompts, n_new, expect,
-            slots=4, **engine_kw):
+            slots=4, flops_per_token=None, **engine_kw):
     """One engine config over one prompt list; returns the metrics
-    record (parity asserted, not reported on faith).
+    record (parity asserted, not reported on faith), including the
+    MFU column (``flops_per_token`` × warm tokens/s over the
+    platform's peak — ISSUE 7's the-gap-is-kernel-shaped metric) and,
+    on ``attn_kernel`` legs, which attention path actually ran.
 
     The workload runs TWICE: the COLD pass supplies the prefill /
     prefix-cache accounting (what a first arrival of this traffic
@@ -136,6 +189,15 @@ def run_leg(params, n_heads, max_len, prompts, n_new, expect,
         cc, c = cold["counters"], warm["counters"]
         tokens = c.get("tokens_out", 0)
         dispatches = c.get("decode_dispatches", 0)
+        if engine_kw.get("attn_kernel"):
+            from veles_tpu.ops.pallas_kernels import on_tpu
+            if not on_tpu() and engine_kw["attn_kernel"] != "force" \
+                    and not c.get("attn_kernel_fallbacks"):
+                # the CPU acceptance criterion: the fallback path must
+                # be EXERCISED and METERED, not silently absent
+                raise AssertionError(
+                    "attn_kernel leg on CPU did not increment the "
+                    "fallback counter under %r" % (engine_kw,))
         if engine_kw.get("paged_kv"):
             # the paged layout has NO row-copy install path — a prefix
             # hit is a page reference; any copy counted here is a bug
@@ -145,12 +207,24 @@ def run_leg(params, n_heads, max_len, prompts, n_new, expect,
                     "prefix hits must be page references"
                     % (cc.get("kv_row_copies", 0)
                        + c.get("kv_row_copies", 0), engine_kw))
+        tps = tokens / wall if wall else 0.0
+        peak, peak_src = peak_flops_estimate()
+        mfu = (tps * flops_per_token / peak
+               if flops_per_token else None)
         return {
             "features": {k: v for k, v in engine_kw.items() if v},
             "requests": len(prompts),
             "tokens_out": tokens,
             "wall_s": round(wall, 4),
-            "tokens_per_sec": round(tokens / wall, 1) if wall else 0.0,
+            "tokens_per_sec": round(tps, 1),
+            # the ISSUE 7 column: model FLOPs actually flowing over the
+            # platform's advertised peak — the kernel-vs-XLA legs read
+            # off against each other here
+            "mfu": round(mfu, 6) if mfu is not None else None,
+            "mfu_peak_source": peak_src,
+            "attn_kernel_dispatches": c.get("attn_kernel_dispatches",
+                                            0),
+            "attn_kernel_fallbacks": c.get("attn_kernel_fallbacks", 0),
             "decode_dispatches": dispatches,
             "dispatches_per_token": (round(dispatches / tokens, 3)
                                      if tokens else None),
@@ -193,14 +267,19 @@ def fixed_kv_memory_comparison(params, n_heads, max_len, chunk, n_new,
     lo, hi = max(4, chunk // 2), max(chunk, (max_len - n_new) // 2)
     prompts = mixed_length_prompts(requests, vocab, lo, hi)
     expect = expected_rows(params, prompts, n_new, n_heads, max_len)
+    fpt = decode_flops_per_token(
+        vocab, params["embed"].shape[1], len(params["blocks"]),
+        int(numpy.mean([len(p) for p in prompts])) + n_new // 2,
+        n_heads=n_heads)
     contig = run_leg(params, n_heads, max_len, prompts, n_new, expect,
-                     slots=budget_slots)
+                     slots=budget_slots, flops_per_token=fpt)
     # -1: the reserved scratch page counts against the byte budget, so
     # both layouts hold EXACTLY budget_slots·max_len KV rows per block
     pool_pages = budget_slots * max_len // chunk - 1
     paged = run_leg(params, n_heads, max_len, prompts, n_new, expect,
                     slots=min(requests, pool_pages),
-                    paged_kv=pool_pages, prefill_chunk=chunk)
+                    paged_kv=pool_pages, prefill_chunk=chunk,
+                    flops_per_token=fpt)
     ratio = paged["slots_busy_peak"] / float(budget_slots)
     return {
         "budget_slots_contiguous": budget_slots,
@@ -231,6 +310,8 @@ def run_bench(smoke=False, slots=4, chunk=16, cache=256, spec_k=4,
         n_new, requests = 8, 4
     params = build_params(vocab=vocab, max_len=max_len)
     n_heads = 4
+    d_model = int(params["embed"].shape[1])
+    n_layers = len(params["blocks"])
     feature_sets = {
         "baseline": {},
         "chunked": {"prefill_chunk": chunk},
@@ -244,6 +325,17 @@ def run_bench(smoke=False, slots=4, chunk=16, cache=256, spec_k=4,
         "paged": {"paged_kv": True, "prefill_chunk": chunk},
         "paged_all": {"paged_kv": True, "prefix_cache": cache,
                       "prefill_chunk": chunk, "spec_k": spec_k},
+        # ISSUE 7: the Pallas serving kernels against the same
+        # workloads — the kernel-vs-XLA MFU comparison reads off the
+        # 'paged' legs above.  On CPU these run the automatic XLA
+        # fallback END TO END (no crash, attn_kernel_fallbacks
+        # increments — asserted by run_leg); the kernel MFU numbers
+        # are a TPU-session fact.
+        "paged_kernel": {"paged_kv": True, "prefill_chunk": chunk,
+                         "attn_kernel": "auto"},
+        "paged_kernel_all": {"paged_kv": True, "prefix_cache": cache,
+                             "prefill_chunk": chunk, "spec_k": spec_k,
+                             "attn_kernel": "auto"},
     }
     # workload A: shared system prompt (load_gen's generator — one
     # request per "client", every prompt shares the prefix)
@@ -259,8 +351,8 @@ def run_bench(smoke=False, slots=4, chunk=16, cache=256, spec_k=4,
     mixed = mixed_length_prompts(
         requests, vocab, max(4, chunk // 2),
         max(chunk, (max_len - n_new - spec_k - 1) // 2))
-    results = {"model": {"vocab": vocab, "d_model": 64, "n_layers": 2,
-                         "max_len": max_len},
+    results = {"model": {"vocab": vocab, "d_model": d_model,
+                         "n_layers": n_layers, "max_len": max_len},
                "slots": slots, "n_new": n_new,
                "workloads": {}}
 
@@ -281,10 +373,15 @@ def run_bench(smoke=False, slots=4, chunk=16, cache=256, spec_k=4,
             ("repetitive", rep, slots),
             ("repetitive_single_lane", rep[:max(2, requests // 2)], 1)):
         expect = expected_rows(params, prompts, n_new, n_heads, max_len)
+        fpt = decode_flops_per_token(
+            vocab, d_model, n_layers,
+            int(numpy.mean([len(p) for p in prompts])) + n_new // 2,
+            n_heads=n_heads)
         legs = results["workloads"].setdefault(wname, {})
         for fname, kw in feature_sets.items():
             legs[fname] = run_leg(params, n_heads, max_len, prompts,
-                                  n_new, expect, slots=wslots, **kw)
+                                  n_new, expect, slots=wslots,
+                                  flops_per_token=fpt, **kw)
             print("%s/%s: %s" % (wname, fname, json.dumps(legs[fname])),
                   file=sys.stderr)
             stream_summary()
@@ -318,8 +415,39 @@ def run_bench(smoke=False, slots=4, chunk=16, cache=256, spec_k=4,
             sp_paged["kv_pages_referenced"],
         "slots_at_fixed_kv_memory_ratio":
             fixed["slots_ratio_vs_contiguous"],
+        # ISSUE 7: the kernel-vs-XLA MFU pair on the same workload
+        # (identical on CPU where the kernel leg falls back — the
+        # split is a TPU-session fact) plus the which-path evidence
+        "mfu_paged_xla_shared_prefix":
+            results["workloads"]["shared_prefix"]["paged"]["mfu"],
+        "mfu_paged_kernel_shared_prefix":
+            results["workloads"]["shared_prefix"]["paged_kernel"]
+            ["mfu"],
+        "attn_kernel_dispatches_shared_prefix":
+            results["workloads"]["shared_prefix"]["paged_kernel"]
+            ["attn_kernel_dispatches"],
+        "attn_kernel_fallbacks_shared_prefix":
+            results["workloads"]["shared_prefix"]["paged_kernel"]
+            ["attn_kernel_fallbacks"],
     }
     return results
+
+
+def _latest_mfu(results):
+    """The newest completed leg's MFU — the per-line column the
+    streamed partial records carry (a watchdog kill still banks an
+    MFU reading for whatever finished last)."""
+    mfu = None
+    for legs in (results.get("workloads") or {}).values():
+        for leg in legs.values():
+            if leg.get("mfu") is not None:
+                mfu = leg["mfu"]
+    fixed = results.get("fixed_kv_memory") or {}
+    for key in ("contiguous", "paged"):
+        leg = fixed.get(key)
+        if leg and leg.get("mfu") is not None:
+            mfu = leg["mfu"]
+    return mfu
 
 
 def summary_record(results):
@@ -330,11 +458,16 @@ def summary_record(results):
     disagree: the fixed-KV-memory slot ratio once that leg has run
     (the ISSUE 6 acceptance headline), any paged shared-prefix leg's
     zero-row-copy fact before that, tokens/s of the newest completed
-    leg as the early-partial fallback."""
+    leg as the early-partial fallback.  EVERY line carries an ``mfu``
+    column (ISSUE 7): the newest completed leg's model-FLOPs
+    utilization, so a killed run still banks the kernel-vs-XLA
+    reading."""
+    mfu = _latest_mfu(results)
     fixed = results.get("fixed_kv_memory") or {}
     if fixed.get("slots_ratio_vs_contiguous") is not None:
         return {
             "metric": "lm_paged_slots_at_fixed_kv_memory_ratio",
+            "mfu": mfu,
             "value": fixed["slots_ratio_vs_contiguous"],
             "unit": "x_vs_contiguous",
             "vs_baseline": 1.0,
@@ -346,6 +479,7 @@ def summary_record(results):
     if paged_sp is not None:
         return {
             "metric": "lm_paged_shared_prefix_kv_row_copies",
+            "mfu": mfu,
             "value": paged_sp["kv_row_copies"],
             "unit": "rows",
             "vs_baseline": None,
@@ -358,6 +492,7 @@ def summary_record(results):
     if latest is not None:
         return {
             "metric": "lm_fastpath_tokens_per_sec",
+            "mfu": mfu,
             "value": latest["tokens_per_sec"],
             "unit": "tokens/sec",
             "vs_baseline": None,
@@ -365,6 +500,7 @@ def summary_record(results):
         }, 0
     return {
         "metric": "lm_fastpath_no_legs_completed",
+        "mfu": mfu,
         "value": None,
         "unit": None,
         "vs_baseline": None,

@@ -1,4 +1,5 @@
-"""Microbenchmark AlexNet train-step components on the real chip.
+"""Microbenchmark train-step AND serving-attention components on the
+real chip — the per-op cost table.
 
 The tunnel adds O(100ms) per dispatch, so per-op cost is measured by
 repeating the op K times INSIDE one jit (fori_loop with a scalar data
@@ -9,23 +10,57 @@ through the tunnel — see bench.py).
 The round-3 patch-materializing pooling / cumsum LRN are kept here as
 local copies so the current native implementations can always be
 re-compared against them (the r3→r4 rewrite rationale: docs/PERF.md).
+
+ISSUE 7 adds the serving attention rows (decode step / chunked
+prefill, contiguous / paged, Pallas kernel vs XLA — the inputs the
+ROADMAP autotuning item will select between) and the bench.py
+streaming discipline: after EVERY completed row one summary_record
+JSON line goes to stdout (metric/value/unit/vs_baseline/configs,
+last-line-wins), so an outer watchdog kill still leaves a parseable
+record of everything measured so far.
 """
+import argparse
+import json
+import os
+import sys
 import time
 
 import numpy
 import jax
 import jax.numpy as jnp
 
-from veles_tpu.ops import functional as F
+# run as a script, tools/ is on sys.path but the repo root (veles_tpu/)
+# is not
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from veles_tpu.ops import functional as F  # noqa: E402
 
 K = 20
+
+#: accumulated {row name: per-op ms} — the ``configs`` payload of every
+#: streamed summary line
+RESULTS = {}
+
+
+def stream_summary():
+    """Bank everything measured so far as ONE stdout JSON line in the
+    bench.py summary_record shape — a watchdog kill keeps the last."""
+    last = next(reversed(RESULTS)) if RESULTS else None
+    print(json.dumps({
+        "metric": "profile_ops_row_ms",
+        "value": RESULTS.get(last),
+        "unit": "ms/op",
+        "vs_baseline": None,
+        "configs": {"last_row": last, "rows_ms": dict(RESULTS)},
+    }), flush=True)
 
 
 def _sync(x):
     return numpy.asarray(jax.tree.leaves(x)[0]).ravel()[0]
 
 
-def bench_op(name, op, x, n_timed=3):
+def bench_op(name, op, x, n_timed=3, reps=K):
     """op: x -> y (any shape).  Reports per-application device time."""
     def chain(x, k):
         def body(i, carry):
@@ -35,7 +70,7 @@ def bench_op(name, op, x, n_timed=3):
         return jax.lax.fori_loop(0, k, body, x)
 
     f0 = jax.jit(lambda x: chain(x, 1))
-    fk = jax.jit(lambda x: chain(x, 1 + K))
+    fk = jax.jit(lambda x: chain(x, 1 + reps))
     _sync(f0(x)); _sync(fk(x))  # compile both
     ts = []
     for variant in (f0, fk):
@@ -46,8 +81,11 @@ def bench_op(name, op, x, n_timed=3):
             _sync(out)
             best = min(best, time.perf_counter() - begin)
         ts.append(best)
-    per_op = (ts[1] - ts[0]) / K
-    print("%-44s %10.3f ms" % (name, per_op * 1e3), flush=True)
+    per_op = (ts[1] - ts[0]) / reps
+    print("%-44s %10.3f ms" % (name, per_op * 1e3), flush=True,
+          file=sys.stderr)
+    RESULTS[name] = round(per_op * 1e3, 4)
+    stream_summary()
     return per_op
 
 
@@ -73,7 +111,88 @@ def _r3_cumsum_lrn(x, alpha=1e-4, beta=0.75, n=5, k=2.0):
     return x / (k + (alpha / n) * window_sums) ** beta
 
 
-def main():
+# ---- serving attention rows (ISSUE 7) --------------------------------
+def attention_rows(kernels="auto"):
+    """Per-op cost of the serving hot loop's attention programs at the
+    lm-bench geometry: decode step (c=1) and chunked prefill (c=page),
+    contiguous vs paged storage, XLA vs the Pallas serving kernels —
+    the same pairs tools/lm_bench.py reads end-to-end, isolated here
+    per dispatch (autotuning seed data).
+
+    ``kernels``: 'auto' rows the Pallas kernels only on real TPU
+    hardware (off-TPU they would run in interpret mode — minutes per
+    timing rep, useless numbers); 'force' insists (parity spelunking);
+    'off' skips them."""
+    from veles_tpu import prng
+    from veles_tpu.ops import attention as A
+    from veles_tpu.ops.pallas_kernels import on_tpu
+
+    d_model, n_heads, max_len, page, b = 64, 4, 256, 16, 4
+    params = jax.tree.map(jnp.asarray, A.init_mha_params(
+        prng.get("profile_attn"), d_model, n_heads))
+    rng = numpy.random.RandomState(11)
+    kv = A.kv_heads_of(params, n_heads, d_model)
+    dh = d_model // n_heads
+    m = max_len // page                       # pages per lane
+    n_pages = b * m + 1                       # + reserved scratch page
+    kc = jnp.asarray(rng.randn(b, kv, max_len, dh), jnp.float32)
+    vc = jnp.asarray(rng.randn(b, kv, max_len, dh), jnp.float32)
+    kp = jnp.asarray(rng.randn(n_pages, kv, page, dh), jnp.float32)
+    vp = jnp.asarray(rng.randn(n_pages, kv, page, dh), jnp.float32)
+    ptab = jnp.asarray(
+        1 + numpy.arange(b * m).reshape(b, m), jnp.int32)
+    pos_mid = jnp.full((b,), max_len // 2, jnp.int32)  # page-aligned
+    pos_scalar = jnp.asarray(max_len // 2, jnp.int32)  # contiguous path
+
+    x1 = jnp.asarray(rng.randn(b, 1, d_model), jnp.float32)
+    xc = jnp.asarray(rng.randn(b, page, d_model), jnp.float32)
+
+    def contig(a):
+        return A.mha_chunk_step(
+            params, a, kc, vc, pos_scalar, n_heads, rope=True)[0]
+
+    def paged(kern=None):
+        return lambda a: A.mha_paged_chunk_step(
+            params, a, kp, vp, ptab, pos_mid, n_heads, rope=True,
+            attn_kernel=kern)[0]
+
+    bench_op("attn decode step c=1 (contiguous)", contig, x1)
+    bench_op("attn chunk prefill c=%d (contiguous)" % page, contig, xc)
+    bench_op("attn decode step c=1 (paged, xla)", paged(), x1)
+    bench_op("attn chunk prefill c=%d (paged, xla)" % page, paged(),
+             xc)
+    run_kernels = (kernels == "force"
+                   or (kernels == "auto" and on_tpu()))
+    if run_kernels:
+        bench_op("attn decode step c=1 (paged, pallas kernel)",
+                 paged("decode"), x1, reps=5)
+        bench_op("attn chunk prefill c=%d (paged, pallas kernel)"
+                 % page, paged("prefill"), xc, reps=5)
+    elif kernels == "auto":
+        print("(pallas kernel rows skipped off-TPU — interpret mode "
+              "measures the interpreter, not the kernel; pass "
+              "--attn-kernels force to insist)", file=sys.stderr)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--only", default="all",
+                    choices=("all", "alexnet", "attention"),
+                    help="which section of the cost table to run")
+    ap.add_argument("--attn-kernels", default="auto",
+                    choices=("auto", "force", "off"),
+                    help="Pallas serving-kernel rows: auto = only on "
+                         "real TPU hardware; force = interpret mode "
+                         "off-TPU (slow, parity gear); off = skip")
+    args = ap.parse_args(argv)
+    if args.only in ("all", "attention"):
+        attention_rows(kernels=args.attn_kernels)
+    if args.only in ("all", "alexnet"):
+        alexnet_rows()
+    stream_summary()
+
+
+def alexnet_rows():
     key = jax.random.PRNGKey(0)
     B = 128
 
@@ -142,13 +261,15 @@ def main():
     # ---- roofline sanity
     xm = jax.random.normal(key, (4096, 4096), jnp.float32)
     t = bench_op("matmul 4096^3 HIGHEST", lambda x: F.matmul(x, x), xm)
-    print("   -> %.1f TF/s fp32-HIGHEST" % (2 * 4096**3 / t / 1e12))
+    print("   -> %.1f TF/s fp32-HIGHEST" % (2 * 4096**3 / t / 1e12),
+          file=sys.stderr)
 
     def mm_bf16(x):
         return jnp.matmul(x.astype(jnp.bfloat16),
                           x.astype(jnp.bfloat16)).astype(jnp.float32)
     t = bench_op("matmul 4096^3 bf16-cast", mm_bf16, xm)
-    print("   -> %.1f TF/s bf16" % (2 * 4096**3 / t / 1e12))
+    print("   -> %.1f TF/s bf16" % (2 * 4096**3 / t / 1e12),
+          file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -212,6 +212,21 @@ def build_argparser():
                              "(slots * max_len / chunk pages, + the "
                              "reserved scratch page); 0 = "
                              "contiguous KV")
+    parser.add_argument("--serve-attn-kernel", default="off",
+                        choices=("off", "auto", "force"),
+                        metavar="MODE",
+                        help="with --serve-slots and --serve-paged-kv: "
+                             "run the engine's attention through the "
+                             "Pallas serving kernels (flash-decode "
+                             "over the paged KV pool + fused chunked "
+                             "prefill; ops/pallas_kernels.py). 'auto' "
+                             "= kernels on real TPU hardware, XLA "
+                             "fallback elsewhere (logged once, "
+                             "metered as attn_kernel_fallbacks); "
+                             "'force' = kernels even off-TPU via "
+                             "interpret mode (tests only — orders of "
+                             "magnitude slower than the fallback); "
+                             "'off' = the XLA path (default)")
     return parser
 
 
@@ -403,7 +418,10 @@ def main(argv=None):
                            prefill_chunk=args.serve_prefill_chunk,
                            spec_k=args.serve_spec_k,
                            paged_kv=(True if args.serve_paged_kv < 0
-                                     else args.serve_paged_kv))
+                                     else args.serve_paged_kv),
+                           attn_kernel=(0 if args.serve_attn_kernel
+                                        == "off"
+                                        else args.serve_attn_kernel))
         else:
             api = RESTfulAPI(
                 wf, normalizer=getattr(wf.loader, "normalizer", None))

@@ -233,22 +233,34 @@ def mha_decode_step_rolling(params, x, k_cache, v_cache, slot, live,
 
 #: attention backend for mha_forward's non-windowed causal path:
 #: 'xla' (dense or our blockwise scan) | 'flash_pallas' (the bundled
-#: TPU Pallas kernel above).  Benchmarked by bench.py's lm config on
+#: TPU Pallas kernel above) | 'flash_serve' (ISSUE 7: 'xla' for
+#: mha_forward, but serving engines built while it is set default
+#: their ``attn_kernel`` to 'auto' — the paged flash-decode /
+#: fused-prefill kernels in ops/pallas_kernels.py, with the engine's
+#: XLA fallback rules).  Benchmarked by bench.py's lm config on
 #: hardware; the default stays whichever wins there.
 _ATTN_BACKEND = "xla"
 
 
 def set_attention_backend(mode):
-    """mode: 'xla' | 'flash_pallas'.  Clears jit caches (trace-time
-    flag) — but only on an actual change, so a restore-to-current no-op
-    doesn't wipe every compiled function in the process."""
+    """mode: 'xla' | 'flash_pallas' | 'flash_serve'.  Clears jit caches
+    (trace-time flag) — but only on an actual change, so a
+    restore-to-current no-op doesn't wipe every compiled function in
+    the process."""
     global _ATTN_BACKEND
-    if mode not in ("xla", "flash_pallas"):
+    if mode not in ("xla", "flash_pallas", "flash_serve"):
         raise ValueError("unknown attention backend %r" % (mode,))
     if mode == _ATTN_BACKEND:
         return
     _ATTN_BACKEND = mode
     jax.clear_caches()
+
+
+def serving_kernel_default():
+    """True when the global backend asks serving engines to default
+    ``attn_kernel`` on (``set_attention_backend('flash_serve')``) —
+    consulted by ``LMEngine`` at construction, never mid-flight."""
+    return _ATTN_BACKEND == "flash_serve"
 
 
 # ------------------------------------------------------------ MHA as layer
@@ -491,7 +503,8 @@ def paged_write(pool, ptab, pos, rows):
 
 
 def mha_paged_chunk_step(params, x, k_pool, v_pool, ptab, pos, n_heads,
-                         rope=False, window=None, sinks=0):
+                         rope=False, window=None, sinks=0,
+                         attn_kernel=None):
     """``c`` positions per lane against the PAGED KV pool in one pass —
     :func:`mha_chunk_step` with the storage indirected through a page
     table, batched over lanes (each at its own traced ``pos``).
@@ -505,9 +518,26 @@ def mha_paged_chunk_step(params, x, k_pool, v_pool, ptab, pos, n_heads,
     the paged decode step; at c=k+1 the paged speculative verify; with
     b=1, c=chunk the paged prefill chunk — ONE core, so the paged
     decompositions can never drift from each other.  The gathered view
-    has the same (kv, m·page, dh) shape for every lane, so with
-    m·page == max_len the scores matrix is shape-identical to the
-    contiguous path and greedy outputs stay bit-identical."""
+    has the same (kv, m·page, dh) shape for every lane.  Callers may
+    pass a ``ptab`` sliced NARROWER than max_len/page as long as it
+    covers every lane's live rows (the engine's live-width ladder,
+    ISSUE 7): masked tail columns contribute exactly-zero softmax
+    terms, so the shorter reductions agree with the full-width ones
+    except under reduction-order reassociation of the SAME live
+    values — the greedy parity matrix (tests/test_lm_fastpath.py)
+    pins outputs bit-identical to the contiguous path across the
+    ladder on the test platform.
+
+    ``attn_kernel`` (STATIC) routes the attention through the Pallas
+    serving kernels (ISSUE 7) instead of the gather + dense softmax:
+    'decode' (any c, any alignment — the pool is written first, then
+    ``pallas_kernels.paged_flash_decode`` walks the table in-kernel; no
+    (b, kv, L, dh) view is ever materialized) or 'prefill' (c must
+    equal the page size and ``pos`` be page-aligned — the caller's
+    contract; ``paged_flash_prefill`` streams the history and installs
+    the chunk's rows in its epilogue).  None/False = the XLA path.
+    Kernel outputs match XLA to fp32 roundoff (online softmax), which
+    preserves the greedy argmax the serving contract pins."""
     b, c, d = x.shape
     dh = d // n_heads
     kv = kv_heads_of(params, n_heads, d)
@@ -517,12 +547,26 @@ def mha_paged_chunk_step(params, x, k_pool, v_pool, ptab, pos, n_heads,
 
     q = split(params["wq"], n_heads)            # (b, h, c, dh)
     k_new = split(params["wk"], kv)
+    v_new = split(params["wv"], kv)
     if rope:
         positions = jnp.asarray(pos)[:, None] + jnp.arange(c)   # (b, c)
         q = rope_rotate_batched(q, positions)
         k_new = rope_rotate_batched(k_new, positions)
+    if attn_kernel:
+        from veles_tpu.ops import pallas_kernels as PK
+        if attn_kernel == "prefill":
+            o, k_pool, v_pool = PK.paged_flash_prefill(
+                q, k_new, v_new, k_pool, v_pool, ptab, pos,
+                window=window, sinks=sinks)
+        else:
+            k_pool = paged_write(k_pool, ptab, pos, k_new)
+            v_pool = paged_write(v_pool, ptab, pos, v_new)
+            o = PK.paged_flash_decode(q, k_pool, v_pool, ptab, pos,
+                                      window=window, sinks=sinks)
+        o = o.transpose(0, 2, 1, 3).reshape(b, c, d)
+        return matmul(o, params["wo"]), k_pool, v_pool
     k_pool = paged_write(k_pool, ptab, pos, k_new)
-    v_pool = paged_write(v_pool, ptab, pos, split(params["wv"], kv))
+    v_pool = paged_write(v_pool, ptab, pos, v_new)
     kx = paged_view(k_pool, ptab)               # (b, kv, L, dh)
     vx = paged_view(v_pool, ptab)
     scores = matmul(q, jnp.swapaxes(_repeat_kv(kx, n_heads),

@@ -13,6 +13,31 @@ threefry — the real-kernel keep statistics are asserted by a TPU-marked
 test (tests/test_pallas.py) that must be run on hardware.  Both kernels
 have jax/XLA equivalents in ``functional``; selection is explicit (bench
 flags / caller opt-in), never silent.
+
+The SERVING ATTENTION SUITE (ISSUE 7) is the hot-loop half: the paged LM
+engine's decode/verify/prefill dispatches spend their bandwidth in
+``ops/attention.py::paged_view`` — a gather that materializes every
+lane's full (kv, max_len, dh) cache view in HBM before one (c,)-token
+query reads a fraction of it.  Two kernels walk the page table INSIDE
+the kernel instead, so no densified view ever exists:
+
+- :func:`paged_flash_decode` — flash-decode over the paged KV pool: the
+  grid is (lane, page), each step streams ONE pool page through VMEM
+  into an online-softmax accumulator (the ``attention._online_update``
+  recurrence), with the ``chunk_live_mask`` causal/window/sink band
+  applied in-kernel.  Serves the single-token decode step AND the
+  (k+1)-token speculative verify (queries are (c,) per lane).
+- :func:`paged_flash_prefill` — fused chunked prefill: the chunk's new
+  K/V enter as VMEM operands (never read back from HBM), history pages
+  stream like decode, and the kernel's EPILOGUE installs the chunk's
+  rows into the lane's pool page through aliased outputs — the
+  ``paged_write`` scatter folded into the same program.
+
+Both run in interpret mode off-TPU (the CPU parity suite,
+``tests/test_pallas.py -m kernel_parity`` / ``tools/
+check_kernel_parity.py``); the serving engine only routes through them
+on real TPU hardware (or when forced) — see ``serving/lm_engine.py``'s
+``attn_kernel`` fallback rules.
 """
 
 from __future__ import annotations
@@ -21,6 +46,12 @@ import functools
 
 import jax
 import jax.numpy as jnp
+
+# the XLA reference path's finite masking constant — the kernels MUST
+# share it exactly: the all-masked-block rescale argument in
+# _flash_step relies on masked scores being bitwise the same value on
+# both sides of the parity suite
+from veles_tpu.ops.attention import NEG_INF
 
 
 def on_tpu():
@@ -284,3 +315,254 @@ def dropout(x, seed, rate, interpret=None):
         interpret=_interpret(interpret),
     )(jnp.asarray([seed], jnp.int32), x2)
     return out.reshape(-1)[:n].reshape(shape)
+
+
+# ------------------------------------------------ paged flash attention
+# The serving hot loop (ISSUE 7).  Shared geometry: the KV pool is
+# (n_pages, kv_heads, page, head_dim), a lane's page table row maps its
+# linear positions [0, m·page) onto pool pages, and queries arrive as
+# (b, heads, c, head_dim) — c = 1 (decode), k+1 (speculative verify) or
+# the prefill chunk.  Grouped-query attention folds into the kernel by
+# reshaping the h = kv·g query heads to (kv, g·c) rows per kv head, so
+# the scores matmul runs once per kv head with no repeated K/V — query
+# row r serves chunk offset r % c.
+
+
+def _flash_step(q, k, v, live, acc_ref, l_ref, m_ref):
+    """One online-softmax accumulation against a K/V block — the
+    ``attention._online_update`` recurrence on kernel refs.  NEG_INF
+    masking (finite) keeps fully-masked blocks harmless: their
+    transient terms rescale to exactly 0.0 (fp32 exp underflow) once a
+    live block arrives, the same argument ``blockwise_attention``
+    documents."""
+    dh = q.shape[-1]
+    s = jax.lax.dot_general(q, k, (((2,), (2,)), ((0,), (0,))),
+                            preferred_element_type=jnp.float32)
+    s = s / jnp.sqrt(jnp.float32(dh))
+    s = s + jnp.where(live, 0.0, NEG_INF)[None]
+    m_prev = m_ref[...]
+    m_new = jnp.maximum(m_prev, s.max(axis=-1))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new[..., None])
+    l_ref[...] = l_ref[...] * alpha + p.sum(axis=-1)
+    acc_ref[...] = acc_ref[...] * alpha[..., None] + jax.lax.dot_general(
+        p.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32)
+    m_ref[...] = m_new
+
+
+def _band(k_pos, q_pos, window, sinks, base):
+    """The ``chunk_live_mask`` band on in-kernel position grids:
+    ``base`` gives the causal half (decode: k <= q; prefill history:
+    k < frontier), window/sinks compose exactly as ``band_bias``."""
+    live = base
+    if window:
+        in_w = k_pos > q_pos - window
+        if sinks:
+            in_w |= k_pos < sinks
+        live &= in_w
+    return live
+
+
+def paged_flash_decode(q, k_pool, v_pool, ptab, pos, window=None,
+                       sinks=0, interpret=None):
+    """Flash-decode over the paged KV pool: ``c`` query positions per
+    lane (already projected, rotated and GQA-shaped — (b, h, c, dh))
+    attend their lane's linear cache view THROUGH the page table, one
+    pool page per grid step, masked by the ``chunk_live_mask`` band.
+
+    The pool must already hold the lane's rows for positions
+    [0, pos+c) — the caller ``paged_write``s the c new rows first (the
+    write is a c-row scatter; the kernel eliminates the L-row gather,
+    which is the asymmetry that matters).  Numerically the
+    online-softmax result of ``blockwise_attention`` — equal to the
+    XLA ``mha_paged_chunk_step`` path to fp32 roundoff (the greedy
+    argmax downstream is what the serving parity matrix pins).
+
+    Returns (b, h, c, dh)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, c, dh = q.shape
+    kv, page = k_pool.shape[1], k_pool.shape[2]
+    m_pages = ptab.shape[1]
+    g = h // kv
+    gc = g * c
+    qg = q.reshape(b, kv, g, c, dh).reshape(b, kv, gc, dh)
+
+    def kernel(ptab_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
+               acc_ref, l_ref, m_ref):
+        i, j = pl.program_id(0), pl.program_id(1)
+
+        @pl.when(j == 0)
+        def _():
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+            l_ref[...] = jnp.zeros_like(l_ref)
+            m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+
+        pos = pos_ref[i]
+        k_pos = j * page + jax.lax.broadcasted_iota(
+            jnp.int32, (gc, page), 1)
+        q_pos = pos + jax.lax.broadcasted_iota(
+            jnp.int32, (gc, page), 0) % c
+        live = _band(k_pos, q_pos, window, sinks, k_pos <= q_pos)
+        _flash_step(q_ref[0], k_ref[0], v_ref[0], live,
+                    acc_ref, l_ref, m_ref)
+
+        @pl.when(j == m_pages - 1)
+        def _():
+            o_ref[0] = (acc_ref[...]
+                        / l_ref[...][..., None]).astype(o_ref.dtype)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, m_pages),
+        in_specs=[
+            pl.BlockSpec((1, kv, gc, dh),
+                         lambda i, j, pt, ps: (i, 0, 0, 0)),
+            pl.BlockSpec((1, kv, page, dh),
+                         lambda i, j, pt, ps: (pt[i, j], 0, 0, 0)),
+            pl.BlockSpec((1, kv, page, dh),
+                         lambda i, j, pt, ps: (pt[i, j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, kv, gc, dh),
+                               lambda i, j, pt, ps: (i, 0, 0, 0)),
+        scratch_shapes=[pltpu.VMEM((kv, gc, dh), jnp.float32),
+                        pltpu.VMEM((kv, gc), jnp.float32),
+                        pltpu.VMEM((kv, gc), jnp.float32)],
+    )
+    o = pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, kv, gc, dh), q.dtype),
+        interpret=_interpret(interpret),
+    )(jnp.asarray(ptab, jnp.int32), jnp.asarray(pos, jnp.int32),
+      qg, k_pool, v_pool)
+    return o.reshape(b, kv, g, c, dh).reshape(b, h, c, dh)
+
+
+def paged_flash_prefill(q, k_new, v_new, k_pool, v_pool, ptab, pos,
+                        window=None, sinks=0, interpret=None):
+    """Fused chunked-prefill attention: one page-aligned chunk of
+    ``c == page`` positions per lane attends the paged history (streamed
+    page-per-grid-step like :func:`paged_flash_decode`, masked strictly
+    below the chunk frontier) PLUS the chunk's own K/V — which arrive
+    as VMEM operands and are accumulated intra-causally in the
+    epilogue, never written-then-gathered through HBM.  The same
+    epilogue installs them into the lane's pool page through ALIASED
+    outputs: the ``paged_write`` row install is part of this program,
+    not a separate scatter dispatch.
+
+    Caller contract (the engine's chunk program guarantees both):
+    ``pos`` is page-aligned and the chunk occupies exactly the pool
+    page ``ptab[i, pos // page]`` — a fresh, unshared page (COW has
+    already run).  Returns (o (b, h, c, dh), k_pool, v_pool) with the
+    chunk installed."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, c, dh = q.shape
+    kv, page = k_pool.shape[1], k_pool.shape[2]
+    if c != page:
+        raise ValueError("prefill kernel needs chunk (%d) == page (%d)"
+                         % (c, page))
+    m_pages = ptab.shape[1]
+    g = h // kv
+    gc = g * c
+    qg = q.reshape(b, kv, g, c, dh).reshape(b, kv, gc, dh)
+
+    def kernel(ptab_ref, pos_ref, q_ref, kn_ref, vn_ref, k_ref, v_ref,
+               o_ref, ko_ref, vo_ref, acc_ref, l_ref, m_ref):
+        i, j = pl.program_id(0), pl.program_id(1)
+
+        @pl.when(j == 0)
+        def _():
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+            l_ref[...] = jnp.zeros_like(l_ref)
+            m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+
+        pos = pos_ref[i]
+        q_rows = jax.lax.broadcasted_iota(jnp.int32, (gc, page), 0) % c
+        # history page j: live strictly below the chunk frontier (the
+        # chunk's own page sits in the pool UNWRITTEN — its rows come
+        # from the VMEM operands in the epilogue)
+        k_pos = j * page + jax.lax.broadcasted_iota(
+            jnp.int32, (gc, page), 1)
+        live = _band(k_pos, pos + q_rows, window, sinks, k_pos < pos)
+        _flash_step(q_ref[0], k_ref[0], v_ref[0], live,
+                    acc_ref, l_ref, m_ref)
+
+        @pl.when(j == m_pages - 1)
+        def _():
+            # the chunk block: intra-chunk causal over the VMEM K/V
+            k_pos_new = pos + jax.lax.broadcasted_iota(
+                jnp.int32, (gc, c), 1)
+            q_pos = pos + jax.lax.broadcasted_iota(
+                jnp.int32, (gc, c), 0) % c
+            live_new = _band(k_pos_new, q_pos, window, sinks,
+                             k_pos_new <= q_pos)
+            _flash_step(q_ref[0], kn_ref[0], vn_ref[0], live_new,
+                        acc_ref, l_ref, m_ref)
+            o_ref[0] = (acc_ref[...]
+                        / l_ref[...][..., None]).astype(o_ref.dtype)
+            # fused install: the chunk's rows land in the lane's page
+            ko_ref[0] = kn_ref[0]
+            vo_ref[0] = vn_ref[0]
+
+    def tgt(i, j, pt, ps):
+        return (pt[i, ps[i] // page], 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, m_pages),
+        in_specs=[
+            pl.BlockSpec((1, kv, gc, dh),
+                         lambda i, j, pt, ps: (i, 0, 0, 0)),
+            pl.BlockSpec((1, kv, c, dh),
+                         lambda i, j, pt, ps: (i, 0, 0, 0)),
+            pl.BlockSpec((1, kv, c, dh),
+                         lambda i, j, pt, ps: (i, 0, 0, 0)),
+            pl.BlockSpec((1, kv, page, dh),
+                         lambda i, j, pt, ps: (pt[i, j], 0, 0, 0)),
+            pl.BlockSpec((1, kv, page, dh),
+                         lambda i, j, pt, ps: (pt[i, j], 0, 0, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, kv, gc, dh),
+                         lambda i, j, pt, ps: (i, 0, 0, 0)),
+            pl.BlockSpec((1, kv, page, dh), tgt),
+            pl.BlockSpec((1, kv, page, dh), tgt),
+        ),
+        scratch_shapes=[pltpu.VMEM((kv, gc, dh), jnp.float32),
+                        pltpu.VMEM((kv, gc), jnp.float32),
+                        pltpu.VMEM((kv, gc), jnp.float32)],
+    )
+    o, k_out, v_out = pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=(jax.ShapeDtypeStruct((b, kv, gc, dh), q.dtype),
+                   jax.ShapeDtypeStruct(k_pool.shape, k_pool.dtype),
+                   jax.ShapeDtypeStruct(v_pool.shape, v_pool.dtype)),
+        # aliased in-place pool update: operand indices INCLUDE the two
+        # scalar-prefetch args, so k_pool/v_pool are operands 5/6
+        input_output_aliases={5: 1, 6: 2},
+        interpret=_interpret(interpret),
+    )(jnp.asarray(ptab, jnp.int32), jnp.asarray(pos, jnp.int32),
+      qg, k_new, v_new, k_pool, v_pool)
+    return (o.reshape(b, kv, g, c, dh).reshape(b, h, c, dh),
+            k_out, v_out)
+
+
+def serving_kernels_supported(paged, n_heads, kv_heads, head_dim,
+                              page):
+    """(ok, reason) — can the serving attention kernels carry this
+    engine geometry?  The checks are STRUCTURAL (what the kernels
+    cannot express), not platform: platform routing (TPU vs interpret
+    vs fallback) is the engine's decision."""
+    if not paged:
+        return False, ("contiguous KV layout (the kernels walk a page "
+                       "table; enable paged_kv)")
+    if n_heads % kv_heads:
+        return False, ("n_heads %d not divisible by kv_heads %d"
+                       % (n_heads, kv_heads))
+    if page < 1 or head_dim < 1:
+        return False, "degenerate geometry"
+    return True, None

@@ -318,17 +318,20 @@ def chunk_apply(params, tokens, caches, pos, n_heads, rope=False,
 
 
 def block_paged_chunk_step(blk, h, k_pool, v_pool, ptab, pos, n_heads,
-                           rope=False, window=None, sinks=0):
+                           rope=False, window=None, sinks=0,
+                           attn_kernel=None):
     """One block over ``c`` positions per lane against the PAGED KV
     pool — :func:`block_chunk_step` with storage indirected through a
     per-lane page table (``attention.mha_paged_chunk_step`` core), and
     batched over lanes so decode/verify advance every lane in ONE
-    dispatch without vmapping the shared pool."""
+    dispatch without vmapping the shared pool.  ``attn_kernel``
+    (static: None | 'decode' | 'prefill') routes attention through the
+    Pallas serving kernels (ISSUE 7)."""
     from veles_tpu.ops.attention import mha_paged_chunk_step
     hn = _layernorm(h, blk["ln1"]["g"], blk["ln1"]["b"])
     attn, k_pool, v_pool = mha_paged_chunk_step(
         blk["attn"], hn, k_pool, v_pool, ptab, pos, n_heads, rope=rope,
-        window=window, sinks=sinks)
+        window=window, sinks=sinks, attn_kernel=attn_kernel)
     h = h + attn
     hn = _layernorm(h, blk["ln2"]["g"], blk["ln2"]["b"])
     return h + _block_ffn(blk, hn), k_pool, v_pool
@@ -351,7 +354,8 @@ def paged_chunk_embed(params, tokens, pos):
 
 
 def paged_chunk_apply(params, tokens, pools, ptab, pos, n_heads,
-                      rope=False, window=None, sinks=0):
+                      rope=False, window=None, sinks=0,
+                      attn_kernel=None):
     """Run ``c`` consecutive tokens PER LANE through the whole stack
     against the paged KV pools in one pass — :func:`chunk_apply` with
     (pools, page table) in place of per-lane contiguous caches.
@@ -363,13 +367,17 @@ def paged_chunk_apply(params, tokens, pools, ptab, pos, n_heads,
     prefill chunk (b=1, c=chunk), decode step (c=1, b=slots),
     speculative verify (c=k+1, b=slots) — so one function carries the
     whole paged fast path and position j's hidden state equals the
-    contiguous path's bit for bit."""
+    contiguous path's bit for bit.  ``attn_kernel`` (static: None |
+    'decode' | 'prefill') swaps every block's attention for the Pallas
+    serving kernel path (ISSUE 7) — same K/V writes, no materialized
+    ``paged_view`` gather."""
     h = paged_chunk_embed(params, tokens, pos)
     new_pools = []
     for blk, (kp, vp) in zip(params["blocks"], pools):
         h, kp, vp = block_paged_chunk_step(blk, h, kp, vp, ptab, pos,
                                            n_heads, rope=rope,
-                                           window=window, sinks=sinks)
+                                           window=window, sinks=sinks,
+                                           attn_kernel=attn_kernel)
         new_pools.append((kp, vp))
     return h, new_pools
 

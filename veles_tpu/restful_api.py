@@ -285,7 +285,7 @@ class RESTfulAPI(Logger):
 def serve_lm(workflow, host="127.0.0.1", port=8180, max_new=256,
              slots=0, queue_depth=64, deadline_s=30.0,
              prefix_cache=0, prefill_chunk=0, spec_k=0,
-             queue_tokens=0, paged_kv=0):
+             queue_tokens=0, paged_kv=0, attn_kernel=None):
     """Serve a trained transformer-trainer workflow (e.g. char_lm) for
     autoregressive continuation: POST ``{"input": [[tok, ...]],
     "n_new": N, "temperature": T, "top_k": K, "seed": S}`` to
@@ -312,7 +312,14 @@ def serve_lm(workflow, host="127.0.0.1", port=8180, max_new=256,
     the contiguous footprint) behind per-lane page tables — lanes
     reserve only their own span, prefix hits are zero-copy page
     references with copy-on-write, and a request the pool cannot place
-    queues or sheds (429/503) instead of wedging.  All preserve
+    queues or sheds (429/503) instead of wedging.
+    ``attn_kernel='auto'`` (ISSUE 7) swaps the paged engine's
+    attention for the Pallas flash-decode / fused-prefill kernels on
+    real TPU hardware, with an automatic XLA fallback (off-TPU or
+    unsupported geometry — logged once, counted on ``/metrics`` as
+    ``attn_kernel_fallbacks``); ``'force'`` insists off-TPU (interpret
+    mode, test gear); ``None`` follows
+    ``attention.set_attention_backend('flash_serve')``.  All preserve
     bit-identical greedy output; see ``veles_tpu/serving/lm_engine.py``.
 
     The direct path decodes one prompt batch at a time via the
@@ -353,7 +360,7 @@ def serve_lm(workflow, host="127.0.0.1", port=8180, max_new=256,
             queue_depth=queue_depth, deadline_s=deadline_s,
             prefix_cache=prefix_cache, prefill_chunk=prefill_chunk,
             spec_k=spec_k, queue_tokens=queue_tokens,
-            paged_kv=paged_kv,
+            paged_kv=paged_kv, attn_kernel=attn_kernel,
             metrics=metrics_mod.new("lm")).start()
 
     def handler(request):

@@ -14,6 +14,12 @@ request, this package amortizes dispatch across concurrent clients.
   cache (greedy path bit-identical to ``ops.transformer.generate``),
   plus the ISSUE 4 fast path: :class:`RadixPrefixCache` prompt-KV
   reuse, chunked prefill, and prompt-lookup speculative decoding.
+  ``attn_kernel=`` (ISSUE 7) routes the paged engine's attention
+  through the Pallas serving kernels in ``ops/pallas_kernels.py``
+  (flash-decode over the page table + fused chunked-prefill with
+  in-kernel row install) on TPU hardware, with an automatic XLA
+  fallback metered as ``attn_kernel_dispatches`` /
+  ``attn_kernel_fallbacks`` on ``/metrics``.
 - :mod:`veles_tpu.serving.kv_pool` — :class:`KVPagePool`: the paged
   KV-cache allocator (ISSUE 6).  ``LMEngine(paged_kv=N)`` stores KV in
   fixed-size pages from one global pool behind per-lane page tables;

@@ -69,10 +69,30 @@ allocator in ``serving/kv_pool.py``):
   covering the whole pool rejects new arrivals with
   ``PoolExhausted`` (HTTP 429) — pool pressure never wedges a lane.
 
+The SERVING ATTENTION KERNELS (ISSUE 7, ``attn_kernel=``) swap the
+paged programs' attention core for the Pallas suite in
+``ops/pallas_kernels.py``: the decode/verify dispatches run
+:func:`~veles_tpu.ops.pallas_kernels.paged_flash_decode` (the page
+table walked INSIDE the kernel — no ``paged_view`` gather ever
+materializes a lane's dense cache view) and the chunk program runs
+:func:`~veles_tpu.ops.pallas_kernels.paged_flash_prefill` (chunk K/V
+attended from VMEM and installed into the pool in the kernel
+epilogue).  Routing resolves ONCE at construction: 'auto' (or True)
+uses the kernels on real TPU hardware and falls back to the XLA path
+everywhere else (off-TPU, contiguous KV layout, unsupported geometry
+— logged once, metered per dispatch as ``attn_kernel_fallbacks`` vs
+``attn_kernel_dispatches``); 'force' insists even off-TPU (interpret
+mode — the parity tests' end-to-end gear, far too slow for traffic).
+Decode/verify additionally slice the page table to the LIVE width
+ladder (``_live_width``): a step pays for the pages the batch actually
+occupies, one program per power-of-two ladder entry.
+
 Decoding is GREEDY (temperature 0) — bit-identical to
 ``ops/transformer.py::generate`` for the same prompt WHATEVER fast-path
 combination is enabled, which is the serving contract (sampled
-requests fall back to the direct path upstream).  Compile count is
+requests fall back to the direct path upstream; the Pallas kernels'
+online softmax matches the XLA softmax to fp32 roundoff, preserving
+every greedy argmax the parity matrix pins).  Compile count is
 bounded: one step program, one prefill program per prompt bucket, one
 install program, plus (fast path) one chunk-prefill program, one
 chunk-install/extract pair, and one verify program per (engine) ``k``;
@@ -344,7 +364,7 @@ class LMEngine(Logger):
                  window=None, sinks=0, queue_depth=64, deadline_s=30.0,
                  metrics=None, name="lm", prefill_chunk=0,
                  prefix_cache=0, spec_k=0, spec_ngram=3,
-                 queue_tokens=0, paged_kv=0):
+                 queue_tokens=0, paged_kv=0, attn_kernel=None):
         import jax.numpy as jnp
         if slots < 1:
             raise ValueError("slots must be >= 1")
@@ -387,9 +407,10 @@ class LMEngine(Logger):
         if self.spec_ngram < 1:
             raise ValueError("spec_ngram must be >= 1")
         if self._paged and self.max_len % self.prefill_chunk:
-            # the paged lane view is max_pages·page wide; only when that
-            # EQUALS max_len is every score matrix shape-identical to
-            # the contiguous path — the bit-parity contract's condition
+            # the paged lane view must tile max_len exactly: a partial
+            # tail page would either truncate placeable rows or attend
+            # rows past max_len (the chunk program additionally relies
+            # on page-aligned starts)
             raise ValueError(
                 "paged_kv needs max_len (%d) divisible by the page size "
                 "(prefill_chunk, %d)" % (self.max_len,
@@ -402,13 +423,65 @@ class LMEngine(Logger):
         d_model = embed.shape[1]
         head_dim = d_model // self.n_heads
         kv_heads = params["blocks"][0]["attn"]["wk"].shape[1] // head_dim
+        # ---- serving attention kernels (ISSUE 7): resolve the routing
+        # ONCE here — platform and geometry are fixed for the engine's
+        # lifetime, so the fallback decision never flaps mid-traffic.
+        # attn_kernel: None = follow set_attention_backend
+        # ('flash_serve' => 'auto'); 0/False = off; True/'auto' = Pallas
+        # kernels on real TPU, XLA fallback elsewhere; 'force' = Pallas
+        # even off-TPU (interpret mode — parity tests, not production).
+        if attn_kernel is None:
+            from veles_tpu.ops.attention import serving_kernel_default
+            attn_kernel = "auto" if serving_kernel_default() else 0
+        if attn_kernel is True:
+            attn_kernel = "auto"
+        if attn_kernel not in (0, False, "auto", "force"):
+            raise ValueError("attn_kernel must be one of 0/False, "
+                             "'auto', 'force' (got %r)" % (attn_kernel,))
+        self.attn_kernel = attn_kernel or 0
+        self._kernel_active = False
+        self._kernel_fallback_reason = None
+        if self.attn_kernel:
+            from veles_tpu.ops.pallas_kernels import (
+                on_tpu, serving_kernels_supported)
+            ok, reason = serving_kernels_supported(
+                self._paged, self.n_heads, kv_heads, head_dim,
+                self.prefill_chunk)
+            if ok and (self.attn_kernel == "force" or on_tpu()):
+                self._kernel_active = True
+            else:
+                self._kernel_fallback_reason = reason or (
+                    "no TPU backend (interpret-mode kernels are test "
+                    "gear; pass attn_kernel='force' to insist)")
+                # logged ONCE, here — not per dispatch
+                self.warning(
+                    "attn_kernel requested but using the XLA path: %s",
+                    self._kernel_fallback_reason)
+        self.metrics.set_gauge("attn_kernel_active",
+                               int(self._kernel_active))
         self._caches = None
         self._kv_pools = None
         self._pool = None
         self._page_tables = None
         self._max_pages = 0
+        self._width_ladder = []
         if self._paged:
             self._max_pages = self.max_len // self.prefill_chunk
+            # decode/verify table-width ladder (ISSUE 7 satellite): a
+            # step only needs pages up to the batch's live frontier,
+            # not the full max_len span — the table is sliced to the
+            # smallest power-of-two width covering every lane, so the
+            # per-token gather (or kernel grid) scales with what's
+            # actually resident.  Power-of-two steps bound the compile
+            # count at one step/verify program per LADDER ENTRY (the
+            # jit-guard's per-family bound), the same discipline as the
+            # contiguous path's prompt buckets.
+            self._width_ladder = []
+            w = 1
+            while w < self._max_pages:
+                self._width_ladder.append(w)
+                w *= 2
+            self._width_ladder.append(self._max_pages)
             num_pages = (self.slots * self._max_pages
                          if paged_kv is True else int(paged_kv))
             if num_pages < 1:
@@ -588,20 +661,31 @@ class LMEngine(Logger):
         (every lane, k+1 speculative positions) and ``_page_copy_jit``
         (copy-on-write).  The whole-prompt prefill/install/extract
         programs have no paged counterpart (prefill is always chunked;
-        prefix hits install page IDS, not rows)."""
+        prefix hits install page IDS, not rows).
+
+        Two ISSUE 7 refinements: when the engine resolved
+        ``attn_kernel`` active, every program's attention routes
+        through the Pallas serving kernels ('prefill' for the chunk
+        program, 'decode' for step/verify — same K/V writes, no
+        materialized ``paged_view``); and step/verify accept tables
+        SLICED to the live width ladder (one program per ladder entry,
+        see ``_live_width``), so the per-token cost follows the batch's
+        actual residency, not max_len."""
         import jax
         import jax.numpy as jnp
         from veles_tpu.ops.transformer import (head_logits,
                                                paged_chunk_apply)
         n_heads = self.n_heads
         rope, window, sinks = self.rope, self.window, self.sinks
+        kern = self._kernel_active
 
         def chunk_slot(params, pools, ptab, tokens, start, last_idx):
             # one lane's prompt chunk through its page table; returns
             # the argmax after ``last_idx`` (read on the tail chunk)
             h, pools = paged_chunk_apply(
                 params, tokens[None], pools, ptab[None], start[None],
-                n_heads, rope=rope, window=window, sinks=sinks)
+                n_heads, rope=rope, window=window, sinks=sinks,
+                attn_kernel="prefill" if kern else None)
             logits = head_logits(params, jax.lax.dynamic_slice_in_dim(
                 h, last_idx, 1, axis=1))[:, 0, :]
             tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)[0]
@@ -612,7 +696,8 @@ class LMEngine(Logger):
             # position through its own page table
             h, pools = paged_chunk_apply(
                 params, toks[:, None], pools, ptabs, pos, n_heads,
-                rope=rope, window=window, sinks=sinks)
+                rope=rope, window=window, sinks=sinks,
+                attn_kernel="decode" if kern else None)
             logits = head_logits(params, h)[:, 0, :]
             return pools, jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
@@ -637,7 +722,8 @@ class LMEngine(Logger):
                 # returns the greedy argmax AFTER each fed position
                 h, pools = paged_chunk_apply(
                     params, toks, pools, ptabs, pos, n_heads, rope=rope,
-                    window=window, sinks=sinks)
+                    window=window, sinks=sinks,
+                    attn_kernel="decode" if kern else None)
                 logits = head_logits(params, h)      # (slots, k+1, v)
                 return pools, jnp.argmax(
                     logits, axis=-1).astype(jnp.int32)
@@ -659,15 +745,21 @@ class LMEngine(Logger):
                 jnp.zeros(self.prefill_chunk, jnp.int32), zero, zero)
             self._kv_pools = self._page_copy_jit(self._kv_pools, zero,
                                                  zero)
-            if self._verify_jit is not None:
-                self._kv_pools, _ = self._verify_jit(
-                    self.params, self._kv_pools, ptabs,
-                    jnp.zeros((self.slots, self.spec_k + 1), jnp.int32),
+            # step/verify compile one program per live-width ladder
+            # entry (ISSUE 7) — warm EVERY entry now, or the first
+            # request to cross each width boundary pays its compile
+            # inside the serving loop
+            for w in self._width_ladder:
+                if self._verify_jit is not None:
+                    self._kv_pools, _ = self._verify_jit(
+                        self.params, self._kv_pools, ptabs[:, :w],
+                        jnp.zeros((self.slots, self.spec_k + 1),
+                                  jnp.int32),
+                        jnp.zeros(self.slots, jnp.int32))
+                self._kv_pools, _ = self._step_jit(
+                    self.params, self._kv_pools, ptabs[:, :w],
+                    jnp.zeros(self.slots, jnp.int32),
                     jnp.zeros(self.slots, jnp.int32))
-            self._kv_pools, _ = self._step_jit(
-                self.params, self._kv_pools, ptabs,
-                jnp.zeros(self.slots, jnp.int32),
-                jnp.zeros(self.slots, jnp.int32))
         else:
             tok, rows = self._prefill_jit(
                 self.params,
@@ -1083,6 +1175,30 @@ class LMEngine(Logger):
         self.metrics.set_gauge("kv_pages_pinned",
                                self._pool.pinned_pages)
 
+    def _live_width(self, span):
+        """Ladder-bucketed page-table width for a decode/verify step
+        writing ``span`` positions per lane: the smallest power-of-two
+        (capped at max_pages) covering EVERY slot's frontier —
+        ``_pos`` includes prefilling lanes' parked frontiers and the
+        inactive lanes' 0, so the batched step's garbage writes always
+        land inside the sliced table (take_along_axis would otherwise
+        CLAMP an out-of-range page lookup onto a live page)."""
+        need = -(-(int(self._pos.max()) + span) // self.prefill_chunk)
+        for w in self._width_ladder:
+            if w >= need:
+                return w
+        return self._max_pages
+
+    def _note_attn_dispatch(self):
+        """Per-dispatch kernel accounting (ISSUE 7): which path the
+        engine's attention actually took.  Only metered when the caller
+        ASKED for kernels — an untouched engine carries no new
+        counters."""
+        if self.attn_kernel:
+            self.metrics.inc("attn_kernel_dispatches"
+                             if self._kernel_active
+                             else "attn_kernel_fallbacks")
+
     def kv_bytes_resident(self):
         """Device bytes held for KV storage — the pool (paged) or the
         contiguous slot caches; what the bench reports as footprint."""
@@ -1162,6 +1278,7 @@ class LMEngine(Logger):
             self._teardown_slot(slot, lane, e)
             return
         self.metrics.inc("prefill_dispatches")
+        self._note_attn_dispatch()
         self.metrics.inc("prefill_tokens",
                          (req.true_len - start) if is_tail
                          else len(tokens))
@@ -1234,6 +1351,7 @@ class LMEngine(Logger):
             self._teardown_slot(slot, lane, e)
             return
         self.metrics.inc("prefill_dispatches")
+        self._note_attn_dispatch()
         self.metrics.inc("prefill_tokens",
                          (req.true_len - start) if is_tail
                          else len(tokens))
@@ -1328,9 +1446,10 @@ class LMEngine(Logger):
         t0 = time.monotonic()
         try:
             if self._paged:
+                w = self._live_width(1)
                 self._kv_pools, toks = self._step_jit(
                     self.params, self._kv_pools,
-                    jnp.asarray(self._page_tables),
+                    jnp.asarray(self._page_tables[:, :w]),
                     jnp.asarray(self._last), jnp.asarray(self._pos))
             else:
                 self._caches, toks = self._step_jit(
@@ -1343,6 +1462,7 @@ class LMEngine(Logger):
         self.metrics.record_dispatch(len(active))
         self.metrics.record_decode_step(time.monotonic() - t0)
         self.metrics.inc("decode_dispatches")
+        self._note_attn_dispatch()
         for slot in active:
             lane = self._lanes[slot]
             lane.emitted.append(int(toks[slot]))
@@ -1392,9 +1512,10 @@ class LMEngine(Logger):
         t0 = time.monotonic()
         try:
             if self._paged:
+                w = self._live_width(k + 1)
                 self._kv_pools, out = self._verify_jit(
                     self.params, self._kv_pools,
-                    jnp.asarray(self._page_tables),
+                    jnp.asarray(self._page_tables[:, :w]),
                     jnp.asarray(toks_in), jnp.asarray(self._pos))
             else:
                 self._caches, out = self._verify_jit(
@@ -1407,6 +1528,7 @@ class LMEngine(Logger):
         self.metrics.record_dispatch(len(active))
         self.metrics.record_decode_step(time.monotonic() - t0)
         self.metrics.inc("decode_dispatches")
+        self._note_attn_dispatch()
         for slot in active:
             lane = self._lanes[slot]
             draft = drafts[slot]

@@ -95,8 +95,11 @@ class ServingMetrics:
         self.ttft = Histogram(TIME_BOUNDS)
         self.decode_step = Histogram(TIME_BOUNDS)
         #: named event counters (prefix-cache hits, draft acceptance,
-        #: ...) — engines add theirs via :meth:`inc`; rendered as
-        #: ``veles_serving_<name>_total`` counter families
+        #: attn_kernel_dispatches/attn_kernel_fallbacks — the ISSUE 7
+        #: which-attention-path-ran pair, ...) — engines add theirs via
+        #: :meth:`inc`; rendered as ``veles_serving_<name>_total``
+        #: counter families (one ``# TYPE`` line per family across
+        #: every engine, the strict-parser rule render_instances keeps)
         self.counters = {}
         #: bounded reservoir of recent end-to-end latencies (percentiles)
         self._recent = collections.deque(maxlen=latency_window)
