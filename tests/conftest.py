@@ -43,7 +43,65 @@ jax.config.update("jax_threefry_partitionable", True)
 # cache (TPU executables serialize fine and the 20-40s conv compiles
 # are what wedge the relay); the CPU test suite stays cold.
 
+import functools  # noqa: E402
+import subprocess  # noqa: E402
+import sys  # noqa: E402
+
 import pytest  # noqa: E402
+
+
+@functools.lru_cache(maxsize=1)
+def _forced_device_count_probe():
+    """Spawn ONE subprocess that forces a 2-device CPU host platform
+    and report whether this jaxlib honors the flag — the serving-mesh
+    analogue of test_multihost's cached collective probe: every
+    sharded-serving test shares this single cheap check instead of
+    each discovering (or flaking on) a single-device jaxlib on its
+    own.  Returns (ok, detail)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    code = ("import jax\n"
+            "jax.config.update('jax_platforms', 'cpu')\n"
+            "print('DEVICES=%d' % jax.device_count())\n")
+    try:
+        out = subprocess.run([sys.executable, "-c", code], env=env,
+                             capture_output=True, text=True,
+                             timeout=120)
+    except Exception as e:   # noqa: BLE001 — probe infra failure
+        return False, "probe subprocess failed: %s" % e
+    for line in out.stdout.splitlines():
+        if line.startswith("DEVICES="):
+            n = int(line.split("=", 1)[1])
+            return n >= 2, "forced-CPU subprocess saw %d device(s)" % n
+    return False, ("probe printed no device count (rc %s): %s"
+                   % (out.returncode, (out.stderr or "").strip()[-200:]))
+
+
+@pytest.fixture(scope="session")
+def serving_mesh():
+    """Loud, cached gate for sharded-serving tests: ``serving_mesh(n)``
+    returns the in-process device count when >= n and otherwise skips
+    with a reason that says WHY this environment cannot host an
+    n-device serving mesh (platform pinned vs jaxlib ignoring
+    xla_force_host_platform_device_count) — a deterministic skip, not
+    a flaky failure, on single-device jaxlibs."""
+    import jax
+
+    def require(n):
+        have = jax.device_count()
+        if have >= n:
+            return have
+        ok, detail = _forced_device_count_probe()
+        why = ("the jaxlib CAN force host devices — this process's "
+               "platform/flags pin it smaller" if ok else
+               "this jaxlib ignores xla_force_host_platform_"
+               "device_count")
+        pytest.skip("serving-mesh test needs %d devices; this process "
+                    "has %d (%s; %s)" % (n, have, why, detail))
+
+    return require
 
 
 def pytest_configure(config):

@@ -1,6 +1,7 @@
 """Genetics (Tune + GA) and ensemble tests (SURVEY §2.1, §3.5)."""
 
 import numpy
+import pytest
 
 from veles_tpu.config import Config, Tune, root
 from veles_tpu.genetics import find_tunes, optimize, set_leaf
@@ -202,10 +203,14 @@ class TestEnsemble:
         # and no member predicts at chance on the shared validation set
         assert max(combined["members"]) < 50
 
+    @pytest.mark.slow
     def test_parallel_members_match_sequential(self):
         """Members trained in worker subprocesses and restored from their
         snapshots must equal in-process members exactly (same platform) —
-        the reference's members-across-slaves parallelism (SURVEY §3.5)."""
+        the reference's members-across-slaves parallelism (SURVEY §3.5).
+        Slow-marked for tier-1 runtime headroom: the in-process
+        ensemble leg (test_members_and_combination) and the GA
+        population-parallel parity leg stay tier-1."""
         from veles_tpu import prng
         from veles_tpu.ensemble import train_ensemble
         from veles_tpu.samples import mnist
@@ -241,10 +246,15 @@ class TestEnsemble:
                 numpy.asarray(par_wf.forwards[0].weights.mem))
 
 
+@pytest.mark.slow
 def test_optimizes_char_lm_learning_rate():
     """The GA generalizes to the transformer family: Tune over the
     char-LM trainer's learning rate, fitness = validation loss from
-    TransformerDecision.best_metric (lower is better)."""
+    TransformerDecision.best_metric (lower is better).  Slow-marked
+    (tier-1 runtime headroom, same discipline as the PR-3 trim):
+    tier-1 keeps the GA parity (TestPopulationParallel) and CLI
+    (TestOptimizeCLI) representatives; this full GA-over-a-trained-LM
+    convergence leg rides the slow suite."""
     from veles_tpu import prng
     from veles_tpu.genetics import optimize_workflow
     prng.reset()

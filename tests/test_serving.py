@@ -615,6 +615,75 @@ class TestMetrics:
         assert 'veles_serving_requests_total{engine="eng_a"} 1' in text
         assert 'veles_serving_requests_total{engine="eng_b"} 1' in text
 
+    def test_labeled_samples_share_family(self):
+        """Satellite (ISSUE 8): the minimal {replica="i"} label path —
+        labeled gauges/counters render into the SAME family as their
+        unlabeled base name (one # TYPE line, strict-parser rule) and
+        surface as name{...} keys in the snapshot."""
+        from veles_tpu.serving import ServingMetrics
+        m = ServingMetrics("lbl_t")
+        m.set_gauge("queue_depth", 7)
+        m.set_gauge("queue_depth", 3, labels={"replica": "0"})
+        m.set_gauge("queue_depth", 4, labels={"replica": "1"})
+        m.inc("routed_requests", 5, labels={"replica": "0"})
+        text = m.render_prometheus()
+        assert text.count("# TYPE veles_serving_queue_depth gauge") == 1
+        assert 'veles_serving_queue_depth{engine="lbl_t"} 7' in text
+        assert ('veles_serving_queue_depth{engine="lbl_t",'
+                'replica="0"} 3') in text
+        assert ('veles_serving_queue_depth{engine="lbl_t",'
+                'replica="1"} 4') in text
+        assert ('veles_serving_routed_requests_total{engine="lbl_t",'
+                'replica="0"} 5') in text
+        snap = m.snapshot()
+        assert snap["gauges"]["queue_depth"] == 7
+        assert snap["gauges"]['queue_depth{replica="0"}'] == 3
+        assert snap["counters"]['routed_requests{replica="0"}'] == 5
+        assert m.counter("routed_requests", labels={"replica": "0"}) \
+            == 5
+
+    def test_replica_instances_coexist_in_registry(self):
+        """Replica engines share a family NAME and differ by instance
+        labels: the registry keeps one row per (name, labels), and the
+        merged render carries one # TYPE with one sample per
+        replica."""
+        from veles_tpu.serving import metrics as metrics_mod
+        r0 = metrics_mod.new("repl_t", labels={"replica": "0"})
+        r1 = metrics_mod.new("repl_t", labels={"replica": "1"})
+        assert r0 is not r1
+        r0.record_enqueue()
+        r1.record_enqueue()
+        r1.record_enqueue()
+        text = metrics_mod.render_prometheus()
+        assert text.count(
+            "# TYPE veles_serving_requests_total counter") == 1
+        assert ('veles_serving_requests_total{engine="repl_t",'
+                'replica="0"} 1') in text
+        assert ('veles_serving_requests_total{engine="repl_t",'
+                'replica="1"} 2') in text
+        # restart-with-same-labels still replaces its own row only
+        r0b = metrics_mod.new("repl_t", labels={"replica": "0"})
+        assert r0b is not r0
+        text = metrics_mod.render_prometheus()
+        assert ('veles_serving_requests_total{engine="repl_t",'
+                'replica="0"} 0') in text
+        assert ('veles_serving_requests_total{engine="repl_t",'
+                'replica="1"} 2') in text
+
+    def test_ewma_tracks_latency_facts(self):
+        """The router's placement signal: TTFT / decode-step EWMAs
+        update on record and read back cheaply."""
+        from veles_tpu.serving import ServingMetrics
+        m = ServingMetrics("ewma_t")
+        assert m.ewma("decode_step") == 0.0
+        m.record_decode_step(0.1)
+        assert m.ewma("decode_step") == pytest.approx(0.1)
+        for _ in range(40):
+            m.record_decode_step(0.2)
+        assert 0.19 < m.ewma("decode_step") <= 0.2
+        m.record_ttft(0.05)
+        assert m.snapshot()["ewma"]["ttft"] == pytest.approx(0.05)
+
     def test_new_replaces_registered_row(self):
         """Engine restarts begin at zero — `new` replaces the row."""
         from veles_tpu.serving import metrics as metrics_mod
@@ -698,6 +767,204 @@ class TestTinyModelSmoke:
                                               atol=1e-5)
         finally:
             api.stop()
+
+
+class TestRouter:
+    """ISSUE 8: data-parallel engine replicas behind the metrics-driven
+    router — the degenerate single-replica path, balance, sick-replica
+    draining, and unchanged admission semantics."""
+
+    def _expected(self, params, prompts, n_new, max_len=48):
+        import jax.numpy as jnp
+        from veles_tpu.ops.transformer import generate
+        return [numpy.asarray(generate(
+            params, jnp.asarray([p], jnp.int32), n_new, 2,
+            temperature=0.0, max_len=max_len))[0] for p in prompts]
+
+    def _replicas(self, params, n, serving_mesh=None, **kw):
+        import jax
+        from veles_tpu.serving import LMEngine, ServingMetrics
+        devs = jax.devices()
+        return [LMEngine(params, n_heads=2, max_len=48,
+                         devices=[devs[i % len(devs)]],
+                         name="rt_r%d" % i,
+                         metrics=ServingMetrics(
+                             "rt", labels={"replica": str(i)}), **kw)
+                for i in range(n)]
+
+    def test_single_replica_degenerates_bit_identical(self):
+        """Router([one engine]) IS today's path: same tokens, same
+        Overloaded admission refusal — no behavioral tax for the
+        degenerate fleet."""
+        from veles_tpu.serving import LMEngine, Overloaded, Router
+        params = _tiny_params()
+        prompts = [[1, 2, 3], [2, 4, 6, 8, 10], [7, 7]]
+        expected = self._expected(params, prompts, 6)
+        engine = LMEngine(params, n_heads=2, max_len=48, slots=1,
+                          queue_depth=4, name="rt_one")
+        router = Router([engine]).start()
+        try:
+            futures = [router.submit(p, 6) for p in prompts]
+            for p, f, exp in zip(prompts, futures, expected):
+                got = numpy.concatenate([p, f.result(timeout=60)])
+                numpy.testing.assert_array_equal(got, exp)
+            # admission refusal surfaces exactly like the bare engine
+            real_step = engine._step_jit
+
+            def slow_step(*a):
+                time.sleep(0.05)
+                return real_step(*a)
+
+            engine._step_jit = slow_step
+            try:
+                with pytest.raises(Overloaded):
+                    for _ in range(12):
+                        router.submit([1, 2, 3], 4)
+            finally:
+                engine._step_jit = real_step
+        finally:
+            router.stop()
+
+    def test_idle_fleet_spreads_evenly(self, serving_mesh):
+        """Cold traffic on an idle 2-replica fleet places by
+        fewest-routed tiebreak: the split is even, not replica-0
+        pile-up."""
+        serving_mesh(2)
+        from veles_tpu.serving import Router
+        params = _tiny_params()
+        replicas = self._replicas(params, 2, slots=2)
+        router = Router(replicas).start()
+        try:
+            prompts = [[1 + i % 5, 2, 3] for i in range(8)]
+            expected = self._expected(params, prompts, 4)
+            futures = [router.submit(p, 4) for p in prompts]
+            for p, f, exp in zip(prompts, futures, expected):
+                got = numpy.concatenate([p, f.result(timeout=60)])
+                numpy.testing.assert_array_equal(got, exp)
+            counts = router.routed_counts()
+            assert sum(counts) == 8
+            assert max(counts) - min(counts) <= 2, counts
+            snap = router.metrics.snapshot()
+            assert snap["counters"]['routed_requests{replica="0"}'] \
+                + snap["counters"]['routed_requests{replica="1"}'] == 8
+        finally:
+            router.stop()
+
+    def test_sick_replica_drain_requeues_without_loss(self,
+                                                     serving_mesh):
+        """Hot-unregister mid-flight: everything pending on the sick
+        replica re-places and completes whole and exactly greedy (no
+        loss, no duplicate, no partial results), and the drained
+        replica receives no new work."""
+        serving_mesh(2)
+        from veles_tpu.serving import Router
+        params = _tiny_params()
+        replicas = self._replicas(params, 2, slots=2)
+        router = Router(replicas).start()
+        real_step = replicas[0]._step_jit
+
+        def slow_step(*a):
+            time.sleep(0.05)
+            return real_step(*a)
+
+        replicas[0]._step_jit = slow_step
+        try:
+            prompts = [[1 + i % 7, 3, 5] for i in range(8)]
+            expected = self._expected(params, prompts, 6)
+            futures = [router.submit(p, 6) for p in prompts]
+            time.sleep(0.12)          # replica 0 is mid-decode now
+            moved = router.unregister(0, reason="test drain")
+            for p, f, exp in zip(prompts, futures, expected):
+                out = f.result(timeout=120)
+                assert len(out) == 6          # whole, never partial
+                numpy.testing.assert_array_equal(
+                    numpy.concatenate([p, out]), exp)
+            snap = router.metrics.snapshot()
+            if moved:
+                assert snap["counters"]["requeued_requests"] >= moved
+            assert snap["gauges"]["replicas_live"] == 1
+            # post-drain placement avoids the sick replica
+            f = router.submit(prompts[0], 4)
+            assert f.job.replica == 1
+            assert len(f.result(timeout=60)) == 4
+        finally:
+            replicas[0]._step_jit = real_step
+            router.stop()
+
+    def test_admission_and_shed_semantics_unchanged(self, serving_mesh):
+        """Behind the router, 429 (every live replica's queue full)
+        and 503 (deadline shed inside an engine) look exactly like the
+        single-engine contract."""
+        serving_mesh(2)
+        from veles_tpu.serving import (DeadlineExceeded, Overloaded,
+                                       Router)
+        params = _tiny_params()
+        replicas = self._replicas(params, 2, slots=1, queue_depth=2,
+                                  deadline_s=0.2)
+        router = Router(replicas).start()
+        reals = [e._step_jit for e in replicas]
+
+        def make_slow(real):
+            def slow_step(*a):
+                time.sleep(0.1)
+                return real(*a)
+            return slow_step
+
+        for e, real in zip(replicas, reals):
+            e._step_jit = make_slow(real)
+        try:
+            futures, rejected = [], 0
+            for k in range(12):
+                try:
+                    futures.append(router.submit([1, 2, 3], 12))
+                except Overloaded:
+                    rejected += 1
+                if k == 3:
+                    # let the workers pop the heads into their slots so
+                    # the NEXT submits sit queued behind a busy lane
+                    # (slots=1, 12 slow steps ≈ 1.2s >> the 0.2s
+                    # deadline → those queued requests must shed)
+                    time.sleep(0.05)
+            assert rejected > 0            # 429 once the fleet is full
+            shed = done = 0
+            for f in futures:
+                try:
+                    f.result(timeout=120)
+                    done += 1
+                except DeadlineExceeded:   # 503 passes through
+                    shed += 1
+            assert done + shed == len(futures)
+            assert shed > 0
+        finally:
+            for e, real in zip(replicas, reals):
+                e._step_jit = real
+            router.stop()
+
+    def test_round_robin_policy(self, serving_mesh):
+        serving_mesh(2)
+        from veles_tpu.serving import Router
+        params = _tiny_params()
+        replicas = self._replicas(params, 2, slots=2)
+        router = Router(replicas, policy="round_robin").start()
+        try:
+            futures = [router.submit([1, 2, 3], 3) for _ in range(6)]
+            for f in futures:
+                assert len(f.result(timeout=60)) == 3
+            counts = router.routed_counts()
+            assert counts == [3, 3], counts
+        finally:
+            router.stop()
+
+    def test_router_validation(self):
+        from veles_tpu.serving import Router
+        with pytest.raises(ValueError, match="at least one"):
+            Router([])
+        from veles_tpu.serving import LMEngine
+        params = _tiny_params()
+        engine = LMEngine(params, n_heads=2, max_len=48, slots=1,
+                          name="rt_v")
+        with pytest.raises(ValueError, match="policy"):
+            Router([engine], policy="fastest")
 
 
 @pytest.mark.slow

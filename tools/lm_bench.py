@@ -21,6 +21,17 @@ and — the acceptance headline — the lane count achievable at FIXED KV
 memory on a mixed-length prompt distribution vs the contiguous layout
 (``fixed_kv_memory``: same bytes, ≥2× the lanes).
 
+The SHARDED legs (ISSUE 8) run every workload tensor-parallel
+(``tp2`` — one engine over a 2-device mesh), data-parallel
+(``replicas2`` — 2 engines behind the metrics-driven router, with
+per-replica routing counts, queue-depth spread and balance ratio) and
+stacked (``tp2_replicas2`` — 4 devices), each streaming the same
+bench-style summary line; every record carries ``devices`` and
+``mfu_per_device`` so fleet utilization reads honestly.  On a
+single-device host these legs bank ``skipped`` records; ``--devices
+N`` forces an N-device CPU dryrun host (the MULTICHIP suite's
+forced-host-device-count gear).
+
 Every leg ALSO asserts its outputs bit-identical to the direct greedy
 ``ops/transformer.py::generate`` — a fast path that changed tokens
 would be a bug, not a speedup, so the bench refuses to report it.
@@ -147,13 +158,53 @@ def expected_rows(params, prompts, n_new, n_heads, max_len):
         temperature=0.0, max_len=max_len))[0] for p in prompts]
 
 
+def _emulate_device_latency(engines, seconds):
+    """Wrap each engine's decode/verify/chunk dispatch with a
+    block-until-ready + sleep — the DEVICE-BOUND serving regime on a
+    CPU dryrun host.  On a real accelerator the engine worker thread
+    idle-waits on the device per dispatch, which is exactly what
+    data-parallel replicas overlap; on a shared-CPU dryrun box the
+    'device' compute competes for the same cores, so raw replica legs
+    measure core contention, not the router.  This emulation restores
+    the regime the layer is FOR, and is always labeled
+    (``emulated_step_latency_s``) in the records it touches."""
+    import time as time_mod
+
+    import jax
+
+    def wrap(fn):
+        def wrapped(*args):
+            out = fn(*args)
+            jax.block_until_ready(out)
+            time_mod.sleep(seconds)
+            return out
+        return wrapped
+
+    for engine in engines:
+        for name in ("_step_jit", "_verify_jit", "_chunk_jit",
+                     "_prefill_jit"):
+            fn = getattr(engine, name, None)
+            if fn is not None:
+                setattr(engine, name, wrap(fn))
+
+
 def run_leg(params, n_heads, max_len, prompts, n_new, expect,
-            slots=4, flops_per_token=None, **engine_kw):
+            slots=4, flops_per_token=None, step_latency_s=0.0,
+            **engine_kw):
     """One engine config over one prompt list; returns the metrics
     record (parity asserted, not reported on faith), including the
     MFU column (``flops_per_token`` × warm tokens/s over the
     platform's peak — ISSUE 7's the-gap-is-kernel-shaped metric) and,
     on ``attn_kernel`` legs, which attention path actually ran.
+
+    SHARDED legs (ISSUE 8): ``tp=N`` runs the engine tensor-parallel
+    over an N-device mesh; ``replicas=R`` builds R engines (each on
+    its own device slice) behind the metrics-driven Router and the
+    record gains per-replica routing/queue-depth facts plus
+    ``mfu_per_device`` (MFU against the FLEET's peak — devices ×
+    single-device peak).  A leg the host cannot seat (too few
+    devices) returns a ``skipped`` record instead of crashing the
+    bench: on CPU, ``--devices N`` forces an N-device dryrun host.
 
     The workload runs TWICE: the COLD pass supplies the prefill /
     prefix-cache accounting (what a first arrival of this traffic
@@ -162,15 +213,102 @@ def run_leg(params, n_heads, max_len, prompts, n_new, expect,
     engines compile prompt-bucket programs lazily, and timing a
     steady-state serving claim through one-off compiles would hand the
     chunked legs an unearned 10x."""
-    from veles_tpu.serving import LMEngine, ServingMetrics
-    engine = LMEngine(params, n_heads=n_heads, max_len=max_len,
-                      slots=slots, queue_depth=max(64, len(prompts)),
-                      metrics=ServingMetrics("lm_bench"),
-                      **engine_kw).start()
+    import jax
+    from veles_tpu.serving import (LMEngine, Router, ServingMetrics,
+                                   replica_device_slices)
+    tp = int(engine_kw.pop("tp", 0) or 0)
+    replicas = int(engine_kw.pop("replicas", 1) or 1)
+    n_devices = max(1, replicas) * max(1, tp)
+    features = {k: v for k, v in engine_kw.items() if v}
+    if tp:
+        features["tp"] = tp
+    if replicas > 1:
+        features["replicas"] = replicas
+    if n_devices > 1 and jax.device_count() < n_devices:
+        # recorded, never silent: a truncated matrix must say so
+        return {"features": features,
+                "skipped": "needs %d devices, have %d (CPU: rerun "
+                           "with --devices %d under JAX_PLATFORMS="
+                           "cpu)" % (n_devices, jax.device_count(),
+                                     n_devices)}
+    # the SAME replica→devices mapping serve_lm ships
+    slices = (replica_device_slices(replicas, tp)
+              if replicas > 1 else None)
+
+    def build(idx=None, tag="lm_bench"):
+        devices = None
+        labels = None
+        if idx is not None:
+            devices = slices[idx]
+            labels = {"replica": str(idx)}
+        return LMEngine(params, n_heads=n_heads, max_len=max_len,
+                        slots=slots, queue_depth=max(64, len(prompts)),
+                        metrics=ServingMetrics(tag, labels=labels),
+                        tp=tp, devices=devices,
+                        name=tag if idx is None else "%s_r%d"
+                        % (tag, idx), **engine_kw)
+
+    if replicas > 1:
+        engines = [build(i) for i in range(replicas)]
+        server = Router(engines,
+                        metrics=ServingMetrics("lm_bench_router"))
+    else:
+        engines = [build()]
+        server = engines[0]
+    server.start()
+    if step_latency_s:
+        _emulate_device_latency(engines, step_latency_s)
+        features["emulated_step_latency_s"] = step_latency_s
+
+    def fresh_metrics(tag):
+        for i, e in enumerate(engines):
+            e.metrics = ServingMetrics(
+                tag, labels={"replica": str(i)} if replicas > 1
+                else None)
+        if replicas > 1:
+            server.metrics = ServingMetrics(tag + "_router")
+
+    def combined_snapshot():
+        """Aggregate the fleet: counters summed, histogram sums/counts
+        summed (for the TTFT mean), peaks summed (aggregate
+        concurrency), plus the raw per-replica snapshots."""
+        snaps = [e.metrics.snapshot() for e in engines]
+        counters = {}
+        for s in snaps:
+            for k, v in s["counters"].items():
+                counters[k] = counters.get(k, 0) + v
+        ttft_n = sum(s["ttft"]["count"] for s in snaps)
+        return {
+            "counters": counters,
+            "ttft_mean": (sum(s["ttft"]["sum"] for s in snaps)
+                          / ttft_n if ttft_n else 0.0),
+            "slots_busy_peak": sum(
+                int(s["gauges"].get("slots_busy_peak", 0))
+                for s in snaps),
+            "queue_depth_peaks": [
+                int(s["gauges"].get("queue_depth_peak", 0))
+                for s in snaps],
+            "per_replica": snaps,
+        }
+
+    def submit_retrying(p):
+        """Closed-loop admission: a 429 (queue or pool pressure) backs
+        off per Retry-After and resubmits — large --requests against a
+        small pool must measure throughput, not crash the leg (the
+        single-lane paged pool admits ~3 requests' pages at a time)."""
+        from veles_tpu.serving import Overloaded
+        deadline = time.monotonic() + 600
+        while True:
+            try:
+                return server.submit(p, n_new)
+            except Overloaded as e:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(min(getattr(e, "retry_after", 0.05), 0.25))
 
     def one_pass():
         t0 = time.monotonic()
-        futures = [engine.submit(p, n_new) for p in prompts]
+        futures = [submit_retrying(p) for p in prompts]
         rows = [f.result(timeout=600) for f in futures]
         wall = time.monotonic() - t0
         for p, row, exp in zip(prompts, rows, expect):
@@ -179,26 +317,26 @@ def run_leg(params, n_heads, max_len, prompts, n_new, expect,
                 raise AssertionError(
                     "fast-path output diverged from greedy generate "
                     "for prompt of length %d under %r"
-                    % (len(p), engine_kw))
-        return wall, engine.metrics.snapshot()
+                    % (len(p), features))
+        return wall, combined_snapshot()
 
     try:
         _, cold = one_pass()
-        engine.metrics = ServingMetrics("lm_bench_warm")
+        fresh_metrics("lm_bench_warm")
         wall, warm = one_pass()
         cc, c = cold["counters"], warm["counters"]
         tokens = c.get("tokens_out", 0)
         dispatches = c.get("decode_dispatches", 0)
-        if engine_kw.get("attn_kernel"):
+        if features.get("attn_kernel"):
             from veles_tpu.ops.pallas_kernels import on_tpu
-            if not on_tpu() and engine_kw["attn_kernel"] != "force" \
+            if not on_tpu() and features["attn_kernel"] != "force" \
                     and not c.get("attn_kernel_fallbacks"):
                 # the CPU acceptance criterion: the fallback path must
                 # be EXERCISED and METERED, not silently absent
                 raise AssertionError(
                     "attn_kernel leg on CPU did not increment the "
-                    "fallback counter under %r" % (engine_kw,))
-        if engine_kw.get("paged_kv"):
+                    "fallback counter under %r" % (features,))
+        if features.get("paged_kv"):
             # the paged layout has NO row-copy install path — a prefix
             # hit is a page reference; any copy counted here is a bug
             if cc.get("kv_row_copies", 0) or c.get("kv_row_copies", 0):
@@ -206,21 +344,27 @@ def run_leg(params, n_heads, max_len, prompts, n_new, expect,
                     "paged leg performed %d KV row copies under %r — "
                     "prefix hits must be page references"
                     % (cc.get("kv_row_copies", 0)
-                       + c.get("kv_row_copies", 0), engine_kw))
+                       + c.get("kv_row_copies", 0), features))
         tps = tokens / wall if wall else 0.0
         peak, peak_src = peak_flops_estimate()
         mfu = (tps * flops_per_token / peak
                if flops_per_token else None)
-        return {
-            "features": {k: v for k, v in engine_kw.items() if v},
+        record = {
+            "features": features,
             "requests": len(prompts),
             "tokens_out": tokens,
             "wall_s": round(wall, 4),
             "tokens_per_sec": round(tps, 1),
             # the ISSUE 7 column: model FLOPs actually flowing over the
             # platform's advertised peak — the kernel-vs-XLA legs read
-            # off against each other here
+            # off against each other here.  ``mfu`` stays against ONE
+            # device's peak (comparable across every leg);
+            # ``mfu_per_device`` divides by the leg's device count —
+            # the honest utilization of a sharded/replicated fleet
             "mfu": round(mfu, 6) if mfu is not None else None,
+            "mfu_per_device": (round(mfu / n_devices, 6)
+                               if mfu is not None else None),
+            "devices": n_devices,
             "mfu_peak_source": peak_src,
             "attn_kernel_dispatches": c.get("attn_kernel_dispatches",
                                             0),
@@ -236,23 +380,41 @@ def run_leg(params, n_heads, max_len, prompts, n_new, expect,
             "draft_accept_rate": (
                 round(c["draft_accepted"] / c["draft_tokens"], 3)
                 if c.get("draft_tokens") else None),
-            "ttft_mean_s": round(warm["ttft"]["mean"], 5),
+            "ttft_mean_s": round(warm["ttft_mean"], 5),
             # paged-KV memory facts (contiguous legs report them too,
             # for the side-by-side): device KV footprint, row copies
             # paid installing prefix hits (cold pass — 0 when paged),
             # pages served by reference, copy-on-write count, and the
             # peak concurrent lanes the layout actually sustained
-            "kv_bytes_resident": engine.kv_bytes_resident(),
+            "kv_bytes_resident": sum(e.kv_bytes_resident()
+                                     for e in engines),
             "kv_row_copies": cc.get("kv_row_copies", 0),
             "kv_pages_referenced": cc.get("kv_pages_referenced", 0),
             "kv_cow_copies": (cc.get("kv_cow_copies", 0)
                               + c.get("kv_cow_copies", 0)),
-            "slots_busy_peak": int(warm["gauges"].get(
-                "slots_busy_peak", 0)),
+            "slots_busy_peak": warm["slots_busy_peak"],
             "parity_vs_generate": True,     # asserted above, both passes
         }
+        if replicas > 1:
+            # router evidence: server-side placement counts (includes
+            # requeues), the queue-depth high-water spread across the
+            # fleet, and per-replica warm tokens
+            routed = server.routed_counts()
+            record["replica_routed"] = routed
+            record["replica_balance_ratio"] = (
+                round(max(routed) / min(routed), 3)
+                if min(routed) else None)
+            record["replica_queue_depth_peak"] = \
+                warm["queue_depth_peaks"]
+            record["replica_queue_depth_spread"] = (
+                max(warm["queue_depth_peaks"])
+                - min(warm["queue_depth_peaks"]))
+            record["replica_tokens_out"] = [
+                s["counters"].get("tokens_out", 0)
+                for s in warm["per_replica"]]
+        return record
     finally:
-        engine.stop()
+        server.stop()
 
 
 def fixed_kv_memory_comparison(params, n_heads, max_len, chunk, n_new,
@@ -292,6 +454,57 @@ def fixed_kv_memory_comparison(params, n_heads, max_len, chunk, n_new,
         "slots_ratio_vs_contiguous": round(ratio, 2),
         "contiguous": contig,
         "paged": paged,
+    }
+
+
+def replica_scaling_comparison(params, n_heads, max_len, chunk, n_new,
+                               vocab, slots=4, requests=16,
+                               step_latency_s=0.005):
+    """ACCEPTANCE leg (ISSUE 8): the SAME mixed-length workload through
+    (a) ONE paged engine and (b) 2 replicas behind the metrics router,
+    both under the emulated device-bound regime
+    (:func:`_emulate_device_latency` — per-dispatch idle wait, the
+    regime real accelerators serve in and the one replica overlap
+    exists for).  Reports the aggregate-throughput ratio and the
+    router's balance evidence.  The RAW shared-core legs (tp2/
+    replicas2 in the feature matrix) stay in the record for the honest
+    side-by-side: on a dryrun box whose cores one engine already
+    saturates, raw replication measures core contention, not the
+    serving layer."""
+    import jax
+    if jax.device_count() < 2:
+        # before the parity references: skipping must be free, not
+        # cost `requests` full greedy generates first
+        return {"skipped": "needs 2 devices, have %d"
+                           % jax.device_count()}
+    lo, hi = max(4, chunk // 2), max(chunk, (max_len - n_new) // 2)
+    prompts = mixed_length_prompts(requests, vocab, lo, hi)
+    expect = expected_rows(params, prompts, n_new, n_heads, max_len)
+    fpt = decode_flops_per_token(
+        vocab, params["embed"].shape[1], len(params["blocks"]),
+        int(numpy.mean([len(p) for p in prompts])) + n_new // 2,
+        n_heads=n_heads)
+    single = run_leg(params, n_heads, max_len, prompts, n_new, expect,
+                     slots=slots, paged_kv=True, prefill_chunk=chunk,
+                     step_latency_s=step_latency_s,
+                     flops_per_token=fpt)
+    pair = run_leg(params, n_heads, max_len, prompts, n_new, expect,
+                   slots=slots, replicas=2, paged_kv=True,
+                   prefill_chunk=chunk, step_latency_s=step_latency_s,
+                   flops_per_token=fpt)
+    ratio = (pair["tokens_per_sec"]
+             / max(single["tokens_per_sec"], 1e-9))
+    return {
+        "emulated_step_latency_s": step_latency_s,
+        "tokens_per_sec_single": single["tokens_per_sec"],
+        "tokens_per_sec_replicas2": pair["tokens_per_sec"],
+        "replicas2_speedup": round(ratio, 2),
+        "replica_routed": pair["replica_routed"],
+        "replica_balance_ratio": pair["replica_balance_ratio"],
+        "replica_queue_depth_spread":
+            pair["replica_queue_depth_spread"],
+        "single": single,
+        "replicas2": pair,
     }
 
 
@@ -336,6 +549,17 @@ def run_bench(smoke=False, slots=4, chunk=16, cache=256, spec_k=4,
         "paged_kernel_all": {"paged_kv": True, "prefix_cache": cache,
                              "prefill_chunk": chunk, "spec_k": spec_k,
                              "attn_kernel": "auto"},
+        # ISSUE 8: sharded serving on the same workloads — tensor-
+        # parallel decode (tp2, 2-device mesh), data-parallel replicas
+        # behind the metrics router (replicas2, aggregate throughput +
+        # balance evidence), and both stacked (tp2_replicas2, 4
+        # devices).  Hosts without the devices bank a 'skipped' record
+        # per leg (CPU dryrun: --devices N).
+        "tp2": {"tp": 2, "paged_kv": True, "prefill_chunk": chunk},
+        "replicas2": {"replicas": 2, "paged_kv": True,
+                      "prefill_chunk": chunk},
+        "tp2_replicas2": {"tp": 2, "replicas": 2, "paged_kv": True,
+                          "prefill_chunk": chunk},
     }
     # workload A: shared system prompt (load_gen's generator — one
     # request per "client", every prompt shares the prefix)
@@ -390,6 +614,12 @@ def run_bench(smoke=False, slots=4, chunk=16, cache=256, spec_k=4,
         params, n_heads, max_len, chunk, n_new, vocab,
         budget_slots=2 if smoke else 4, requests=requests * 2)
     stream_summary()
+    # the replica-scaling acceptance leg (ISSUE 8): device-bound
+    # regime, 1 engine vs 2 replicas on the same mixed-length traffic
+    results["replica_scaling"] = replica_scaling_comparison(
+        params, n_heads, max_len, chunk, n_new, vocab, slots=slots,
+        requests=max(8, requests))
+    stream_summary()
     # headline facts the acceptance criteria name
     lane1 = results["workloads"]["repetitive_single_lane"]
     sp_cache = results["workloads"]["shared_prefix"]["prefix_cache"]
@@ -430,6 +660,33 @@ def run_bench(smoke=False, slots=4, chunk=16, cache=256, spec_k=4,
             results["workloads"]["shared_prefix"]["paged_kernel"]
             ["attn_kernel_fallbacks"],
     }
+    # ISSUE 8 headline: replica scaling on the mixed-length workload
+    # (the acceptance ratio) + client-relevant balance on shared_prefix
+    ml = results["workloads"]["mixed_length"]
+    if "skipped" not in ml["replicas2"]:
+        # the RAW shared-core ratio against the SAME engine config
+        # single-replica ('paged' == replicas2 minus the router) —
+        # honest about core contention on a dryrun box; the
+        # acceptance ratio is the device-bound replica_scaling leg's
+        results["headline"]["replicas2_speedup_mixed_length_raw"] = \
+            round(ml["replicas2"]["tokens_per_sec"]
+                  / max(ml["paged"]["tokens_per_sec"], 1e-9), 2)
+    scaling = results.get("replica_scaling", {})
+    if "skipped" not in scaling:
+        results["headline"]["replicas2_speedup_mixed_length"] = \
+            scaling["replicas2_speedup"]
+        results["headline"]["replica_balance_ratio_mixed_length"] = \
+            scaling["replica_balance_ratio"]
+    sp2 = results["workloads"]["shared_prefix"]["replicas2"]
+    if "skipped" not in sp2:
+        results["headline"]["replica_balance_ratio_shared_prefix"] = \
+            sp2["replica_balance_ratio"]
+    tp_leg = results["workloads"]["shared_prefix"]["tp2"]
+    if "skipped" not in tp_leg:
+        results["headline"]["tp2_tokens_per_sec_shared_prefix"] = \
+            tp_leg["tokens_per_sec"]
+        results["headline"]["tp2_parity_vs_generate"] = \
+            tp_leg["parity_vs_generate"]
     return results
 
 
@@ -523,7 +780,20 @@ def main(argv=None):
     parser.add_argument("--requests", type=int, default=8)
     parser.add_argument("--json", default=None, metavar="FILE",
                         help="also write the record here")
+    parser.add_argument("--devices", type=int, default=0, metavar="N",
+                        help="force an N-device CPU host platform "
+                             "(xla_force_host_platform_device_count) "
+                             "so the sharded legs (tp2/replicas2/"
+                             "tp2_replicas2) can seat on a laptop/CI "
+                             "box — CPU dryrun only, set before jax "
+                             "initializes; ignored on real TPU hosts")
     args = parser.parse_args(argv)
+    if args.devices:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=%d"
+                % args.devices).strip()
     max_len = bench_max_len(args.smoke)
     if args.chunk < 1 or max_len % args.chunk:
         # the paged legs run unconditionally and LMEngine requires the

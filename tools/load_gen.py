@@ -189,6 +189,7 @@ def run_lm_load(url, clients=8, requests_per_client=20, vocab=16,
                        qps=qps, duration=duration, timeout=timeout,
                        payload_fn=payload_fn)
     gen_counts, rates = [], []
+    replica_counts = {}
     for rec, resp in zip(summary["records"], summary["responses"]):
         if rec["status"] != 200 or not resp or "tokens" not in resp:
             continue
@@ -198,6 +199,13 @@ def run_lm_load(url, clients=8, requests_per_client=20, vocab=16,
         gen_counts.append(generated)
         if rec["latency_s"] > 0:
             rates.append(generated / rec["latency_s"])
+        # routed serving (ISSUE 8): serve_lm(replicas=N) stamps each
+        # row with the replica that decoded it — aggregate the
+        # CLIENT-observed placement so router skew is measurable from
+        # outside the server
+        for rid in resp.get("replicas", ()):
+            key = str(rid)
+            replica_counts[key] = replica_counts.get(key, 0) + 1
     summary["lm"] = {
         "vocab": vocab, "mean_len": mean_len,
         "shared_frac": shared_frac, "n_new": n_new,
@@ -216,6 +224,20 @@ def run_lm_load(url, clients=8, requests_per_client=20, vocab=16,
             "p50": _percentile(sorted(rates), 0.50),
         },
     }
+    if replica_counts:
+        # balance ratio: max/min requests per replica as THE CLIENT
+        # saw them (1.0 = perfect spread; the acceptance criterion
+        # bounds it on the shared-prefix workload).  Only replicas
+        # that actually served appear in the counts, so a routed
+        # fleet where every reply came from ONE replica is total
+        # skew (a starved or drained sibling) — reported as null,
+        # never as a perfect 1.0.
+        summary["lm"]["per_replica_requests"] = dict(
+            sorted(replica_counts.items()))
+        summary["lm"]["replica_balance_ratio"] = (
+            round(max(replica_counts.values())
+                  / min(replica_counts.values()), 3)
+            if len(replica_counts) > 1 else None)
     return summary
 
 

@@ -227,6 +227,33 @@ def build_argparser():
                              "interpret mode (tests only — orders of "
                              "magnitude slower than the fallback); "
                              "'off' = the XLA path (default)")
+    parser.add_argument("--serve-tp", type=int, default=0,
+                        metavar="N",
+                        help="with --serve-slots: tensor-parallel "
+                             "decode — run every engine program over "
+                             "an N-device mesh (weights head-sharded, "
+                             "KV cache/pool sharded head-wise; N must "
+                             "divide the model's attention and KV "
+                             "head counts; greedy output stays "
+                             "bit-identical).  0 = single-device "
+                             "(default)")
+    parser.add_argument("--serve-replicas", type=int, default=1,
+                        metavar="R",
+                        help="with --serve-slots: R independent "
+                             "data-parallel engine replicas (each on "
+                             "its own device slice — R×max(tp,1) "
+                             "devices when --serve-tp >= 2) behind a "
+                             "metrics-driven router; /metrics gains "
+                             "{replica=\"i\"} labels and responses a "
+                             "per-row replica id")
+    parser.add_argument("--serve-router", default="metrics",
+                        choices=("metrics", "round_robin"),
+                        help="with --serve-replicas: placement policy "
+                             "— 'metrics' (default) weighs each "
+                             "replica's live queue depth, resident KV "
+                             "pages and TTFT/decode-step EWMAs; "
+                             "'round_robin' ignores them (the skew "
+                             "baseline)")
     return parser
 
 
@@ -421,7 +448,10 @@ def main(argv=None):
                                      else args.serve_paged_kv),
                            attn_kernel=(0 if args.serve_attn_kernel
                                         == "off"
-                                        else args.serve_attn_kernel))
+                                        else args.serve_attn_kernel),
+                           tp=args.serve_tp,
+                           replicas=args.serve_replicas,
+                           router=args.serve_router)
         else:
             api = RESTfulAPI(
                 wf, normalizer=getattr(wf.loader, "normalizer", None))

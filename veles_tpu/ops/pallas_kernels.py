@@ -552,11 +552,20 @@ def paged_flash_prefill(q, k_new, v_new, k_pool, v_pool, ptab, pos,
 
 
 def serving_kernels_supported(paged, n_heads, kv_heads, head_dim,
-                              page):
+                              page, tp=0):
     """(ok, reason) — can the serving attention kernels carry this
     engine geometry?  The checks are STRUCTURAL (what the kernels
     cannot express), not platform: platform routing (TPU vs interpret
-    vs fallback) is the engine's decision."""
+    vs fallback) is the engine's decision.  ``tp >= 2`` (a
+    tensor-parallel serving mesh, ISSUE 8) is structural too: a
+    pallas_call is a single-device program and the KV pool is
+    head-sharded across the mesh, so TP-sharded engines serve through
+    the XLA path (GSPMD shards the gather + softmax like any other
+    op), metered as fallbacks exactly like the off-TPU case."""
+    if tp and tp >= 2:
+        return False, ("tensor-parallel mesh (tp=%d): the Pallas "
+                       "serving kernels are single-device programs; "
+                       "the XLA path serves sharded decode" % tp)
     if not paged:
         return False, ("contiguous KV layout (the kernels walk a page "
                        "table; enable paged_kv)")

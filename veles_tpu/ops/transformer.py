@@ -382,6 +382,57 @@ def paged_chunk_apply(params, tokens, pools, ptab, pos, n_heads,
     return h, new_pools
 
 
+def lm_param_specs(params, axis="tp"):
+    """``jax.sharding.PartitionSpec`` tree (same structure as
+    ``params``) for TENSOR-PARALLEL serving over a one-axis mesh — the
+    megatron head/column split the training-side TP tests
+    (tests/test_parallel.py) already prove out, applied to the decode
+    param tree:
+
+    - attention ``wq``/``wk``/``wv`` are COLUMN-sharded over ``axis``
+      (heads are contiguous feature groups in the output dim, so an
+      ``axis`` size dividing n_heads — and n_kv_heads, for the smaller
+      wk/wv — partitions whole heads and each device attends only its
+      own head group against its own KV shard);
+    - ``wo`` is ROW-sharded (the contraction over the sharded head
+      features becomes the one per-block all-reduce);
+    - FFN ``w1``/``b1`` column-, ``w2`` row-sharded (same pattern over
+      d_ff);
+    - embeddings, positional table, layernorms, biases after
+      reductions, and MoE expert stacks stay REPLICATED.
+
+    Consumed by ``serving/lm_engine.py`` (``LMEngine(tp=)``): weights
+    placed by these specs flow through the UNCHANGED decode/chunk/
+    verify programs and GSPMD inserts the collectives — the dataflow
+    reconfigures, the kernels stay put."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    col, row, repl = P(None, axis), P(axis, None), P()
+
+    def replicated(tree):
+        return jax.tree.map(lambda _: repl, tree)
+
+    blocks = []
+    for blk in params["blocks"]:
+        spec = {}
+        for key, val in blk.items():
+            if key == "attn":
+                spec[key] = {"wq": col, "wk": col, "wv": col, "wo": row}
+            elif key == "w1":
+                spec[key] = col
+            elif key == "b1":
+                spec[key] = P(axis)
+            elif key == "w2":
+                spec[key] = row
+            else:
+                spec[key] = replicated(val)
+        blocks.append(spec)
+    out = {key: replicated(val) for key, val in params.items()
+           if key != "blocks"}
+    out["blocks"] = blocks
+    return out
+
+
 def _make_sampler(greedy, top_k, temperature):
     """Token sampler shared by the full-cache and rolling decoders (the
     top-k tie rule and traced-temperature handling must never drift

@@ -42,6 +42,26 @@ def make_mesh(n_devices=None, model_parallel=1, devices=None):
     return Mesh(grid, ("data", "model"))
 
 
+def make_tp_mesh(tp, devices=None):
+    """One-axis ``('tp',)`` mesh over ``tp`` devices for TENSOR-PARALLEL
+    SERVING (``serving/lm_engine.py::LMEngine(tp=)``) — the serving
+    sibling of :func:`make_mesh`'s ``model`` axis, kept separate because
+    an engine mesh is a DEVICE SLICE: data-parallel engine replicas each
+    build their own disjoint tp mesh out of one host's devices
+    (``serving/router.py``), whereas the training mesh owns them all.
+    ``devices`` defaults to the first ``tp`` of ``jax.devices()``."""
+    import jax
+    from jax.sharding import Mesh
+    if tp < 2:
+        raise ValueError("a tp mesh needs >= 2 devices (got tp=%d); "
+                         "tp<2 serving runs without a mesh" % tp)
+    devices = list(devices if devices is not None else jax.devices())
+    if tp > len(devices):
+        raise ValueError("requested tp=%d devices, have %d"
+                         % (tp, len(devices)))
+    return Mesh(numpy.array(devices[:tp]), ("tp",))
+
+
 def model_shard_candidates(runner, min_width=1024):
     """Layer indices whose output width makes model-axis sharding pay
     (e.g. AlexNet's 4096-wide FC trunk).  Narrow layers stay replicated —

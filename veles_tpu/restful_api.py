@@ -285,7 +285,8 @@ class RESTfulAPI(Logger):
 def serve_lm(workflow, host="127.0.0.1", port=8180, max_new=256,
              slots=0, queue_depth=64, deadline_s=30.0,
              prefix_cache=0, prefill_chunk=0, spec_k=0,
-             queue_tokens=0, paged_kv=0, attn_kernel=None):
+             queue_tokens=0, paged_kv=0, attn_kernel=None,
+             tp=0, replicas=1, router="metrics"):
     """Serve a trained transformer-trainer workflow (e.g. char_lm) for
     autoregressive continuation: POST ``{"input": [[tok, ...]],
     "n_new": N, "temperature": T, "top_k": K, "seed": S}`` to
@@ -322,6 +323,20 @@ def serve_lm(workflow, host="127.0.0.1", port=8180, max_new=256,
     ``attention.set_attention_backend('flash_serve')``.  All preserve
     bit-identical greedy output; see ``veles_tpu/serving/lm_engine.py``.
 
+    SHARDED SERVING (ISSUE 8): ``tp=N`` runs each engine's decode
+    tensor-parallel over an N-device mesh (weights head-sharded,
+    KV head-wise — greedy output still bit-identical); ``replicas=R``
+    builds R independent engines (each on its own device slice —
+    ``R×max(tp,1)`` devices when tp >= 2) behind a
+    :class:`veles_tpu.serving.Router` placing each request by live
+    metrics signals (``router='metrics'``; ``'round_robin'`` for the
+    skew baseline).  Routed responses carry a per-row ``"replicas"``
+    list so closed-loop clients (``tools/load_gen.py --lm``) can
+    measure balance; ``/metrics`` renders per-replica
+    ``{replica="i"}`` labeled families and ``/metrics.json`` embeds
+    every replica snapshot.  Admission (429/503) is unchanged behind
+    the router.
+
     The direct path decodes one prompt batch at a time via the
     KV-cached ``transformer.generate``, one jitted dispatch per
     request.  Compile count and per-request cost are both BOUNDED
@@ -349,19 +364,48 @@ def serve_lm(workflow, host="127.0.0.1", port=8180, max_new=256,
     # max_new=256 decode)
     tiers = sorted({t for t in (8, 32, 128, max_new) if t <= max_new})
     engine = None
+    routed = False
     if slots > 0:
-        from veles_tpu.serving import LMEngine
+        from veles_tpu.serving import (LMEngine, Router, RouterMetrics,
+                                       replica_device_slices)
         from veles_tpu.serving import metrics as metrics_mod
-        engine = LMEngine(
-            params, n_heads=trainer.n_heads, max_len=cache_len,
-            slots=slots, rope=getattr(trainer, "rope", False),
-            window=getattr(trainer, "window", None),
-            sinks=getattr(trainer, "attn_sinks", 0),
-            queue_depth=queue_depth, deadline_s=deadline_s,
-            prefix_cache=prefix_cache, prefill_chunk=prefill_chunk,
-            spec_k=spec_k, queue_tokens=queue_tokens,
-            paged_kv=paged_kv, attn_kernel=attn_kernel,
-            metrics=metrics_mod.new("lm")).start()
+        n_rep = max(1, int(replicas))
+        tp_n = int(tp or 0)
+        slices = (replica_device_slices(n_rep, tp_n)
+                  if n_rep > 1 else None)
+
+        def build_engine(i=None):
+            """One engine — replica ``i`` owns its own device slice
+            (replica_device_slices — the same mapping the bench
+            measures) and a metrics row labeled {replica="i"} under
+            the shared 'lm' family."""
+            devices = None
+            label = None
+            eng_name = "lm"
+            if i is not None:
+                devices = slices[i]
+                label = {"replica": str(i)}
+                eng_name = "lm_r%d" % i
+            return LMEngine(
+                params, n_heads=trainer.n_heads, max_len=cache_len,
+                slots=slots, rope=getattr(trainer, "rope", False),
+                window=getattr(trainer, "window", None),
+                sinks=getattr(trainer, "attn_sinks", 0),
+                queue_depth=queue_depth, deadline_s=deadline_s,
+                prefix_cache=prefix_cache, prefill_chunk=prefill_chunk,
+                spec_k=spec_k, queue_tokens=queue_tokens,
+                paged_kv=paged_kv, attn_kernel=attn_kernel,
+                tp=tp_n, devices=devices, name=eng_name,
+                metrics=metrics_mod.new("lm", labels=label))
+
+        if n_rep > 1:
+            routed = True
+            engine = Router(
+                [build_engine(i) for i in range(n_rep)],
+                metrics=metrics_mod.register(RouterMetrics("lm_router")),
+                policy=router).start()
+        else:
+            engine = build_engine().start()
 
     def handler(request):
         prompt = numpy.asarray(request["input"], numpy.int32)
@@ -383,6 +427,13 @@ def serve_lm(workflow, host="127.0.0.1", port=8180, max_new=256,
                 and eng_headroom >= 1:
             # continuous batching: exact n_new (no tier), concurrent
             # prompts share the decode step across slots
+            if routed:
+                toks, reps = engine.generate(
+                    prompt, min(want, eng_headroom),
+                    return_replicas=True)
+                # per-row replica ids: the client-side balance
+                # evidence load_gen --lm aggregates
+                return {"tokens": toks.tolist(), "replicas": reps}
             return {"tokens": engine.generate(
                 prompt, min(want, eng_headroom)).tolist()}
         # decode length: round the request UP to a tier; near the cache

@@ -26,6 +26,15 @@ request, this package amortizes dispatch across concurrent clients.
   prefix-cache hits become zero-copy page references (ref-counts +
   copy-on-write), and slot count is bounded by the pool, not by
   ``slots × max_len``.
+- :mod:`veles_tpu.serving.router` — :class:`Router` (ISSUE 8): N
+  data-parallel :class:`LMEngine` replicas — each optionally
+  tensor-parallel over its own device slice (``LMEngine(tp=)``, mesh
+  from ``parallel.make_tp_mesh``, weights by
+  ``ops.transformer.lm_param_specs``) — placed by live metrics
+  signals (queue depth, resident KV pages, TTFT/decode-step EWMAs),
+  with hot-unregister draining that requeues a sick replica's pending
+  requests.  ``serve_lm(tp=, replicas=)``, CLI ``--serve-tp`` /
+  ``--serve-replicas`` / ``--serve-router``.
 - :mod:`veles_tpu.serving.metrics` — :class:`ServingMetrics`:
   lock-cheap counters/histograms (queue wait, batch size, latency
   percentiles, shed/429, slot occupancy) with a snapshot API and a
@@ -45,9 +54,11 @@ from veles_tpu.serving.lm_engine import (LMEngine, RadixPrefixCache,
                                          prompt_bucket, propose_draft)
 from veles_tpu.serving.metrics import (ServingMetrics, get,
                                        render_prometheus)
+from veles_tpu.serving.router import (Router, RouterMetrics,
+                                      replica_device_slices)
 
 __all__ = ["MicroBatcher", "LMEngine", "RadixPrefixCache",
-           "KVPagePool", "ServingMetrics", "Overloaded",
-           "DeadlineExceeded", "PoolExhausted", "batch_buckets",
-           "prompt_bucket", "propose_draft", "get",
-           "render_prometheus"]
+           "KVPagePool", "Router", "RouterMetrics", "ServingMetrics",
+           "Overloaded", "DeadlineExceeded", "PoolExhausted",
+           "batch_buckets", "prompt_bucket", "propose_draft", "get",
+           "render_prometheus", "replica_device_slices"]

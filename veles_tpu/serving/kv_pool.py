@@ -70,6 +70,13 @@ class KVPagePool:
         """Pages held by an active lane (the gauge /metrics exposes)."""
         return sum(1 for p in self._pins[1:] if p > 0)
 
+    @property
+    def occupancy(self):
+        """Used fraction of the pool (0..1) — the resident-KV pressure
+        signal behind the ``kv_pages_free``/``kv_pages_total`` gauges
+        the serving router weighs when placing requests (ISSUE 8)."""
+        return self.used_pages / float(self.num_pages)
+
     def refs(self, page):
         return self._refs[page]
 

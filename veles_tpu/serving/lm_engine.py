@@ -87,6 +87,22 @@ Decode/verify additionally slice the page table to the LIVE width
 ladder (``_live_width``): a step pays for the pages the batch actually
 occupies, one program per power-of-two ladder entry.
 
+SHARDED SERVING (ISSUE 8, ``tp=N``) runs every program above under a
+one-axis ``('tp',)`` mesh: weights are head-/column-sharded by
+``ops/transformer.py::lm_param_specs`` (megatron split — wq/wk/wv by
+head group, wo/w2 by row so GSPMD inserts one all-reduce per block),
+the KV pool/caches shard over their kv_heads axis, and the page
+tables, host allocator and every program stay EXACTLY as above — the
+head shard and the page indirection compose because neither is a
+shape.  Output shardings are pinned to the input layout so the mesh
+adds zero programs (the jit-guard bound holds per replica).  The
+Pallas kernels are single-device programs, so a TP engine serves
+through the XLA path (metered as ``attn_kernel_fallbacks`` when
+kernels were requested).  ``devices=`` narrows the engine to a device
+slice — N independent engine REPLICAS (each optionally TP-sharded
+over a disjoint slice) stack behind ``serving/router.py`` for the
+data-parallel axis.
+
 Decoding is GREEDY (temperature 0) — bit-identical to
 ``ops/transformer.py::generate`` for the same prompt WHATEVER fast-path
 combination is enabled, which is the serving contract (sampled
@@ -364,7 +380,9 @@ class LMEngine(Logger):
                  window=None, sinks=0, queue_depth=64, deadline_s=30.0,
                  metrics=None, name="lm", prefill_chunk=0,
                  prefix_cache=0, spec_k=0, spec_ngram=3,
-                 queue_tokens=0, paged_kv=0, attn_kernel=None):
+                 queue_tokens=0, paged_kv=0, attn_kernel=None,
+                 tp=0, devices=None):
+        import jax
         import jax.numpy as jnp
         if slots < 1:
             raise ValueError("slots must be >= 1")
@@ -372,6 +390,35 @@ class LMEngine(Logger):
         self.params = params
         self.n_heads = int(n_heads)
         self.max_len = int(max_len)
+        # ---- sharded serving (ISSUE 8): ``tp >= 2`` runs EVERY engine
+        # program under a one-axis ('tp',) mesh — weights head-/column-
+        # sharded by ops/transformer.py::lm_param_specs, KV storage
+        # sharded over its kv_heads axis — with the decode/chunk/verify
+        # math UNCHANGED (GSPMD inserts the per-block all-reduce).
+        # ``devices`` narrows the engine to a device SLICE: a
+        # data-parallel replica (serving/router.py) owns devices
+        # [i*tp, (i+1)*tp) of the host; tp<2 with ``devices`` pins a
+        # single-device replica there.  Output shardings are pinned to
+        # the input layout in _build_jits, so the compile count stays
+        # at one program per family (the jit-guard bound) under the
+        # mesh too.
+        self.tp = int(tp or 0)
+        if self.tp < 0:
+            raise ValueError("tp must be >= 0 (got %d)" % self.tp)
+        devices = list(devices) if devices is not None else None
+        self._mesh = None
+        self._device = None
+        self._kv_shard = None
+        self._repl_shard = None
+        if self.tp >= 2:
+            from veles_tpu.parallel import make_tp_mesh
+            if self.n_heads % self.tp:
+                raise ValueError(
+                    "tp=%d must divide n_heads %d (whole attention "
+                    "heads shard)" % (self.tp, self.n_heads))
+            self._mesh = make_tp_mesh(self.tp, devices)
+        elif devices:
+            self._device = devices[0]
         self.slots = int(slots)
         self.rope = bool(rope)
         self.window = window
@@ -418,11 +465,36 @@ class LMEngine(Logger):
         self.metrics = metrics or ServingMetrics(name)
         self.metrics.set_gauge("slots_total", self.slots)
         self.metrics.set_gauge("slots_busy", 0)
+        self.metrics.set_gauge("tp_devices", self.tp or 1)
 
         embed = params["embed"]
         d_model = embed.shape[1]
         head_dim = d_model // self.n_heads
         kv_heads = params["blocks"][0]["attn"]["wk"].shape[1] // head_dim
+        if self._mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            from veles_tpu.ops.transformer import lm_param_specs
+            if kv_heads % self.tp:
+                raise ValueError(
+                    "tp=%d must divide kv_heads %d (the KV cache "
+                    "shards head-wise)" % (self.tp, kv_heads))
+            # place the weights by the megatron specs; the KV arrays
+            # below shard over their kv_heads axis so paged_view /
+            # mha_paged_chunk_step (and the contiguous decode) stay
+            # one-program-per-family — the page-table indirection and
+            # the head shard compose, neither is a shape
+            self.params = jax.tree.map(
+                lambda a, s: jax.device_put(
+                    a, NamedSharding(self._mesh, s)),
+                self.params, lm_param_specs(self.params))
+            self._kv_shard = NamedSharding(
+                self._mesh, P(None, "tp", None, None))
+            self._repl_shard = NamedSharding(self._mesh, P())
+        elif self._device is not None:
+            # a single-device replica: commit the weights (and the KV
+            # arrays below) so every program runs on THIS device slice
+            # instead of whatever the process default is
+            self.params = jax.device_put(self.params, self._device)
         # ---- serving attention kernels (ISSUE 7): resolve the routing
         # ONCE here — platform and geometry are fixed for the engine's
         # lifetime, so the fallback decision never flaps mid-traffic.
@@ -446,7 +518,7 @@ class LMEngine(Logger):
                 on_tpu, serving_kernels_supported)
             ok, reason = serving_kernels_supported(
                 self._paged, self.n_heads, kv_heads, head_dim,
-                self.prefill_chunk)
+                self.prefill_chunk, tp=self.tp)
             if ok and (self.attn_kernel == "force" or on_tpu()):
                 self._kernel_active = True
             else:
@@ -489,17 +561,19 @@ class LMEngine(Logger):
             self._pool = KVPagePool(num_pages, self.prefill_chunk)
             pool_shape = (num_pages + 1, kv_heads, self.prefill_chunk,
                           head_dim)          # +1: the scratch page
-            self._kv_pools = [(jnp.zeros(pool_shape, embed.dtype),
-                               jnp.zeros(pool_shape, embed.dtype))
-                              for _ in params["blocks"]]
+            self._kv_pools = [
+                (self._place_kv(jnp.zeros(pool_shape, embed.dtype)),
+                 self._place_kv(jnp.zeros(pool_shape, embed.dtype)))
+                for _ in params["blocks"]]
             self._page_tables = numpy.zeros(
                 (self.slots, self._max_pages), numpy.int32)
             self.metrics.set_gauge("kv_pages_total", num_pages)
         else:
             cache_shape = (self.slots, kv_heads, self.max_len, head_dim)
-            self._caches = [(jnp.zeros(cache_shape, embed.dtype),
-                             jnp.zeros(cache_shape, embed.dtype))
-                            for _ in params["blocks"]]
+            self._caches = [
+                (self._place_kv(jnp.zeros(cache_shape, embed.dtype)),
+                 self._place_kv(jnp.zeros(cache_shape, embed.dtype)))
+                for _ in params["blocks"]]
         self._trie = (RadixPrefixCache(
             prefix_cache, self.prefill_chunk,
             on_evict=self._pool.release if self._paged else None)
@@ -520,6 +594,37 @@ class LMEngine(Logger):
         self._build_jits()
         if self._paged:
             self._update_pool_gauges()
+
+    # ----------------------------------------------------------- placement
+    def _place_kv(self, arr):
+        """Place one KV array per the engine's layout: head-sharded
+        over the tp mesh, committed to the replica's device, or left
+        uncommitted (the single-device default)."""
+        import jax
+        if self._mesh is not None:
+            return jax.device_put(arr, self._kv_shard)
+        if self._device is not None:
+            return jax.device_put(arr, self._device)
+        return arr
+
+    def _jit(self, fn, out_shardings=None):
+        """``jax.jit`` with the output layout PINNED under a tp mesh:
+        without the pin, GSPMD's chosen output sharding compares
+        unequal to the device_put input layout and the second call of
+        every family silently compiles a twin program — the exact
+        recompile ladder the jit-guard forbids.  Off-mesh, a plain
+        jit."""
+        import jax
+        if self._mesh is None or out_shardings is None:
+            return jax.jit(fn)
+        return jax.jit(fn, out_shardings=out_shardings)
+
+    def _out_shard_trees(self):
+        """(kv_tree, repl) building blocks for out_shardings: one
+        (k, v) sharding pair per block, and the replicated sharding
+        for token outputs."""
+        kv_pair = (self._kv_shard, self._kv_shard)
+        return [kv_pair] * len(self.params["blocks"]), self._repl_shard
 
     # ------------------------------------------------------------- jitted core
     def _build_jits(self):
@@ -570,10 +675,16 @@ class LMEngine(Logger):
             logits = head_logits(params, x)[0, 0, :]
             return new_rows, jnp.argmax(logits).astype(jnp.int32)
 
-        self._prefill_jit = jax.jit(prefill_one)
-        self._install_jit = jax.jit(install)
-        self._step_jit = jax.jit(jax.vmap(step_one,
-                                          in_axes=(None, 0, 0, 0)))
+        kv_tree = repl = None
+        if self._mesh is not None:
+            kv_tree, repl = self._out_shard_trees()
+        self._prefill_jit = self._jit(
+            prefill_one,
+            (repl, kv_tree) if self._mesh is not None else None)
+        self._install_jit = self._jit(install, kv_tree)
+        self._step_jit = self._jit(
+            jax.vmap(step_one, in_axes=(None, 0, 0, 0)),
+            (kv_tree, repl) if self._mesh is not None else None)
 
         self._chunk_jit = None
         self._chunk_install_jit = None
@@ -628,9 +739,11 @@ class LMEngine(Logger):
                                                   (slot, 0, start, 0)))
                     for (kc, vc), (rk, rv) in zip(caches, rows)]
 
-            self._chunk_jit = jax.jit(chunk_slot)
-            self._chunk_extract_jit = jax.jit(chunk_extract)
-            self._chunk_install_jit = jax.jit(chunk_install)
+            self._chunk_jit = self._jit(
+                chunk_slot,
+                (kv_tree, repl) if self._mesh is not None else None)
+            self._chunk_extract_jit = self._jit(chunk_extract, kv_tree)
+            self._chunk_install_jit = self._jit(chunk_install, kv_tree)
 
         self._verify_jit = None
         if self.spec_k:
@@ -648,8 +761,9 @@ class LMEngine(Logger):
                 out = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                 return [(kc[0], vc[0]) for kc, vc in rows], out
 
-            self._verify_jit = jax.jit(jax.vmap(
-                verify_one, in_axes=(None, 0, 0, 0)))
+            self._verify_jit = self._jit(
+                jax.vmap(verify_one, in_axes=(None, 0, 0, 0)),
+                (kv_tree, repl) if self._mesh is not None else None)
 
     def _build_paged_jits(self):
         """The PAGED program set — every shape is fixed by (slots,
@@ -708,9 +822,13 @@ class LMEngine(Logger):
             return [(kp.at[dst].set(kp[src]), vp.at[dst].set(vp[src]))
                     for kp, vp in pools]
 
-        self._chunk_jit = jax.jit(chunk_slot)
-        self._step_jit = jax.jit(step_all)
-        self._page_copy_jit = jax.jit(page_copy)
+        kv_tree = repl = None
+        if self._mesh is not None:
+            kv_tree, repl = self._out_shard_trees()
+        pair = (kv_tree, repl) if self._mesh is not None else None
+        self._chunk_jit = self._jit(chunk_slot, pair)
+        self._step_jit = self._jit(step_all, pair)
+        self._page_copy_jit = self._jit(page_copy, kv_tree)
         self._prefill_jit = None
         self._install_jit = None
         self._chunk_install_jit = None
@@ -728,7 +846,7 @@ class LMEngine(Logger):
                 return pools, jnp.argmax(
                     logits, axis=-1).astype(jnp.int32)
 
-            self._verify_jit = jax.jit(verify_all)
+            self._verify_jit = self._jit(verify_all, pair)
 
     # --------------------------------------------------------------- lifecycle
     def start(self):
@@ -861,6 +979,11 @@ class LMEngine(Logger):
             self._queued_pages += req.pages
             self.metrics.record_enqueue()
             self.metrics.set_gauge("queue_depth", len(self._queue))
+            # the router/bench-visible high-water mark of this
+            # replica's backlog (an instantaneous gauge under-reads
+            # between scrapes)
+            self.metrics.set_gauge_max("queue_depth_peak",
+                                       len(self._queue))
             self.metrics.set_gauge("queue_tokens", self._queued_tokens)
             if self._paged:
                 self.metrics.set_gauge("queue_pages",

@@ -72,11 +72,35 @@ def _percentile(sorted_vals, q):
     return sorted_vals[i]
 
 
-class ServingMetrics:
-    """One engine's counters; create via :func:`get` to auto-register."""
+def _escape_label(value):
+    """Prometheus label-value escaping (backslash, quote, newline)."""
+    return str(value).replace("\\", r"\\").replace('"', r'\"') \
+                     .replace("\n", r"\n")
 
-    def __init__(self, name="serving", latency_window=4096):
+
+def _label_key(labels):
+    """Canonical (sorted tuple) form of a labels dict — the internal
+    key for labeled gauge/counter samples."""
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+class ServingMetrics:
+    """One engine's counters; create via :func:`get` to auto-register.
+
+    ``labels`` (ISSUE 8) attaches constant Prometheus labels to EVERY
+    sample this instance renders — data-parallel engine replicas share
+    one family name (``name="lm"``) and differ only by
+    ``labels={"replica": "0"}``, so scrapers see one ``# TYPE`` per
+    family with one row per replica.  Individual gauges/counters can
+    additionally carry per-sample labels via ``set_gauge(...,
+    labels=)`` / ``inc(..., labels=)`` — the router's per-replica
+    placement counters ride that path."""
+
+    def __init__(self, name="serving", latency_window=4096, labels=None):
         self.name = name
+        #: constant instance-level labels rendered on every sample
+        self.labels = {str(k): str(v)
+                       for k, v in (labels or {}).items()}
         self._lock = threading.Lock()
         #: counters
         self.requests = 0        # admitted into a queue
@@ -105,6 +129,15 @@ class ServingMetrics:
         self._recent = collections.deque(maxlen=latency_window)
         #: point-in-time values (queue depth, slot occupancy, ...)
         self.gauges = {}
+        #: labeled samples: {(name, label_key): value} — rendered into
+        #: the SAME family as the unlabeled sample of that name
+        self._labeled_gauges = {}
+        self._labeled_counters = {}
+        #: exponentially-weighted moving averages of the latency facts
+        #: (TTFT, decode-step wall) — the router's freshness-weighted
+        #: placement signal (a cumulative mean never forgets a cold
+        #: start; an EWMA tracks the replica as it is NOW)
+        self.ewmas = {}
 
     # ------------------------------------------------------------- recording
     def record_enqueue(self):
@@ -141,21 +174,38 @@ class ServingMetrics:
         """Time from enqueue to the request's FIRST generated token."""
         with self._lock:
             self.ttft.observe(seconds)
+            self._ewma("ttft", seconds)
 
     def record_decode_step(self, seconds):
         """Wall seconds of one decode/verify dispatch."""
         with self._lock:
             self.decode_step.observe(seconds)
+            self._ewma("decode_step", seconds)
 
-    def inc(self, name, n=1):
+    def _ewma(self, name, value, alpha=0.2):
+        prev = self.ewmas.get(name)
+        self.ewmas[name] = value if prev is None \
+            else (1.0 - alpha) * prev + alpha * value
+
+    def inc(self, name, n=1, labels=None):
         """Bump the named counter by ``n`` (created at zero on first
         use) — the LM fast-path facts (prefix_hit_tokens,
-        draft_accepted, ...) that are not worth a dedicated slot."""
+        draft_accepted, ...) that are not worth a dedicated slot.
+        ``labels`` keeps a separately-keyed sample in the same family
+        (the router's ``routed_requests{replica="i"}``)."""
         with self._lock:
-            self.counters[name] = self.counters.get(name, 0) + n
+            if labels:
+                key = (name, _label_key(labels))
+                self._labeled_counters[key] = \
+                    self._labeled_counters.get(key, 0) + n
+            else:
+                self.counters[name] = self.counters.get(name, 0) + n
 
-    def counter(self, name):
+    def counter(self, name, labels=None):
         with self._lock:
+            if labels:
+                return self._labeled_counters.get(
+                    (name, _label_key(labels)), 0)
             return self.counters.get(name, 0)
 
     def record_response(self, latency_s):
@@ -164,9 +214,24 @@ class ServingMetrics:
             self.latency.observe(latency_s)
             self._recent.append(latency_s)
 
-    def set_gauge(self, name, value):
+    def set_gauge(self, name, value, labels=None):
         with self._lock:
-            self.gauges[name] = value
+            if labels:
+                self._labeled_gauges[(name, _label_key(labels))] = value
+            else:
+                self.gauges[name] = value
+
+    def gauge(self, name, default=0):
+        """Cheap point read of one gauge — the router's placement loop
+        polls these (queue_depth, slots_busy, kv_pages_free) without
+        paying a full snapshot."""
+        with self._lock:
+            return self.gauges.get(name, default)
+
+    def ewma(self, name, default=0.0):
+        """Point read of one EWMA (ttft / decode_step)."""
+        with self._lock:
+            return self.ewmas.get(name, default)
 
     def set_gauge_max(self, name, value):
         """High-water-mark gauge: keeps the largest value ever set —
@@ -179,12 +244,31 @@ class ServingMetrics:
                 else max(prev, value)
 
     # --------------------------------------------------------------- reading
+    @staticmethod
+    def _flat_key(name, label_key):
+        """JSON-safe key for a labeled sample: ``name{k="v",...}``."""
+        return "%s{%s}" % (name, ",".join(
+            '%s="%s"' % kv for kv in label_key))
+
     def snapshot(self):
-        """Plain-dict snapshot (JSON-safe) with latency percentiles."""
+        """Plain-dict snapshot (JSON-safe) with latency percentiles.
+        Labeled gauge/counter samples appear under their family dicts
+        as ``name{label="v"}`` keys; instance labels ride under
+        ``labels``."""
         with self._lock:
             recent = sorted(self._recent)
+            counters = dict(self.counters)
+            counters.update({self._flat_key(n, lk): v
+                             for (n, lk), v in
+                             self._labeled_counters.items()})
+            gauges = dict(self.gauges)
+            gauges.update({self._flat_key(n, lk): v
+                           for (n, lk), v in
+                           self._labeled_gauges.items()})
             return {
                 "name": self.name,
+                "labels": dict(self.labels),
+                "ewma": dict(self.ewmas),
                 "requests": self.requests,
                 "responses": self.responses,
                 "rejected": self.rejected,
@@ -200,16 +284,28 @@ class ServingMetrics:
                                 p99=_percentile(recent, 0.99)),
                 "ttft": self.ttft.snapshot(),
                 "decode_step": self.decode_step.snapshot(),
-                "counters": dict(self.counters),
-                "gauges": dict(self.gauges),
+                "counters": counters,
+                "gauges": gauges,
             }
+
+    def _label_str(self, extra=()):
+        """The full Prometheus label set for one sample line: the
+        engine name, this instance's constant labels (replica id), and
+        any per-sample ``extra`` pairs — escaped, deterministic
+        order."""
+        items = [("engine", self.name)] + sorted(self.labels.items()) \
+            + list(extra)
+        return "{%s}" % ",".join('%s="%s"' % (k, _escape_label(v))
+                                 for k, v in items)
 
     def _families(self):
         """[(family, kind, [sample lines])] — merged per family across
         engines by the renderers, so the exposition carries exactly ONE
         ``# TYPE`` line per metric family (strict parsers reject
-        duplicates)."""
-        label = '{engine="%s"}' % self.name
+        duplicates).  Labeled samples join the family of their base
+        name — replicas and per-replica router counters never fork a
+        second ``# TYPE`` line."""
+        label = self._label_str()
         fams = []
         with self._lock:
             for cname in ("requests", "responses", "rejected", "shed",
@@ -222,12 +318,20 @@ class ServingMetrics:
                 metric = "veles_serving_%s_total" % name
                 fams.append((metric, "counter",
                              ["%s%s %d" % (metric, label, value)]))
+            for (name, lkey), value in sorted(
+                    self._labeled_counters.items()):
+                metric = "veles_serving_%s_total" % name
+                fams.append((metric, "counter",
+                             ["%s%s %d" % (metric,
+                                           self._label_str(lkey),
+                                           value)]))
             for hname in ("queue_wait", "batch_size", "latency",
                           "ttft", "decode_step"):
                 hist = getattr(self, hname)
                 metric = "veles_serving_%s" % hname
-                lines = ['%s_bucket{engine="%s",le="%s"} %d'
-                         % (metric, self.name, bound, cum)
+                lines = ["%s_bucket%s %d"
+                         % (metric,
+                            self._label_str((("le", str(bound)),)), cum)
                          for bound, cum in zip(hist.bounds + ("+Inf",),
                                                hist._cum())]
                 lines.append("%s_sum%s %g" % (metric, label, hist.sum))
@@ -238,6 +342,13 @@ class ServingMetrics:
                 metric = "veles_serving_%s" % gname
                 fams.append((metric, "gauge",
                              ["%s%s %g" % (metric, label, value)]))
+            for (name, lkey), value in sorted(
+                    self._labeled_gauges.items()):
+                metric = "veles_serving_%s" % name
+                fams.append((metric, "gauge",
+                             ["%s%s %g" % (metric,
+                                           self._label_str(lkey),
+                                           value)]))
         return fams
 
     def render_prometheus(self):
@@ -250,11 +361,23 @@ _registry = {}
 _registry_lock = threading.Lock()
 
 
+def _registry_key(metrics):
+    """Registry identity: name + instance labels — replica instances
+    sharing a family name (``lm`` with ``replica="0"/"1"``) coexist;
+    a restarted engine with the same name AND labels replaces its
+    row."""
+    if not metrics.labels:
+        return metrics.name
+    return "%s{%s}" % (metrics.name, ",".join(
+        "%s=%s" % kv for kv in sorted(metrics.labels.items())))
+
+
 def register(metrics):
     """Make ``metrics`` visible to the global /metrics renderer (latest
-    instance wins per name — restarted engines replace their row)."""
+    instance wins per name+labels — restarted engines replace their
+    row)."""
     with _registry_lock:
-        _registry[metrics.name] = metrics
+        _registry[_registry_key(metrics)] = metrics
     return metrics
 
 
@@ -266,11 +389,12 @@ def get(name="serving"):
         return _registry[name]
 
 
-def new(name):
-    """A FRESH registered instance for ``name`` — engine starts use this
-    so a restarted server begins at zero instead of accumulating into
-    the previous run's counters (the old row is replaced)."""
-    return register(ServingMetrics(name))
+def new(name, labels=None):
+    """A FRESH registered instance for ``name`` (+ optional constant
+    ``labels``) — engine starts use this so a restarted server begins
+    at zero instead of accumulating into the previous run's counters
+    (the old row is replaced)."""
+    return register(ServingMetrics(name, labels=labels))
 
 
 def registered():

@@ -1,0 +1,404 @@
+"""Data-parallel LM serving — N engine replicas behind a metrics-driven
+router (ISSUE 8).
+
+Tensor parallelism (``LMEngine(tp=)``) scales ONE decode stream over a
+device mesh; this module adds the other serving axis: N INDEPENDENT
+engine replicas — each a full :class:`~veles_tpu.serving.LMEngine`,
+optionally TP-sharded over its own disjoint device slice — behind a
+:class:`Router` that places each admitted request on one replica.
+Replicas share nothing (no cross-replica KV, no shared queue), so
+aggregate decode throughput scales with replica count while the router
+keeps the serving contract intact:
+
+- PLACEMENT is driven by the replicas' live ``serving/metrics.py``
+  signals, nothing engine-internal: queue depth + busy lanes scaled by
+  the replica's decode-step EWMA (its measured pace, not its nominal
+  one), the TTFT EWMA as the queueing penalty, and resident-KV-page
+  pressure on paged pools.  Ties (an idle fleet) break by fewest
+  requests routed, so cold traffic spreads evenly instead of piling
+  on replica 0.  ``policy="round_robin"`` ignores the signals — the
+  skew-measurement baseline ``tools/load_gen.py`` reads against.
+- ADMISSION semantics are unchanged: the router tries replicas in
+  placement order and re-raises the engines' own
+  :class:`~veles_tpu.serving.batcher.Overloaded` /
+  :class:`~veles_tpu.serving.batcher.PoolExhausted` only when EVERY
+  live replica refused (HTTP 429 upstream, same as one engine);
+  deadline sheds (503) and client errors (ValueError → 400) pass
+  through untouched.  A single replica degenerates to exactly today's
+  one-engine path — same outputs, same errors.
+- A SICK replica HOT-UNREGISTERS (:meth:`Router.unregister`): it
+  leaves the placement rotation immediately and every request the
+  router still has pending on it — queued or mid-decode — is
+  withdrawn and REQUEUED on the surviving replicas.  A request is
+  completed exactly once: a requeue only fires for work the drain
+  itself interrupted (cancelled, or returned short), never for a
+  result that arrived whole, and never for engine-level failures on a
+  healthy replica (those keep their fault-isolation contract and fail
+  to the client).  Requests never wedge: when no live replica can
+  take a requeued request, its future fails loudly.
+
+The router's own :class:`ServingMetrics` meters placement
+(``routed_requests{replica="i"}`` labeled counters, ``requeued``,
+rejected), and each replica's engine metrics register under one
+family name with a ``{replica="i"}`` label — ``/metrics`` renders one
+``# TYPE`` line per family with one row per replica, and
+``/metrics.json`` (via :class:`RouterMetrics`) embeds every replica's
+snapshot under ``"replicas"``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy
+
+from veles_tpu.logger import Logger
+from veles_tpu.serving.batcher import Overloaded
+from veles_tpu.serving.metrics import ServingMetrics
+
+
+def replica_device_slices(replicas, tp, devices=None):
+    """The device slice each replica owns: replica ``i`` gets devices
+    ``[i*tp, (i+1)*tp)`` when tensor-parallel (validated against the
+    host's device count up front), one device round-robin otherwise.
+    THE one replica→devices mapping — ``serve_lm`` and
+    ``tools/lm_bench.py`` both consume it, so the bench measures the
+    placement the server actually ships."""
+    import jax
+    devices = list(devices if devices is not None else jax.devices())
+    n_rep = max(1, int(replicas))
+    tp_n = int(tp or 0)
+    if tp_n >= 2:
+        if n_rep * tp_n > len(devices):
+            raise ValueError(
+                "replicas=%d × tp=%d needs %d devices, have %d"
+                % (n_rep, tp_n, n_rep * tp_n, len(devices)))
+        return [devices[i * tp_n:(i + 1) * tp_n] for i in range(n_rep)]
+    return [[devices[i % len(devices)]] for i in range(n_rep)]
+
+
+class RouterMetrics(ServingMetrics):
+    """Router-owned metrics whose ``snapshot()`` additionally embeds
+    each replica engine's snapshot under ``"replicas"`` — one
+    ``/metrics.json`` fetch covers the whole fleet."""
+
+    def __init__(self, name="lm_router", labels=None):
+        super().__init__(name, labels=labels)
+        self._router = None
+
+    def snapshot(self):
+        snap = super().snapshot()
+        router = self._router
+        if router is not None:
+            snap["replicas"] = [e.metrics.snapshot()
+                                for e in router.replicas]
+        return snap
+
+
+class _Job:
+    """One routed request: the client-facing future plus the live
+    engine-side placement it currently rides on."""
+
+    __slots__ = ("prompt", "n_new", "future", "t0", "replica",
+                 "engine_future", "requeue", "attempts")
+
+    def __init__(self, prompt, n_new):
+        self.prompt = prompt
+        self.n_new = int(n_new)
+        self.future = Future()
+        self.future.job = self          # router-level cancellation handle
+        self.t0 = time.monotonic()
+        self.replica = None
+        self.engine_future = None
+        #: set by unregister() right before it withdraws the engine-side
+        #: request: tells the completion callback that a cancellation or
+        #: short result is drain fallout to REPLACE, not a client event
+        self.requeue = False
+        self.attempts = 0
+
+
+class Router(Logger):
+    """Place requests on ``replicas`` (started/stopped together) by
+    their live metrics; see the module docstring for the contract."""
+
+    POLICIES = ("metrics", "round_robin")
+
+    def __init__(self, replicas, metrics=None, name="lm_router",
+                 policy="metrics"):
+        replicas = list(replicas)
+        if not replicas:
+            raise ValueError("router needs at least one replica")
+        if policy not in self.POLICIES:
+            raise ValueError("unknown router policy %r (one of %r)"
+                             % (policy, self.POLICIES))
+        self.name = name
+        self.replicas = replicas
+        self.policy = policy
+        self.metrics = metrics or ServingMetrics(name)
+        if isinstance(self.metrics, RouterMetrics):
+            self.metrics._router = self
+        self._live = [True] * len(replicas)
+        self._routed = [0] * len(replicas)
+        self._pending = [set() for _ in replicas]
+        self._lock = threading.Lock()
+        self._rr = 0
+        self._stopping = False
+        self.metrics.set_gauge("replicas_total", len(replicas))
+        self.metrics.set_gauge("replicas_live", len(replicas))
+
+    # ----------------------------------------------------------- properties
+    @property
+    def spec_k(self):
+        """Speculation headroom upstream admission must reserve — the
+        replicas share a config, but take the max so a heterogeneous
+        fleet still reserves enough for any placement."""
+        return max(e.spec_k for e in self.replicas)
+
+    @property
+    def max_len(self):
+        return min(e.max_len for e in self.replicas)
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self):
+        for e in self.replicas:
+            e.start()
+        return self
+
+    def stop(self):
+        with self._lock:
+            self._stopping = True
+        for e in self.replicas:
+            e.stop()
+
+    # ------------------------------------------------------------ placement
+    def _score(self, i):
+        """Smaller = place here.  Everything read from the replica's
+        live ServingMetrics: outstanding work (queue depth + busy
+        lanes) scaled by the replica's measured decode-step EWMA (a
+        slow replica's queue costs more wall than a fast one's), the
+        TTFT EWMA weighted by queue depth (the queueing penalty new
+        arrivals actually feel), and fractional resident-KV-page
+        pressure as the paged-pool tiebreak."""
+        m = self.replicas[i].metrics
+        depth = m.gauge("queue_depth", 0) + m.gauge("slots_busy", 0)
+        step = m.ewma("decode_step", 0.0) or 1e-4
+        score = depth * step + m.ewma("ttft", 0.0) * m.gauge(
+            "queue_depth", 0)
+        kv_total = m.gauge("kv_pages_total", 0)
+        if kv_total:
+            score += (1.0 - m.gauge("kv_pages_free", kv_total)
+                      / kv_total) * step
+        return score
+
+    def _order(self):
+        """Live replica indices, best placement first."""
+        with self._lock:
+            live = [i for i, ok in enumerate(self._live) if ok]
+            if self.policy == "round_robin":
+                self._rr += 1
+                start = self._rr
+            routed = list(self._routed)
+        if not live:
+            raise RuntimeError("router has no live replicas")
+        if self.policy == "round_robin":
+            return [live[(start + j) % len(live)]
+                    for j in range(len(live))]
+        return sorted(live, key=lambda i: (self._score(i), routed[i], i))
+
+    def submit(self, prompt, n_new):
+        """Queue one prompt on the best replica; returns a Future for
+        the (n_new,) greedy continuation.  Raises exactly what one
+        engine would: ValueError for client errors, Overloaded /
+        PoolExhausted when every live replica refuses admission."""
+        job = _Job(prompt, int(n_new))
+        self._place(job)
+        return job.future
+
+    def _place(self, job):
+        last_exc = None
+        for i in self._order():
+            engine = self.replicas[i]
+            with self._lock:
+                if not self._live[i]:
+                    continue
+            try:
+                f = engine.submit(job.prompt, job.n_new)
+            except Overloaded as exc:
+                # queue/pool pressure on this replica: the next-best
+                # may still have room (ValueError — a client error —
+                # propagates immediately: it is identical on every
+                # replica of a homogeneous fleet)
+                last_exc = exc
+                continue
+            job.replica = i
+            job.engine_future = f
+            with self._lock:
+                # re-check liveness at COMMIT: a drain that ran between
+                # the pre-submit check and here already snapshotted
+                # _pending[i] without this job, so committing would
+                # strand it on the drained replica — withdraw and keep
+                # looking instead
+                stale = not self._live[i]
+                if not stale:
+                    self._pending[i].add(job)
+                    self._routed[i] += 1
+            if stale:
+                engine._cancel(f.request)
+                job.engine_future = None
+                job.replica = None
+                continue
+            self.metrics.record_enqueue()
+            self.metrics.inc("routed_requests",
+                             labels={"replica": str(i)})
+            f.add_done_callback(
+                lambda f, job=job, i=i: self._on_engine_done(job, i, f))
+            return
+        self.metrics.record_reject()
+        raise last_exc if last_exc is not None else Overloaded()
+
+    # ----------------------------------------------------------- completion
+    def _on_engine_done(self, job, i, engine_future):
+        """Runs on the replica's worker (or canceller) thread when the
+        engine-side future settles.  Exactly-once delivery: the
+        router future is resolved here and only here, and a requeue
+        fires only for drain fallout (see _Job.requeue)."""
+        with self._lock:
+            self._pending[i].discard(job)
+            live = self._live[i]
+            stopping = self._stopping
+        if job.future.done():            # withdrawn at the router level
+            return
+        requeue = job.requeue and not stopping
+        if engine_future.cancelled():
+            # withdrawn before any decode: drain fallout replaces it,
+            # a router-level cancellation stays cancelled
+            if requeue:
+                self._replace(job)
+            else:
+                job.future.cancel()
+            return
+        exc = engine_future.exception()
+        if exc is not None:
+            from veles_tpu.serving.batcher import DeadlineExceeded
+            if (requeue or not live) and not stopping \
+                    and not isinstance(exc, (Overloaded,
+                                             DeadlineExceeded)):
+                # in-flight work dying WITH its drained/sick replica
+                # (engine stopped, poisoned step) is the router's
+                # problem; on a live replica the engine's
+                # fault-isolation contract stands and the client sees
+                # the fault
+                self._replace(job)
+                return
+            job.future.set_exception(exc)
+            return
+        result = engine_future.result()
+        if requeue and len(result) < job.n_new:
+            # the drain interrupted this lane mid-decode: the engine
+            # resolved it with the tokens it had (its cancellation
+            # path) — rerun the request whole on a live replica
+            self._replace(job)
+            return
+        self.metrics.record_response(time.monotonic() - job.t0)
+        job.future.set_result(result)
+
+    def _replace(self, job):
+        """Re-place a drain-interrupted job on the surviving replicas —
+        or fail it loudly when none can take it (never wedge)."""
+        if job.future.done():
+            # raced a router-level cancellation (generate() sibling
+            # withdrawal): nobody reads this result — do not spend a
+            # healthy replica's slots rerunning it
+            return
+        job.requeue = False
+        job.attempts += 1
+        self.metrics.inc("requeued_requests")
+        if job.attempts > len(self.replicas) + 1:
+            job.future.set_exception(RuntimeError(
+                "request could not be re-placed after %d drain retries"
+                % job.attempts))
+            return
+        try:
+            self._place(job)
+        except Exception as exc:   # noqa: BLE001 — delivered, not raised
+            if not job.future.done():
+                job.future.set_exception(exc)
+
+    # --------------------------------------------------------------- client
+    def generate(self, prompts, n_new, return_replicas=False):
+        """Decode a (b, s) prompt batch across the fleet; returns
+        (b, s + n_new) int32 (and, with ``return_replicas``, the
+        replica index that served each row).  All-or-nothing sibling
+        cancellation, exactly like ``LMEngine.generate``."""
+        prompts = numpy.asarray(prompts, numpy.int32)
+        futures = []
+        try:
+            for row in prompts:
+                futures.append(self.submit(row, n_new))
+            news = numpy.stack([f.result() for f in futures])
+        except Exception:
+            for f in futures:
+                self.cancel(f)
+            raise
+        out = numpy.concatenate([prompts, news], axis=1)
+        if return_replicas:
+            return out, [f.job.replica for f in futures]
+        return out
+
+    def cancel(self, future):
+        """Withdraw a routed request (sibling cancellation): the
+        engine-side request is cancelled and the router future will
+        NOT be re-placed."""
+        job = future.job
+        job.requeue = False
+        with self._lock:
+            engine_future = job.engine_future
+            i = job.replica
+        if engine_future is not None:
+            self.replicas[i]._cancel(engine_future.request)
+        future.cancel()
+
+    # ---------------------------------------------------------------- drain
+    def unregister(self, i, reason="sick"):
+        """Hot-unregister replica ``i``: it leaves the placement
+        rotation NOW, and every request the router still has pending
+        on it is withdrawn and re-placed on the surviving replicas
+        (queued requests requeue unserved; a mid-decode lane is
+        cancelled and its request reruns whole elsewhere — no loss,
+        no duplicate completion).  The engine itself keeps running —
+        the caller decides whether to stop or restart it; re-admit
+        with :meth:`reregister`.  Returns the number of requests
+        withdrawn."""
+        with self._lock:
+            if not self._live[i]:
+                return 0
+            self._live[i] = False
+            jobs = list(self._pending[i])
+            live_now = sum(1 for ok in self._live if ok)
+        self.metrics.set_gauge("replicas_live", live_now)
+        self.metrics.inc("replica_drains")
+        self.warning("draining replica %d (%s): re-placing %d pending "
+                     "request(s) on %d live replica(s)",
+                     i, reason, len(jobs), live_now)
+        engine = self.replicas[i]
+        for job in jobs:
+            job.requeue = True
+            engine._cancel(job.engine_future.request)
+        return len(jobs)
+
+    def reregister(self, i):
+        """Return a drained replica to the placement rotation (after a
+        restart or recovery)."""
+        with self._lock:
+            self._live[i] = True
+            live_now = sum(1 for ok in self._live if ok)
+        self.metrics.set_gauge("replicas_live", live_now)
+
+    # ------------------------------------------------------------- evidence
+    def routed_counts(self):
+        """Requests placed per replica (including requeues) — the
+        server-side balance evidence the bench records."""
+        with self._lock:
+            return list(self._routed)
