@@ -272,6 +272,89 @@ class TestEngineLifecycle:
         finally:
             engine.stop()
 
+    def test_mid_prefill_faults_leak_no_pages(self):
+        """ISSUE 10 satellite: injected mid-prefill dispatch failures
+        (the engine.chunk site) across several shared-prefix requests
+        — every faulted request fails alone, the survivors stay
+        exactly greedy, and afterwards the pool returns to baseline
+        with zero orphan trie pins and the allocator invariants
+        intact."""
+        import jax.numpy as jnp
+        from veles_tpu.ops.transformer import generate
+        from veles_tpu.serving import FaultPlan, InjectedFault, LMEngine
+        params = _params()
+        rng = numpy.random.RandomState(3)
+        shared = rng.randint(0, 16, 16).tolist()     # 2 full chunks
+        prompts = [shared + rng.randint(0, 16, 1 + i).tolist()
+                   for i in range(6)]
+        expected = [numpy.asarray(generate(
+            params, jnp.asarray([p], jnp.int32), 4, 2,
+            temperature=0.0, max_len=96))[0] for p in prompts]
+        # every 3rd chunk dispatch faults — mid-prefill, because these
+        # prompts are almost all prefill chunks
+        plan = FaultPlan().arm("engine.chunk", every=3)
+        engine = LMEngine(params, n_heads=2, max_len=96, slots=2,
+                          paged_kv=True, prefill_chunk=8,
+                          prefix_cache=16, name="kv_fault",
+                          faults=plan).start()
+        try:
+            futures = [engine.submit(p, 4) for p in prompts]
+            failed = ok = 0
+            for p, f, exp in zip(prompts, futures, expected):
+                try:
+                    out = f.result(timeout=60)
+                    numpy.testing.assert_array_equal(
+                        numpy.concatenate([p, out]), exp)
+                    ok += 1
+                except InjectedFault:
+                    failed += 1
+            assert failed > 0 and ok > 0     # both paths exercised
+            assert plan.fired("engine.chunk") >= failed
+            # leak-freedom: no lane active, no orphan pins, and once
+            # the trie is pressed empty the pool refills WHOLE
+            assert engine._pool.pinned_pages == 0
+            assert engine._trie.live_pins() == 0
+            engine.verify_pool_invariants()
+            while engine._trie.evict_one():
+                pass
+            assert engine._pool.free_pages == engine._pool.num_pages
+        finally:
+            engine.stop()
+
+    def test_mid_cow_fault_releases_orphan_page(self):
+        """ISSUE 10 satellite: a faulted copy-on-write dispatch (the
+        engine.cow site fires inside the page-copy try) releases the
+        just-allocated destination page instead of leaking it, and
+        the shared source page's bookkeeping is untouched."""
+        from veles_tpu.serving import FaultPlan, InjectedFault, LMEngine
+        from veles_tpu.serving.lm_engine import _Request, _Slot
+        params = _params()
+        plan = FaultPlan().arm("engine.cow", times=1)
+        engine = LMEngine(params, n_heads=2, max_len=96, slots=1,
+                          paged_kv=4, prefill_chunk=8, name="kv_cowf",
+                          faults=plan)
+        pool = engine._pool
+        (p,) = pool.alloc(1)
+        pool.retain(p)                   # the sibling's reference
+        pool.pin(p)                      # this lane's pin
+        lane = _Slot(_Request([1, 2, 3], 4, 30.0, pages=1))
+        lane.pages = [p]
+        engine._page_tables[0, 0] = p
+        free_before = pool.free_pages
+        with pytest.raises(InjectedFault):
+            engine._cow_guard(0, lane, 0, 1)
+        # the orphan destination went back; the shared page still has
+        # both referents and the lane's pin — nothing leaked or lost
+        assert pool.free_pages == free_before
+        assert pool.refs(p) == 2 and pool.pinned(p)
+        assert engine.metrics.counter("kv_cow_copies") == 0
+        # disarmed, the same write now copies cleanly
+        plan.disarm()
+        engine._cow_guard(0, lane, 0, 1)
+        q = lane.pages[0]
+        assert q != p and pool.refs(q) == 1 and pool.pinned(q)
+        assert engine.metrics.counter("kv_cow_copies") == 1
+
     def test_pool_exhaustion_sheds_503_never_hangs(self):
         """A request queued on pool pressure whose pages never free in
         time sheds DeadlineExceeded (503) at its deadline — it does not

@@ -967,6 +967,409 @@ class TestRouter:
             Router([engine], policy="fastest")
 
 
+class TestFaultPlan:
+    """ISSUE 10: the deterministic fault-injection layer — pure host
+    logic, no engines."""
+
+    def test_deterministic_call_sites(self):
+        from veles_tpu.serving import FaultPlan, InjectedFault
+        plan = FaultPlan().arm("engine.step", calls={2, 4})
+        fired = []
+        for _ in range(5):
+            try:
+                plan.fire("engine.step")
+                fired.append(False)
+            except InjectedFault:
+                fired.append(True)
+        assert fired == [False, True, False, True, False]
+        assert plan.calls("engine.step") == 5
+        assert plan.fired("engine.step") == 2
+
+    def test_every_after_times_conditions(self):
+        from veles_tpu.serving import FaultPlan, InjectedFault
+        plan = FaultPlan().arm("s", every=3, after=3, times=2)
+        hits = []
+        for n in range(1, 13):
+            try:
+                plan.fire("s")
+            except InjectedFault:
+                hits.append(n)
+        assert hits == [6, 9]          # every 3rd AND after 3, twice
+
+    def test_seeded_prob_is_reproducible(self):
+        from veles_tpu.serving import FaultPlan, InjectedFault
+
+        def run(seed):
+            plan = FaultPlan(seed=seed).arm("s", prob=0.5)
+            out = []
+            for _ in range(32):
+                try:
+                    plan.fire("s")
+                    out.append(0)
+                except InjectedFault:
+                    out.append(1)
+            return out
+
+        assert run(7) == run(7)
+        assert run(7) != run(8)        # astronomically unlikely equal
+
+    def test_disarm_and_named_exceptions(self):
+        from veles_tpu.serving import FaultPlan, Overloaded
+        plan = FaultPlan().arm("s", exc="Overloaded")
+        with pytest.raises(Overloaded):
+            plan.fire("s")
+        plan.disarm("s")
+        plan.fire("s")                 # no-op again
+        with pytest.raises(ValueError, match="unknown fault"):
+            FaultPlan().arm("s", exc="NoSuchError")
+        with pytest.raises(ValueError, match="kind"):
+            FaultPlan().arm("s", kind="explode")
+
+    def test_json_spec(self):
+        from veles_tpu.serving import FaultPlan, InjectedHTTPError
+        plan = FaultPlan.from_spec({"seed": 3, "sites": [
+            {"site": "http.request", "kind": "error", "exc": "http_503",
+             "calls": [1]}]})
+        with pytest.raises(InjectedHTTPError) as err:
+            plan.fire("http.request")
+        assert err.value.code == 503
+        plan.fire("http.request")      # call 2: unarmed
+
+    def test_freeze_releases(self):
+        from veles_tpu.serving import FaultPlan
+        plan = FaultPlan().arm("s", kind="freeze", duration_s=60.0)
+        t = threading.Thread(target=plan.fire, args=("s",))
+        t.start()
+        time.sleep(0.05)
+        assert t.is_alive()            # frozen
+        plan.release()
+        t.join(timeout=10)
+        assert not t.is_alive()
+        plan.fire("s")                 # released plans never freeze
+
+    def test_batcher_dispatch_site_wired_through_enable_batching(self):
+        """The batcher.* sites arm through RESTfulAPI(faults=) →
+        enable_batching: an injected dispatch fault fails its batch's
+        clients (500) through the real fault-isolation path, and the
+        worker keeps serving."""
+        from veles_tpu.restful_api import RESTfulAPI
+        from veles_tpu.serving import FaultPlan, ServingMetrics
+        plan = FaultPlan().arm("batcher.dispatch", calls={1})
+        api = RESTfulAPI(None, forward=lambda x: x * 2.0, faults=plan)
+        api.enable_batching(max_batch=4, sample_shape=(2,),
+                            metrics=ServingMetrics("bf_t"))
+        api.start(port=0)
+        try:
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _post(api.port, {"input": [[1.0, 2.0]]})
+            assert err.value.code == 500
+            assert "injected" in json.loads(err.value.read())["error"]
+            out = _post(api.port, {"input": [[3.0, 4.0]]})
+            assert out["output"][0] == [6.0, 8.0]   # worker survived
+            assert plan.fired("batcher.dispatch") == 1
+        finally:
+            api.stop()
+
+
+class TestResilience:
+    """ISSUE 10: retry/backoff, hedging, health circuit breaker — the
+    router-level resilience layer over injected faults."""
+
+    def _expected(self, params, prompts, n_new, max_len=48):
+        import jax.numpy as jnp
+        from veles_tpu.ops.transformer import generate
+        return [numpy.asarray(generate(
+            params, jnp.asarray([p], jnp.int32), n_new, 2,
+            temperature=0.0, max_len=max_len))[0] for p in prompts]
+
+    def _replicas(self, params, plans, **kw):
+        import jax
+        from veles_tpu.serving import LMEngine, ServingMetrics
+        devs = jax.devices()
+        return [LMEngine(params, n_heads=2, max_len=48,
+                         devices=[devs[i % len(devs)]],
+                         name="rs_r%d" % i, faults=plan,
+                         metrics=ServingMetrics(
+                             "rs", labels={"replica": str(i)}), **kw)
+                for i, plan in enumerate(plans)]
+
+    def test_retry_replaces_faulted_request_on_other_replica(self):
+        """An engine FAULT on a live replica re-places the request
+        whole on the other replica (requests_retried metered), and
+        the delivered tokens are exactly greedy — idempotent because
+        replicas are bit-identical."""
+        from veles_tpu.serving import FaultPlan, Router
+        params = _tiny_params()
+        plan = FaultPlan().arm("engine.step", times=20)
+        replicas = self._replicas(params, [plan, None], slots=2)
+        router = Router(replicas, retries=2,
+                        retry_backoff_s=0.01).start()
+        try:
+            [exp] = self._expected(params, [[1, 2, 3]], 6)
+            fut = router.submit([1, 2, 3], 6)
+            out = fut.result(timeout=60)
+            numpy.testing.assert_array_equal(
+                numpy.concatenate([[1, 2, 3], out]), exp)
+            assert fut.job.replica == 1          # served by the healthy one
+            retried = router.metrics.counter("requests_retried")
+            assert retried >= 1
+            # budget exhaustion on the SAME fleet: with BOTH replicas
+            # now faulting, retries run out and the client sees the
+            # injected fault — bounded, never an infinite retry loop
+            from veles_tpu.serving import InjectedFault
+            replicas[1]._faults = FaultPlan().arm("engine.step",
+                                                  times=100)
+            fut = router.submit([1, 2, 3], 6)
+            with pytest.raises(InjectedFault):
+                fut.result(timeout=60)
+            assert router.metrics.counter("requests_retried") \
+                == retried + 2
+        finally:
+            router.stop()
+
+    def test_hedge_wins_on_slow_replica(self):
+        """A request stuck on the injected-latency replica hedges onto
+        the fast one past the threshold; the hedge wins, output stays
+        exactly greedy, and the loser is cancelled (not delivered)."""
+        from veles_tpu.serving import FaultPlan, Router
+        params = _tiny_params()
+        plan = FaultPlan().arm("engine.step", kind="latency",
+                               latency_s=0.2)
+        replicas = self._replicas(params, [plan, None], slots=2)
+        router = Router(replicas, hedge_after_s=0.15).start()
+        try:
+            prompts = [[1, 2, 3], [2, 4, 6]]
+            expected = self._expected(params, prompts, 6)
+            futures = [router.submit(p, 6) for p in prompts]
+            for p, f, exp in zip(prompts, futures, expected):
+                out = f.result(timeout=60)
+                numpy.testing.assert_array_equal(
+                    numpy.concatenate([p, out]), exp)
+            m = router.metrics
+            assert m.counter("requests_hedged") >= 1
+            assert m.counter("hedge_wins") >= 1
+        finally:
+            router.stop()
+
+    def test_health_checker_quarantines_and_recovers(self):
+        """The full circuit-breaker cycle, driven synchronously: a
+        frozen replica is quarantined through the drain path (its
+        pending work completes on the survivor), and after the
+        cooldown the half-open probe re-registers it."""
+        from veles_tpu.serving import (FaultPlan, HealthChecker,
+                                       Router)
+        params = _tiny_params()
+        plan = FaultPlan().arm("engine.tick", kind="freeze", after=2,
+                               times=1, duration_s=60.0)
+        replicas = self._replicas(params, [plan, None], slots=2)
+        router = Router(replicas, drain_timeout_s=0.3).start()
+        checker = HealthChecker(router, interval_s=0.05,
+                                probe_timeout_s=2.0, fail_threshold=2,
+                                cooldown_s=0.2, stall_s=0.25)
+        try:
+            futures = [router.submit([1 + i, 2, 3], 6)
+                       for i in range(6)]
+            deadline = time.monotonic() + 30
+            while router._live[0] and time.monotonic() < deadline:
+                checker.step()
+                time.sleep(0.05)
+            assert not router._live[0]            # quarantined
+            assert checker.states()[0] == HealthChecker.OPEN
+            assert router.metrics.counter("circuit_open_total") == 1
+            for f in futures:                     # no loss, no wedge
+                assert len(f.result(timeout=60)) == 6
+            # thaw; after the cooldown the half-open probe re-admits
+            plan.release()
+            time.sleep(0.25)
+            deadline = time.monotonic() + 30
+            while not router._live[0] \
+                    and time.monotonic() < deadline:
+                checker.step()
+                time.sleep(0.05)
+            assert router._live[0]
+            assert checker.states()[0] == HealthChecker.HEALTHY
+            snap = router.metrics.snapshot()
+            assert snap["gauges"][
+                'replica_health_state{replica="0"}'] == 0
+            # the recovered replica serves again
+            out = router.submit([1, 2, 3], 4).result(timeout=60)
+            assert len(out) == 4
+        finally:
+            plan.release()
+            checker.stop()
+            router.stop()
+
+    def test_429_retry_after_is_minimum_over_replicas(self):
+        """Satellite: when every replica refuses, the surfaced
+        Retry-After is the MINIMUM over the refusing replicas — the
+        client may return as soon as the soonest one frees."""
+        from veles_tpu.serving import LMEngine, Overloaded, Router
+        params = _tiny_params()
+        engines = [LMEngine(params, n_heads=2, max_len=48, slots=1,
+                            name="ra_r%d" % i) for i in range(2)]
+
+        def refuse(ra):
+            def submit(prompt, n_new):
+                raise Overloaded(retry_after=ra)
+            return submit
+
+        engines[0].submit = refuse(0.7)
+        engines[1].submit = refuse(0.3)
+        router = Router(engines)
+        with pytest.raises(Overloaded) as err:
+            router.submit([1, 2, 3], 4)
+        assert err.value.retry_after == pytest.approx(0.3)
+
+    def test_no_live_replicas_is_retryable_429(self):
+        """A fully-quarantined fleet is a TRANSIENT condition: submit
+        surfaces the Overloaded subclass NoLiveReplicas (429 +
+        Retry-After upstream), never a bare 500-class error."""
+        from veles_tpu.serving import (LMEngine, NoLiveReplicas,
+                                       Overloaded, Router)
+        params = _tiny_params()
+        engine = LMEngine(params, n_heads=2, max_len=48, slots=1,
+                          name="nl_r0")
+        router = Router([engine])
+        router.unregister(0, reason="test: full-fleet circuit open")
+        with pytest.raises(NoLiveReplicas) as err:
+            router.submit([1, 2, 3], 4)
+        assert isinstance(err.value, Overloaded)
+        assert err.value.retry_after > 0
+
+    def test_checkpoint_restore_after_simulated_crash(self):
+        """Kill-and-restore: a paged engine freezes mid-traffic, its
+        checkpoint re-admits the journaled work on a FRESH engine
+        (allocator invariants verified first), resumed outputs are
+        bit-identical to greedy generate, the pool ends leak-free,
+        and new traffic serves with unchanged parity."""
+        import jax.numpy as jnp
+        from veles_tpu.ops.transformer import generate
+        from veles_tpu.serving import FaultPlan, LMEngine
+        params = _tiny_params(max_len=64)
+        prompts = [[1, 2, 3], [2, 4, 6, 8, 10], [5, 1, 5, 1, 5]]
+        expected = [numpy.asarray(generate(
+            params, jnp.asarray([p], jnp.int32), 5, 2,
+            temperature=0.0, max_len=64))[0] for p in prompts]
+        plan = FaultPlan().arm("engine.tick", kind="freeze", after=2,
+                               duration_s=60.0)
+        crashed = LMEngine(params, n_heads=2, max_len=64, slots=2,
+                           paged_kv=8, prefill_chunk=8,
+                           prefix_cache=8, name="crash",
+                           faults=plan).start()
+        try:
+            for p in prompts:
+                crashed.submit(p, 5)
+            time.sleep(0.2)                  # wedged mid-flight
+            state = crashed.checkpoint()
+            assert len(state["requests"]) == 3
+            json.dumps(state)                # JSON-safe by contract
+            fresh = LMEngine(params, n_heads=2, max_len=64, slots=2,
+                             paged_kv=8, prefill_chunk=8,
+                             prefix_cache=8, name="fresh").start()
+            try:
+                restored = fresh.restore(state)
+                assert len(restored) == 3
+                outs = [restored[e["rid"]].result(timeout=60)
+                        for e in state["requests"]]
+                for p, out, exp in zip(prompts, outs, expected):
+                    numpy.testing.assert_array_equal(
+                        numpy.concatenate([p, out]), exp)
+                # leak-free: drain the trie, the pool refills whole
+                while fresh._trie.evict_one():
+                    pass
+                inv = fresh.verify_pool_invariants()
+                assert inv["free_pages"] == fresh._pool.num_pages
+                assert fresh._trie.live_pins() == 0
+                # new traffic, unchanged parity
+                out = fresh.generate(numpy.asarray([prompts[0]]), 5)
+                numpy.testing.assert_array_equal(out[0], expected[0])
+                assert fresh.metrics.counter("engine_restores") == 1
+            finally:
+                fresh.stop()
+        finally:
+            plan.release()
+            crashed.stop()
+
+    def test_restore_refuses_garbage_and_oversized(self):
+        from veles_tpu.serving import LMEngine
+        params = _tiny_params()
+        engine = LMEngine(params, n_heads=2, max_len=48, slots=1,
+                          name="rg")
+        with pytest.raises(ValueError, match="format"):
+            engine.restore({"format": 99})
+        with pytest.raises(ValueError, match="max_len"):
+            engine.restore({"format": 1, "config": {"max_len": 4096},
+                            "requests": []})
+        # all-or-nothing geometry check: a journaled request the
+        # restoring pool can NEVER place refuses up front, before any
+        # sibling entry is re-admitted
+        paged = LMEngine(params, n_heads=2, max_len=48, slots=1,
+                         paged_kv=2, prefill_chunk=8, name="rg_p")
+        with pytest.raises(ValueError, match="KV pages"):
+            paged.restore({"format": 1, "config": {"max_len": 48},
+                           "requests": [
+                               {"rid": 1, "prompt": [1, 2], "n_new": 2},
+                               {"rid": 2, "prompt": list(range(30)),
+                                "n_new": 10}]})
+
+
+class TestInjectedHTTPFaults:
+    """ISSUE 10: the http.request site serves structured transient
+    errors, and load_gen's failure classes (satellite) split them from
+    real errors."""
+
+    def test_injected_503_is_structured_and_classified(self):
+        from veles_tpu.restful_api import RESTfulAPI
+        from veles_tpu.serving import FaultPlan, ServingMetrics
+        plan = FaultPlan().arm("http.request", exc="http_503",
+                               every=2)
+        api = RESTfulAPI(None, forward=lambda x: x * 2.0, faults=plan)
+        api.metrics = ServingMetrics("httpf_t")
+        api.start(port=0)
+        try:
+            summary = run_load(
+                "http://127.0.0.1:%d/predict" % api.port,
+                payload={"input": [[1.0, 2.0]]}, clients=1,
+                requests_per_client=6)
+            assert summary["sent"] == 6
+            # every 2nd request got the injected 503 (Retry-After set),
+            # the rest served — and the failure CLASSES split them
+            assert summary["failures"]["http_503"] == 3
+            assert summary["failures"]["timeout"] == 0
+            assert summary["failures"]["connection"] == 0
+            assert summary["shed_not_errored"] is True
+            assert summary["ok"] == 3
+        finally:
+            api.stop()
+
+    def test_connection_failure_class(self):
+        """A dead endpoint lands in the 'connection' class — chaos
+        runs can tell a refused socket from a graceful shed."""
+        import socket
+        sock = socket.socket()
+        sock.bind(("127.0.0.1", 0))
+        port = sock.getsockname()[1]
+        sock.close()                       # nothing listens here now
+        summary = run_load("http://127.0.0.1:%d/predict" % port,
+                           payload={"input": [[0.0]]}, clients=1,
+                           requests_per_client=1, timeout=2)
+        assert summary["failures"]["connection"] == 1
+        assert summary["shed_not_errored"] is False
+
+
+class TestChaosSmoke:
+    def test_chaos_smoke_kill_one_replica(self):
+        """Satellite: the <60s chaos-smoke subset runs tier-1 so the
+        fault-injection plumbing and the quarantine/drain/exactly-once
+        contract cannot rot between TPU sessions."""
+        from chaos_smoke import run_smoke
+        record = run_smoke()
+        assert record["completed_exactly_once"] == record["requests"]
+        assert record["parity_vs_generate"] is True
+        assert record["replica0_quarantined"] is True
+        assert record["smoke_wall_s"] < 60
+
+
 @pytest.mark.slow
 class TestSustainedLoad:
     def test_sustained_qps_with_histograms(self):

@@ -1,0 +1,452 @@
+"""Chaos bench (ISSUE 10): the serving resilience layer under
+deterministic injected faults.
+
+Four scenarios, each driven by a seeded
+``veles_tpu/serving/faults.py::FaultPlan`` so a given run always
+injects at the same dispatches:
+
+- ``kill_one_replica_under_load`` — replica 0's worker FREEZES
+  mid-traffic (the wedged-device shape).  The health checker's
+  staleness watch quarantines it through the router's drain path,
+  drained work re-places (wedged mid-decode lanes force-replace after
+  the drain timeout), and EVERY admitted request completes exactly
+  once with output bit-identical to ``transformer.generate`` — no
+  loss, no duplicate, no wedge.
+- ``slow_replica_tail`` — replica 0 pays an injected per-dispatch
+  latency spike.  The same workload runs hedging OFF then ON:
+  requests outstanding past the hedge threshold duplicate onto the
+  fast replica, first complete wins (parity unchanged), and the
+  record carries both latency distributions plus the
+  ``requests_hedged`` / ``hedge_wins`` evidence.
+- ``pool_exhaustion_storm`` — a page-pool flood (many concurrent
+  mixed-length requests against a tiny pool) plus injected admission
+  storms.  Every request either completes exactly greedy or sheds as
+  429/PoolExhausted/503 — never another error class, never a hang —
+  and afterwards the pool drains back to FULL with allocator
+  invariants re-verified (leak-freedom).
+- ``fault_free_overhead`` — the acceptance leg for "unarmed is
+  free": measures the per-call cost of an UNARMED fault hook and the
+  health checker's per-scan cost, expresses both as a fraction of a
+  measured decode step, and asserts the sum < 2%.
+
+A bench.py-style summary JSON line streams after EVERY completed
+scenario (last-line-wins under an outer watchdog kill), and the final
+line carries the full record.
+
+Standalone (CPU is fine — every scenario is about control flow, not
+device speed)::
+
+    python tools/chaos_bench.py [--smoke] [--json out.json]
+
+``tools/chaos_smoke.py`` runs the tier-1 subset (one scenario, tiny
+model, <60s) — the CI guard that keeps this plumbing from rotting
+between TPU sessions.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from lm_bench import (build_params, expected_rows,  # noqa: E402
+                      mixed_length_prompts)
+from load_gen import _percentile  # noqa: E402 — the ONE quantile helper
+
+
+def _lat_summary(lats):
+    lats = sorted(lats)
+    return {"mean": round(sum(lats) / len(lats), 4) if lats else 0.0,
+            "p50": round(_percentile(lats, 0.50), 4),
+            "p95": round(_percentile(lats, 0.95), 4),
+            "p99": round(_percentile(lats, 0.99), 4),
+            "max": round(lats[-1], 4) if lats else 0.0}
+
+
+def _build_replicas(params, n_heads, max_len, n, slots, plans,
+                    tag="chaos", **engine_kw):
+    """N single-device replicas; ``plans[i]`` (or None) arms replica
+    i's fault sites."""
+    from veles_tpu.serving import LMEngine, ServingMetrics
+    return [LMEngine(params, n_heads=n_heads, max_len=max_len,
+                     slots=slots, name="%s_r%d" % (tag, i),
+                     metrics=ServingMetrics(
+                         tag, labels={"replica": str(i)}),
+                     faults=plans[i], **engine_kw)
+            for i in range(n)]
+
+
+def _submit_all(server, prompts, n_new, deadline_s=120.0):
+    """Closed-loop admission: back off on 429s so a storm measures
+    shedding, not a crashed client."""
+    from veles_tpu.serving import Overloaded
+    futures = []
+    stop = time.monotonic() + deadline_s
+    for p in prompts:
+        while True:
+            try:
+                futures.append(server.submit(p, n_new))
+                break
+            except Overloaded as e:
+                if time.monotonic() > stop:
+                    raise
+                time.sleep(min(getattr(e, "retry_after", 0.05), 0.1))
+    return futures
+
+
+# --------------------------------------------------------------- scenarios
+def scenario_kill_replica(params, n_heads, max_len, prompts, n_new,
+                          expect, slots=2, freeze_after_ticks=6,
+                          drain_timeout_s=0.5):
+    """Kill-one-replica-under-load: see the module docstring."""
+    from veles_tpu.serving import FaultPlan, HealthChecker, Router
+    plan = FaultPlan(seed=0)
+    # CHUNKED prefill: every program is warmed at start, so the
+    # staleness watch sees only real wedges — a lazily-compiled prompt
+    # bucket would stall the progress counters exactly like a freeze
+    # (the stall_s sizing rule the HealthChecker docstring documents)
+    replicas = _build_replicas(params, n_heads, max_len, 2, slots,
+                               [plan, None], tag="chaos_kill",
+                               prefill_chunk=16)
+    router = Router(replicas, retries=2,
+                    drain_timeout_s=drain_timeout_s)
+    checker = HealthChecker(router, interval_s=0.05,
+                            probe_timeout_s=2.0, fail_threshold=2,
+                            cooldown_s=600.0, stall_s=0.3)
+    router.start()
+    plan.arm("engine.tick", kind="freeze",
+             after=plan.calls("engine.tick") + freeze_after_ticks,
+             duration_s=600.0)
+    t0 = time.monotonic()
+    try:
+        futures = _submit_all(router, prompts, n_new)
+        # drive the health state machine synchronously until the wedge
+        # is detected and every request resolved (deterministic: the
+        # freeze always fires at the same tick)
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            checker.step()
+            if all(f.done() for f in futures):
+                break
+            time.sleep(0.05)
+        completed = 0
+        for p, f, exp in zip(prompts, futures, expect):
+            out = f.result(timeout=60)     # raises on any failure
+            if len(out) != n_new:
+                raise AssertionError("partial result delivered: %d/%d"
+                                     % (len(out), n_new))
+            if not numpy.array_equal(numpy.concatenate([p, out]), exp):
+                raise AssertionError(
+                    "post-fault output diverged from greedy generate "
+                    "for prompt of length %d" % len(p))
+            completed += 1
+        m = router.metrics
+        quarantined = not router._live[0]
+        record = {
+            "scenario": "kill_one_replica_under_load",
+            "requests": len(prompts),
+            "completed_exactly_once": completed,
+            "parity_vs_generate": True,
+            "replica0_quarantined": quarantined,
+            "circuit_open_total": m.counter("circuit_open_total"),
+            "requeued_requests": m.counter("requeued_requests"),
+            "requests_retried": m.counter("requests_retried"),
+            "drain_forced_replacements":
+                m.counter("drain_forced_replacements"),
+            "freeze_fired": plan.fired("engine.tick"),
+            "wall_s": round(time.monotonic() - t0, 3),
+        }
+        if not quarantined:
+            raise AssertionError("health checker never quarantined the "
+                                 "frozen replica")
+        if completed != len(prompts):
+            raise AssertionError("%d/%d requests completed"
+                                 % (completed, len(prompts)))
+        return record
+    finally:
+        plan.release()
+        checker.stop()
+        router.stop()
+
+
+def scenario_slow_replica(params, n_heads, max_len, prompts, n_new,
+                          expect, slots=2, spike_s=0.15,
+                          hedge_after_s=0.25):
+    """Slow-replica tail: the same workload with hedging off then on;
+    hedging must fire, win, and keep parity."""
+    from veles_tpu.serving import FaultPlan, Router
+
+    def run(hedge):
+        plan = FaultPlan(seed=0).arm("engine.step", kind="latency",
+                                     latency_s=spike_s)
+        replicas = _build_replicas(params, n_heads, max_len, 2, slots,
+                                   [plan, None], tag="chaos_slow",
+                                   prefill_chunk=16)
+        router = Router(replicas,
+                        hedge_after_s=hedge_after_s if hedge else 0.0)
+        router.start()
+        try:
+            lats = []
+            futures = _submit_all(router, prompts, n_new)
+            t_sub = {id(f): time.monotonic() for f in futures}
+            for p, f, exp in zip(prompts, futures, expect):
+                out = f.result(timeout=120)
+                lats.append(time.monotonic() - t_sub[id(f)])
+                if not numpy.array_equal(
+                        numpy.concatenate([p, out]), exp):
+                    raise AssertionError(
+                        "hedged output diverged from greedy generate")
+            m = router.metrics
+            return {"latency_s": _lat_summary(lats),
+                    "requests_hedged": m.counter("requests_hedged"),
+                    "hedge_wins": m.counter("hedge_wins")}
+        finally:
+            plan.release()
+            router.stop()
+
+    base = run(hedge=False)
+    hedged = run(hedge=True)
+    if not hedged["requests_hedged"]:
+        raise AssertionError("hedging never fired on the slow replica")
+    return {
+        "scenario": "slow_replica_tail",
+        "requests": len(prompts),
+        "parity_vs_generate": True,
+        "injected_step_spike_s": spike_s,
+        "hedge_after_s": hedge_after_s,
+        "no_hedge": base,
+        "hedge": hedged,
+        "p99_ratio_hedge_vs_none": (
+            round(hedged["latency_s"]["p99"]
+                  / base["latency_s"]["p99"], 3)
+            if base["latency_s"]["p99"] else None),
+    }
+
+
+def scenario_pool_storm(params, n_heads, max_len, prompts, n_new,
+                        expect, slots=2, pool_pages=6, chunk=8,
+                        deadline_s=2.0):
+    """Pool-exhaustion storm: shed (429/503), never errored, never
+    wedged; pool drains leak-free afterwards."""
+    from veles_tpu.serving import (DeadlineExceeded, FaultPlan,
+                                  LMEngine, Overloaded, ServingMetrics)
+    # the pool must be able to place the LARGEST single request (an
+    # up-front 400 otherwise) while staying far below the aggregate
+    # demand — that gap IS the storm
+    need = max(-(-(len(p) + n_new) // chunk) for p in prompts)
+    pool_pages = max(pool_pages, need + 1)
+    # the storm site: every 7th admission also 429s by injection, on
+    # top of the natural pool pressure
+    plan = FaultPlan(seed=0).arm("engine.submit", kind="error",
+                                 exc="PoolExhausted", every=7)
+    engine = LMEngine(params, n_heads=n_heads, max_len=max_len,
+                      slots=slots, paged_kv=pool_pages,
+                      prefill_chunk=chunk, deadline_s=deadline_s,
+                      queue_depth=len(prompts) + 8,
+                      name="chaos_pool",
+                      metrics=ServingMetrics("chaos_pool"),
+                      faults=plan).start()
+    t0 = time.monotonic()
+    try:
+        outcomes = {"ok": 0, "rejected_429": 0, "shed_503": 0}
+        futures = []
+        for p in prompts:
+            try:
+                futures.append((p, engine.submit(p, n_new)))
+            except Overloaded:
+                outcomes["rejected_429"] += 1
+        for p, f in futures:
+            try:
+                out = f.result(timeout=120)
+                exp = expect[[i for i, q in enumerate(prompts)
+                              if q is p][0]]
+                if not numpy.array_equal(
+                        numpy.concatenate([p, out]), exp):
+                    raise AssertionError(
+                        "storm survivor diverged from greedy generate")
+                outcomes["ok"] += 1
+            except DeadlineExceeded:
+                outcomes["shed_503"] += 1
+            except Overloaded:
+                outcomes["rejected_429"] += 1
+            # any OTHER exception propagates: the storm must shed, not
+            # error — the scenario fails loudly on a 500-class fault
+        while engine._trie is not None and engine._trie.evict_one():
+            pass
+        invariants = engine.verify_pool_invariants()
+        if engine._pool.free_pages != engine._pool.num_pages:
+            raise AssertionError(
+                "pool leaked %d page(s) after the storm"
+                % (engine._pool.num_pages - engine._pool.free_pages))
+        total = sum(outcomes.values())
+        if total != len(prompts):
+            raise AssertionError("accounted %d of %d requests"
+                                 % (total, len(prompts)))
+        return {
+            "scenario": "pool_exhaustion_storm",
+            "requests": len(prompts),
+            "pool_pages": pool_pages,
+            "outcomes": outcomes,
+            "shed_not_errored": True,       # else we raised above
+            "injected_admission_storms": plan.fired("engine.submit"),
+            "pool_leak_free": True,
+            "allocator_invariants": invariants,
+            "wall_s": round(time.monotonic() - t0, 3),
+        }
+    finally:
+        engine.stop()
+
+
+def scenario_overhead(params, n_heads, max_len, prompts, n_new,
+                      slots=2, hook_calls=200000):
+    """Fault-free overhead: the UNARMED layer and the health prober
+    must cost <2% of a decode step (the acceptance bound).
+
+    Two measured facts: (a) the per-call cost of an unarmed fault hook
+    (one attribute-is-None check — timed over ``hook_calls``
+    iterations) scaled by the hooks a decode tick crosses; (b) the
+    health checker's per-scan cost on a BUSY fleet (counter reads, no
+    probe) amortized over its interval.  Both are expressed against a
+    decode-step wall measured live on this host."""
+    from veles_tpu.serving import HealthChecker, LMEngine, Router, \
+        ServingMetrics
+    engine = LMEngine(params, n_heads=n_heads, max_len=max_len,
+                      slots=slots, name="chaos_ovh",
+                      metrics=ServingMetrics("chaos_ovh")).start()
+    router = Router([engine])
+    checker = HealthChecker(router, interval_s=1.0)
+    try:
+        # a real decode-step wall from live traffic (warm programs)
+        futures = [engine.submit(p, n_new) for p in prompts]
+        for f in futures:
+            f.result(timeout=120)
+        step_s = engine.metrics.ewma("decode_step") or 1e-4
+        # (a) the unarmed hook, exactly as compiled into the engine
+        t0 = time.perf_counter()
+        for _ in range(hook_calls):
+            engine._fault("engine.step")
+        hook_s = (time.perf_counter() - t0) / hook_calls
+        # a decode tick crosses 2 sites (engine.tick + engine.step);
+        # admission-path sites are per REQUEST, not per token — charge
+        # them too, conservatively, as one more per tick
+        hooks_per_tick = 3
+        hook_frac = hooks_per_tick * hook_s / step_s
+        # (b) one health scan over a busy replica (staleness math
+        # only: the engine has queued work during the scan)
+        fut = engine.submit(prompts[0], max(8, n_new))
+        t0 = time.perf_counter()
+        scans = 50
+        for _ in range(scans):
+            checker.step()
+        scan_s = (time.perf_counter() - t0) / scans
+        fut.result(timeout=120)
+        # the prober runs once per interval_s of wall time, whatever
+        # the decode rate — its amortized cost is simply the fraction
+        # of wall clock a scan occupies
+        health_frac = scan_s / checker.interval_s
+        overhead = hook_frac + health_frac
+        record = {
+            "scenario": "fault_free_overhead",
+            "decode_step_ewma_s": round(step_s, 6),
+            "unarmed_hook_ns": round(hook_s * 1e9, 1),
+            "hooks_per_decode_tick": hooks_per_tick,
+            "hook_frac_of_decode_step": round(hook_frac, 6),
+            "health_scan_s": round(scan_s, 6),
+            "health_scan_interval_s": checker.interval_s,
+            "health_frac_of_decode_step": round(health_frac, 6),
+            "overhead_frac": round(overhead, 6),
+            "bound": 0.02,
+        }
+        if overhead >= 0.02:
+            raise AssertionError(
+                "unarmed fault layer + health prober cost %.3f%% of a "
+                "decode step (bound: 2%%)" % (100 * overhead))
+        return record
+    finally:
+        checker.stop()
+        router.stop()
+
+
+# ------------------------------------------------------------------- bench
+def summary_record(results):
+    """(record, exit_code) in the bench.py shape — metric priority in
+    ONE place: scenarios completed / total once any ran."""
+    done = [k for k in ("kill_one_replica_under_load",
+                        "slow_replica_tail", "pool_exhaustion_storm",
+                        "fault_free_overhead") if k in results]
+    if done:
+        return {
+            "metric": "chaos_scenarios_passed",
+            "value": len(done),
+            "unit": "scenarios",
+            "vs_baseline": 4,
+            "configs": results,
+        }, 0
+    return {"metric": "chaos_no_scenarios_completed", "value": None,
+            "unit": None, "vs_baseline": None, "configs": results}, 1
+
+
+def run_bench(smoke=False, n_new=16, requests=12, seed=0):
+    if smoke:
+        n_new, requests = 8, 6
+    vocab, max_len = 16, 64
+    params = build_params(vocab=vocab, d_model=32, n_heads=2,
+                          n_layers=2, max_len=max_len, seed=7)
+    n_heads = 2
+    prompts = mixed_length_prompts(requests, vocab, 4,
+                                   max_len - n_new - 8, seed=seed + 13)
+    expect = expected_rows(params, prompts, n_new, n_heads, max_len)
+    results = {"model": {"vocab": vocab, "max_len": max_len},
+               "requests": requests, "n_new": n_new}
+
+    def stream():
+        record, _ = summary_record(results)
+        print(json.dumps(record), flush=True)
+
+    results["kill_one_replica_under_load"] = scenario_kill_replica(
+        params, n_heads, max_len, prompts, n_new, expect)
+    stream()
+    results["slow_replica_tail"] = scenario_slow_replica(
+        params, n_heads, max_len, prompts[:max(4, requests // 2)],
+        n_new, expect)
+    stream()
+    results["pool_exhaustion_storm"] = scenario_pool_storm(
+        params, n_heads, max_len, prompts, n_new, expect)
+    stream()
+    results["fault_free_overhead"] = scenario_overhead(
+        params, n_heads, max_len, prompts[:4], n_new)
+    stream()
+    return results
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--smoke", action="store_true",
+                        help="tiny sizes (CI validation)")
+    parser.add_argument("--n-new", type=int, default=16)
+    parser.add_argument("--requests", type=int, default=12)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--json", default=None, metavar="FILE",
+                        help="also write the final record here")
+    args = parser.parse_args(argv)
+    results = run_bench(smoke=args.smoke, n_new=args.n_new,
+                        requests=args.requests, seed=args.seed)
+    record, rc = summary_record(results)
+    line = json.dumps(record)
+    print(line)                  # final full record — last line wins
+    if args.json:
+        with open(args.json, "w", encoding="utf-8") as f:
+            f.write(line + "\n")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,64 @@
+"""Chaos smoke (ISSUE 10 satellite): the <60s, tier-1-safe subset of
+``tools/chaos_bench.py`` — ONE scenario (kill-one-replica-under-load)
+on a tiny model, CPU, deterministic — wired into
+``tests/test_serving.py`` so the fault-injection plumbing, the health
+checker's quarantine path, and the router's drain/retry exactly-once
+contract cannot rot between TPU sessions.
+
+Standalone::
+
+    python tools/chaos_smoke.py        # prints one summary JSON line
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from chaos_bench import (build_params, expected_rows,  # noqa: E402
+                         mixed_length_prompts, scenario_kill_replica)
+
+#: the smoke's wall budget — asserted, so a slow drift fails loudly
+#: instead of silently eating the tier-1 watchdog's headroom
+BUDGET_S = 60.0
+
+
+def run_smoke(n_new=6, requests=6):
+    """Run the kill-one-replica scenario at smoke size; returns the
+    scenario record (raises on any violated invariant)."""
+    vocab, max_len, n_heads = 16, 48, 2
+    params = build_params(vocab=vocab, d_model=32, n_heads=n_heads,
+                          n_layers=2, max_len=max_len, seed=7)
+    prompts = mixed_length_prompts(requests, vocab, 3,
+                                   max_len - n_new - 4, seed=5)
+    expect = expected_rows(params, prompts, n_new, n_heads, max_len)
+    t0 = time.monotonic()
+    record = scenario_kill_replica(params, n_heads, max_len, prompts,
+                                   n_new, expect, slots=2,
+                                   freeze_after_ticks=4,
+                                   drain_timeout_s=0.4)
+    record["smoke_wall_s"] = round(time.monotonic() - t0, 2)
+    if record["smoke_wall_s"] >= BUDGET_S:
+        raise AssertionError("chaos smoke took %.1fs (budget %.0fs)"
+                             % (record["smoke_wall_s"], BUDGET_S))
+    return record
+
+
+def main(argv=None):
+    record = run_smoke()
+    print(json.dumps({"metric": "chaos_smoke_kill_one_replica",
+                      "value": record["completed_exactly_once"],
+                      "unit": "requests_completed_exactly_once",
+                      "vs_baseline": record["requests"],
+                      "configs": record}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -18,6 +18,12 @@ and token throughput (client-side tokens/s) alongside the latency
 percentiles.  ``tools/lm_bench.py`` imports the same prompt generator
 so benchmark prompts and load-test prompts can never drift.
 
+Failure accounting is split BY CLASS (ISSUE 10): the summary's
+``failures`` dict separates timeouts, 429s, 503s, connection drops and
+other HTTP errors, and ``shed_not_errored`` is True exactly when every
+non-200 was a graceful shed (429/503) — what the chaos harness asserts
+after a fault-injection run.
+
 Standalone::
 
     python tools/load_gen.py --url http://127.0.0.1:8180/predict \
@@ -36,6 +42,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import socket
 import sys
 import threading
 import time
@@ -62,8 +69,25 @@ def run_load(url, payload, clients=8, requests_per_client=20, qps=None,
     """
     interval = clients / qps if qps else 0.0
     stop_at = None
-    results = []   # (status_code, latency_s, body_or_None)
+    results = []   # (status_code, latency_s, body, client, req, class)
     lock = threading.Lock()
+
+    def failure_class(code, exc):
+        """ISSUE 10 satellite: bucket every outcome so chaos runs can
+        assert "shed, not errored" — timeouts vs 429 vs 503 vs
+        connection drops vs other HTTP errors."""
+        if code == 200:
+            return "ok"
+        if code == 429:
+            return "http_429"
+        if code == 503:
+            return "http_503"
+        if code:
+            return "http_other"
+        reason = getattr(exc, "reason", exc)
+        if isinstance(reason, (socket.timeout, TimeoutError)):
+            return "timeout"
+        return "connection"
 
     def client(ci):
         n = 0
@@ -79,6 +103,7 @@ def run_load(url, payload, clients=8, requests_per_client=20, qps=None,
                 url, data=data,
                 headers={"Content-Type": "application/json"})
             t0 = time.monotonic()
+            exc = None
             try:
                 with urllib.request.urlopen(req, timeout=timeout) as resp:
                     out = json.loads(resp.read())
@@ -89,11 +114,12 @@ def run_load(url, payload, clients=8, requests_per_client=20, qps=None,
                 except Exception:   # noqa: BLE001 — non-JSON error body
                     out = None
                 code = e.code
-            except Exception:   # noqa: BLE001 — connection-level failure
-                out, code = None, 0
+            except Exception as e:  # noqa: BLE001 — connection-level
+                out, code, exc = None, 0, e
             dt = time.monotonic() - t0
             with lock:
-                results.append((code, dt, out, ci, n))
+                results.append((code, dt, out, ci, n,
+                                failure_class(code, exc)))
             n += 1
             if interval and dt < interval:
                 time.sleep(interval - dt)
@@ -110,15 +136,25 @@ def run_load(url, payload, clients=8, requests_per_client=20, qps=None,
     wall = time.monotonic() - t_start
 
     by_status = {}
-    for code, _, _, _, _ in results:
+    failures = {"timeout": 0, "http_429": 0, "http_503": 0,
+                "connection": 0, "http_other": 0}
+    for code, _, _, _, _, klass in results:
         by_status[str(code)] = by_status.get(str(code), 0) + 1
-    lats = sorted(dt for code, dt, _, _, _ in results if code == 200)
+        if klass != "ok":
+            failures[klass] += 1
+    lats = sorted(dt for code, dt, _, _, _, _ in results if code == 200)
     return {
         "url": url,
         "clients": clients,
         "sent": len(results),
         "ok": len(lats),
         "by_status": by_status,
+        # failure accounting BY CLASS (ISSUE 10 satellite): chaos runs
+        # assert "shed (429/503), not errored (timeout/connection/5xx)"
+        "failures": failures,
+        "shed_not_errored": (failures["timeout"] == 0
+                             and failures["connection"] == 0
+                             and failures["http_other"] == 0),
         "wall_s": wall,
         "achieved_qps": len(results) / wall if wall > 0 else 0.0,
         "latency_s": {
@@ -128,11 +164,12 @@ def run_load(url, payload, clients=8, requests_per_client=20, qps=None,
             "p99": _percentile(lats, 0.99),
             "max": lats[-1] if lats else 0.0,
         },
-        "responses": [r for _, _, r, _, _ in results],
+        "responses": [r for _, _, r, _, _, _ in results],
         #: per-request facts aligned with ``responses`` — LM mode reads
         #: these to pair each reply with its generating (client, index)
         "records": [{"status": code, "latency_s": dt, "client": ci,
-                     "req": n} for code, dt, _, ci, n in results],
+                     "req": n, "class": klass}
+                    for code, dt, _, ci, n, klass in results],
     }
 
 

@@ -254,6 +254,44 @@ def build_argparser():
                              "pages and TTFT/decode-step EWMAs; "
                              "'round_robin' ignores them (the skew "
                              "baseline)")
+    parser.add_argument("--serve-health", action="store_true",
+                        help="with --serve-slots: background health "
+                             "prober per replica (staleness watch on "
+                             "busy replicas, synthetic 1-token probe "
+                             "on idle ones) that auto-quarantines a "
+                             "failing replica via the router's drain "
+                             "path and re-admits it after a cooldown "
+                             "(half-open circuit breaker; "
+                             "replica_health_state / "
+                             "circuit_open_total on /metrics)")
+    parser.add_argument("--serve-hedge", type=float, default=0.0,
+                        metavar="SECONDS",
+                        help="with --serve-slots: duplicate a request "
+                             "still outstanding past SECONDS on a "
+                             "second replica — first complete wins, "
+                             "the loser is cancelled (greedy replicas "
+                             "are bit-identical, so hedging moves "
+                             "tail latency, never output); negative = "
+                             "dynamic threshold (1.5x the live "
+                             "latency p95); 0 = off (default)")
+    parser.add_argument("--serve-retries", type=int, default=0,
+                        metavar="N",
+                        help="with --serve-slots: re-place a request "
+                             "whose replica FAULTED (engine error — "
+                             "not 429/503 sheds, not client errors) "
+                             "on a different replica up to N times "
+                             "with exponential jittered backoff; "
+                             "0 = off (default, the fault fails to "
+                             "the client)")
+    parser.add_argument("--fault-plan", default=None, metavar="FILE",
+                        help="with --serve: arm the deterministic "
+                             "fault-injection layer from a JSON plan "
+                             "(veles_tpu/serving/faults.py — injected "
+                             "dispatch errors, latency spikes, "
+                             "freezes, admission storms, transient "
+                             "HTTP errors at named sites).  Chaos/"
+                             "test gear: every site is a no-op "
+                             "without this flag")
     return parser
 
 
@@ -436,6 +474,10 @@ def main(argv=None):
             # boot); kept as the safety net for snapshot-restored ones
             parser.error("--serve: workflow %r has no forward chain or "
                          "LM trainer to serve" % wf.name)
+        fault_plan = None
+        if args.fault_plan:
+            from veles_tpu.serving import FaultPlan
+            fault_plan = FaultPlan.from_file(args.fault_plan)
         if getattr(wf, "trainer", None) is not None and \
                 hasattr(wf.trainer, "n_heads"):
             # transformer-trainer workflows serve token continuation
@@ -451,11 +493,18 @@ def main(argv=None):
                                         else args.serve_attn_kernel),
                            tp=args.serve_tp,
                            replicas=args.serve_replicas,
-                           router=args.serve_router)
+                           router=args.serve_router,
+                           health=args.serve_health,
+                           hedge=args.serve_hedge,
+                           retries=args.serve_retries,
+                           fault_plan=fault_plan)
         else:
             api = RESTfulAPI(
-                wf, normalizer=getattr(wf.loader, "normalizer", None))
+                wf, normalizer=getattr(wf.loader, "normalizer", None),
+                faults=fault_plan)
             if args.serve_batch > 0:
+                # enable_batching forwards api.faults, so the plan's
+                # batcher.* sites arm alongside http.request
                 api.enable_batching(max_batch=args.serve_batch)
             api.start(port=args.serve)
         # parseable by wrappers/tests; flushed before blocking

@@ -47,8 +47,17 @@ from veles_tpu.logger import Logger
 
 class RESTfulAPI(Logger):
     def __init__(self, workflow, normalizer=None, forward=None,
-                 handler=None, metrics=None, max_body=16 << 20):
+                 handler=None, metrics=None, max_body=16 << 20,
+                 faults=None):
         self.workflow = workflow
+        #: optional serving FaultPlan (ISSUE 10): the ``http.request``
+        #: site fires per POST — transient InjectedHTTPError replies
+        #: (the retryable-infrastructure-blip shape) and latency
+        #: spikes; a no-op when None
+        self.faults = faults
+        #: optional HealthChecker owned by serve_lm (stopped with the
+        #: server)
+        self.health_checker = None
         #: optional input normalizer (a loader's fitted normalizer) applied
         #: before the forward, so clients send raw feature scale
         self.normalizer = normalizer
@@ -129,7 +138,7 @@ class RESTfulAPI(Logger):
             self._ensure_forward(), max_batch=max_batch,
             queue_depth=queue_depth, batch_wait_s=batch_wait_s,
             deadline_s=deadline_s, sample_shape=sample_shape,
-            metrics=m, name=name)
+            metrics=m, name=name, faults=self.faults)
         self.metrics = m
         return self
 
@@ -225,6 +234,23 @@ class RESTfulAPI(Logger):
                     self._reply(400, {"error": "%s: %s"
                                       % (type(e).__name__, e)})
                     return
+                if api.faults is not None:
+                    from veles_tpu.serving.faults import InjectedHTTPError
+                    try:
+                        api.faults.fire("http.request")
+                    except InjectedHTTPError as e:
+                        # a transient HTTP-level fault: structured
+                        # reply at the injected status, Retry-After on
+                        # the retryable codes — the shape load_gen's
+                        # failure classes and the chaos harness assert
+                        headers = []
+                        if e.code in (429, 503):
+                            headers = [("Retry-After", "%d" % max(
+                                1, int(e.retry_after + 0.999)))]
+                        self._reply(e.code, {
+                            "error": str(e),
+                            "retry_after": e.retry_after}, headers)
+                        return
                 try:    # dispatch
                     result = (api._handler(payload)
                               if api._handler is not None
@@ -278,6 +304,11 @@ class RESTfulAPI(Logger):
             self._server = None
         if self.batcher is not None:
             self.batcher.stop()
+        if self.health_checker is not None:
+            # the prober must stop BEFORE its engines do, or its next
+            # probe lands on a stopped engine and counts a fake failure
+            self.health_checker.stop()
+            self.health_checker = None
         if self.lm_engine is not None:
             self.lm_engine.stop()
 
@@ -286,7 +317,9 @@ def serve_lm(workflow, host="127.0.0.1", port=8180, max_new=256,
              slots=0, queue_depth=64, deadline_s=30.0,
              prefix_cache=0, prefill_chunk=0, spec_k=0,
              queue_tokens=0, paged_kv=0, attn_kernel=None,
-             tp=0, replicas=1, router="metrics"):
+             tp=0, replicas=1, router="metrics",
+             health=False, health_interval_s=1.0, hedge=0.0,
+             retries=0, fault_plan=None):
     """Serve a trained transformer-trainer workflow (e.g. char_lm) for
     autoregressive continuation: POST ``{"input": [[tok, ...]],
     "n_new": N, "temperature": T, "top_k": K, "seed": S}`` to
@@ -337,6 +370,22 @@ def serve_lm(workflow, host="127.0.0.1", port=8180, max_new=256,
     every replica snapshot.  Admission (429/503) is unchanged behind
     the router.
 
+    The RESILIENCE layer (ISSUE 10, all default-off): ``retries=N``
+    re-places a request whose replica FAULTED (not sheds, not client
+    errors) on a different replica with exponential jittered backoff;
+    ``hedge=T`` duplicates a request outstanding past T seconds (T<0:
+    1.5× the live latency p95) on a second replica, first complete
+    wins, loser cancelled; ``health=True`` starts a
+    :class:`veles_tpu.serving.HealthChecker` that auto-quarantines a
+    wedged/failing replica through the router's drain path and
+    re-admits it after a cooldown (half-open circuit breaker;
+    ``replica_health_state`` / ``circuit_open_total`` on /metrics).
+    Any of the three wraps a single replica in the bit-identical
+    degenerate router.  ``fault_plan`` attaches a
+    :class:`veles_tpu.serving.FaultPlan` (CLI ``--fault-plan FILE``)
+    arming the deterministic fault-injection sites — test/chaos gear,
+    never armed in production.  See USAGE.md "Failure semantics".
+
     The direct path decodes one prompt batch at a time via the
     KV-cached ``transformer.generate``, one jitted dispatch per
     request.  Compile count and per-request cost are both BOUNDED
@@ -364,13 +413,19 @@ def serve_lm(workflow, host="127.0.0.1", port=8180, max_new=256,
     # max_new=256 decode)
     tiers = sorted({t for t in (8, 32, 128, max_new) if t <= max_new})
     engine = None
+    checker = None
     routed = False
     if slots > 0:
-        from veles_tpu.serving import (LMEngine, Router, RouterMetrics,
+        from veles_tpu.serving import (HealthChecker, LMEngine, Router,
+                                       RouterMetrics,
                                        replica_device_slices)
         from veles_tpu.serving import metrics as metrics_mod
         n_rep = max(1, int(replicas))
         tp_n = int(tp or 0)
+        # the RESILIENCE layer (ISSUE 10) lives on the Router — a
+        # single replica wraps in the (bit-identical) degenerate
+        # router when health/hedge/retries are requested
+        resilient = bool(health) or bool(hedge) or int(retries) > 0
         slices = (replica_device_slices(n_rep, tp_n)
                   if n_rep > 1 else None)
 
@@ -396,14 +451,22 @@ def serve_lm(workflow, host="127.0.0.1", port=8180, max_new=256,
                 spec_k=spec_k, queue_tokens=queue_tokens,
                 paged_kv=paged_kv, attn_kernel=attn_kernel,
                 tp=tp_n, devices=devices, name=eng_name,
-                metrics=metrics_mod.new("lm", labels=label))
+                metrics=metrics_mod.new("lm", labels=label),
+                faults=fault_plan)
 
-        if n_rep > 1:
+        if n_rep > 1 or resilient:
             routed = True
             engine = Router(
-                [build_engine(i) for i in range(n_rep)],
+                [build_engine(i if n_rep > 1 else None)
+                 for i in range(n_rep)],
                 metrics=metrics_mod.register(RouterMetrics("lm_router")),
-                policy=router).start()
+                policy=router, retries=int(retries),
+                hedge_after_s=float(hedge or 0.0),
+                faults=fault_plan).start()
+            if health:
+                checker = HealthChecker(
+                    engine, interval_s=float(health_interval_s),
+                    probe_timeout_s=max(5.0, deadline_s / 2)).start()
         else:
             engine = build_engine().start()
 
@@ -467,8 +530,9 @@ def serve_lm(workflow, host="127.0.0.1", port=8180, max_new=256,
 
     api = RESTfulAPI(None, handler=handler,
                      metrics=engine.metrics if engine is not None
-                     else None)
+                     else None, faults=fault_plan)
     api.lm_engine = engine
+    api.health_checker = checker
     return api.start(host=host, port=port)
 
 

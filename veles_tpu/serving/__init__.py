@@ -35,6 +35,20 @@ request, this package amortizes dispatch across concurrent clients.
   with hot-unregister draining that requeues a sick replica's pending
   requests.  ``serve_lm(tp=, replicas=)``, CLI ``--serve-tp`` /
   ``--serve-replicas`` / ``--serve-router``.
+- :mod:`veles_tpu.serving.faults` — :class:`FaultPlan` (ISSUE 10):
+  deterministic, seedable fault injection at named sites compiled into
+  the engine/batcher/router/HTTP layers (dispatch errors, latency
+  spikes, freezes, admission storms, transient HTTP errors) — each
+  site a no-op when unarmed.  Drives the resilience layer:
+  :class:`HealthChecker` (auto-quarantine via the router's drain path
+  + half-open circuit breaker), ``Router(retries=, hedge_after_s=)``
+  (re-place faulted requests on another replica with backoff; hedge
+  tail-latency stragglers, first-complete wins), and
+  ``LMEngine.checkpoint()/restore()`` (crash-safe re-admission of
+  journaled work with allocator invariants re-verified).  CLI
+  ``--serve-health`` / ``--serve-hedge`` / ``--serve-retries`` /
+  ``--fault-plan``; harness ``tools/chaos_bench.py`` /
+  ``tools/chaos_smoke.py``.
 - :mod:`veles_tpu.serving.metrics` — :class:`ServingMetrics`:
   lock-cheap counters/histograms (queue wait, batch size, latency
   percentiles, shed/429, slot occupancy) with a snapshot API and a
@@ -49,16 +63,22 @@ through here when asked (``RESTfulAPI.enable_batching``, ``serve_lm``'s
 from veles_tpu.serving.batcher import (DeadlineExceeded, MicroBatcher,
                                        Overloaded, PoolExhausted,
                                        batch_buckets)
+from veles_tpu.serving.faults import (FaultPlan, InjectedFault,
+                                      InjectedHTTPError)
 from veles_tpu.serving.kv_pool import KVPagePool
 from veles_tpu.serving.lm_engine import (LMEngine, RadixPrefixCache,
                                          prompt_bucket, propose_draft)
 from veles_tpu.serving.metrics import (ServingMetrics, get,
                                        render_prometheus)
-from veles_tpu.serving.router import (Router, RouterMetrics,
+from veles_tpu.serving.router import (HealthChecker, NoLiveReplicas,
+                                      Router, RouterMetrics,
                                       replica_device_slices)
 
 __all__ = ["MicroBatcher", "LMEngine", "RadixPrefixCache",
-           "KVPagePool", "Router", "RouterMetrics", "ServingMetrics",
-           "Overloaded", "DeadlineExceeded", "PoolExhausted",
-           "batch_buckets", "prompt_bucket", "propose_draft", "get",
-           "render_prometheus", "replica_device_slices"]
+           "KVPagePool", "Router", "RouterMetrics", "HealthChecker",
+           "ServingMetrics", "FaultPlan", "InjectedFault",
+           "InjectedHTTPError", "NoLiveReplicas", "Overloaded",
+           "DeadlineExceeded",
+           "PoolExhausted", "batch_buckets", "prompt_bucket",
+           "propose_draft", "get", "render_prometheus",
+           "replica_device_slices"]

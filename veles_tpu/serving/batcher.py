@@ -101,12 +101,16 @@ class MicroBatcher(Logger):
 
     def __init__(self, forward, max_batch=64, queue_depth=128,
                  batch_wait_s=0.002, deadline_s=2.0, sample_shape=None,
-                 dtype=numpy.float32, metrics=None, name="predict"):
+                 dtype=numpy.float32, metrics=None, name="predict",
+                 faults=None):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         if queue_depth < 1:
             raise ValueError("queue_depth must be >= 1")
         self.name = name
+        #: optional serving/faults.py FaultPlan (ISSUE 10) — the
+        #: batcher.* sites are one is-None check when unarmed
+        self._faults = faults
         self.forward = forward
         self.max_batch = int(max_batch)
         self.buckets = batch_buckets(self.max_batch)
@@ -154,6 +158,8 @@ class MicroBatcher(Logger):
         rows = numpy.asarray(rows, self.dtype)
         if rows.ndim < 1 or len(rows) < 1:
             raise ValueError("submit needs at least one row")
+        if self._faults is not None:
+            self._faults.fire("batcher.submit")
         with self._cond:
             if self._stop or self._thread is None:
                 raise RuntimeError("micro-batcher is not running")
@@ -224,6 +230,11 @@ class MicroBatcher(Logger):
         A single oversized request (rows > max_batch) is chunked over
         several max_batch dispatches."""
         now = time.monotonic()
+        if self._faults is not None:
+            # inside the worker's dispatch try: an injected error rides
+            # the real fault-isolation path (fails the batch's clients,
+            # never the worker)
+            self._faults.fire("batcher.dispatch")
         x = numpy.concatenate([it.rows for it in items]) \
             if len(items) > 1 else items[0].rows
         outs = []

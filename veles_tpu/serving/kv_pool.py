@@ -80,6 +80,44 @@ class KVPagePool:
     def refs(self, page):
         return self._refs[page]
 
+    def snapshot(self):
+        """JSON-safe copy of the full allocator bookkeeping — what
+        ``LMEngine.checkpoint`` (ISSUE 10) embeds so a crash leaves a
+        post-mortem record of who owned what."""
+        return {"num_pages": self.num_pages,
+                "page_size": self.page_size,
+                "refs": list(self._refs),
+                "pins": list(self._pins),
+                "free": list(self._free)}
+
+    def verify(self):
+        """Self-consistency audit (ISSUE 10): the free list holds
+        exactly the zero-ref pages (each once, never the scratch
+        page), no negative counts, and no pinned page without a
+        referent.  Raises RuntimeError naming the first violation;
+        returns a summary dict when sound — the crash-recovery path
+        runs this before re-admitting any work."""
+        free = set(self._free)
+        if len(free) != len(self._free):
+            raise RuntimeError("free list holds duplicate pages")
+        if self.SCRATCH in free:
+            raise RuntimeError("scratch page entered the free list")
+        for p in range(1, self.num_pages + 1):
+            refs, pins = self._refs[p], self._pins[p]
+            if refs < 0 or pins < 0:
+                raise RuntimeError(
+                    "page %d has negative bookkeeping (refs=%d, "
+                    "pins=%d)" % (p, refs, pins))
+            if (refs == 0) != (p in free):
+                raise RuntimeError(
+                    "page %d refs=%d but free-list membership is %s "
+                    "— leaked or double-freed" % (p, refs, p in free))
+            if pins and not refs:
+                raise RuntimeError(
+                    "page %d pinned (%d) with no referent" % (p, pins))
+        return {"free": len(free), "used": self.used_pages,
+                "pinned": self.pinned_pages}
+
     def shared(self, page):
         """True when appending into ``page`` needs copy-on-write."""
         return self._refs[page] > 1

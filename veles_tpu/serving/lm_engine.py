@@ -332,6 +332,18 @@ class RadixPrefixCache:
                 stack.append(child)
         return count
 
+    def live_pins(self):
+        """Total outstanding pin count across the trie — 0 whenever no
+        lane is active (ISSUE 10: the orphan-pin leak check after
+        faulted requests; a nonzero value at idle means a fault path
+        forgot to release its admission walk)."""
+        total, stack = 0, [self.root]
+        while stack:
+            for child in stack.pop().children.values():
+                total += child.refs
+                stack.append(child)
+        return total
+
     def _evict_one(self):
         """Evict the least-recently-used unpinned LEAF (interior nodes
         keep their children's prefix reachable; they become leaves —
@@ -381,12 +393,15 @@ class LMEngine(Logger):
                  metrics=None, name="lm", prefill_chunk=0,
                  prefix_cache=0, spec_k=0, spec_ngram=3,
                  queue_tokens=0, paged_kv=0, attn_kernel=None,
-                 tp=0, devices=None):
+                 tp=0, devices=None, faults=None):
         import jax
         import jax.numpy as jnp
         if slots < 1:
             raise ValueError("slots must be >= 1")
         self.name = name
+        #: optional serving/faults.py FaultPlan — every engine.* site
+        #: is one is-None check when unarmed (ISSUE 10)
+        self._faults = faults
         self.params = params
         self.n_heads = int(n_heads)
         self.max_len = int(max_len)
@@ -591,11 +606,22 @@ class LMEngine(Logger):
         self._cond = threading.Condition()
         self._thread = None
         self._stop = False
+        #: admission journal (ISSUE 10): rid -> _Request for every
+        #: request not yet resolved — checkpoint() snapshots it so a
+        #: supervisor can re-admit in-flight work after a crash
+        self._journal = {}
+        self._rid = 0
         self._build_jits()
         if self._paged:
             self._update_pool_gauges()
 
     # ----------------------------------------------------------- placement
+    def _fault(self, site):
+        """Fault-injection hook (ISSUE 10): free when no plan is
+        attached — one attribute-is-None check on the hot path."""
+        if self._faults is not None:
+            self._faults.fire(site)
+
     def _place_kv(self, arr):
         """Place one KV array per the engine's layout: head-sharded
         over the tp mesh, committed to the replica's device, or left
@@ -945,6 +971,7 @@ class LMEngine(Logger):
                     "pool holds %d — this request can never be placed"
                     % (len(prompt), n_new, demand,
                        self._pool.num_pages))
+        self._fault("engine.submit")
         with self._cond:
             if self._stop or self._thread is None:
                 raise RuntimeError("LM engine is not running")
@@ -974,6 +1001,16 @@ class LMEngine(Logger):
                 raise PoolExhausted(demand, 2 * self._pool.num_pages)
             req = _Request(prompt, int(n_new), self.deadline_s,
                            pages=demand)
+            # admission journal (ISSUE 10): the entry lives until the
+            # request's future settles (result, exception or cancel) —
+            # checkpoint() snapshots exactly the unresolved set.  The
+            # pop re-takes the (reentrant) engine lock so a concurrent
+            # checkpoint never iterates a mutating dict.
+            self._rid += 1
+            rid = self._rid
+            self._journal[rid] = req
+            req.future.add_done_callback(
+                lambda f, rid=rid: self._journal_pop(rid))
             self._queue.append(req)
             self._queued_tokens += req.true_len
             self._queued_pages += req.pages
@@ -1025,6 +1062,174 @@ class LMEngine(Logger):
             except ValueError:
                 return           # admitted (or done) — worker handles it
         req.future.cancel()
+
+    # --------------------------------------------------- crash-safe recovery
+    def _journal_pop(self, rid):
+        with self._cond:
+            self._journal.pop(rid, None)
+
+    def checkpoint(self):
+        """JSON-safe snapshot of the HOST-side serving state (ISSUE
+        10): every ADMITTED-but-unresolved request (the admission
+        journal), the slot frontiers, and — paged — the page tables
+        and the pool's full ref/pin/free bookkeeping.  Taken under the
+        engine lock, so the request set is consistent; cheap enough to
+        take per admission tick.
+
+        A crash loses DEVICE state (KV rows) unconditionally, so the
+        checkpoint deliberately carries no tensors: :meth:`restore`
+        re-admits the journaled work on a fresh engine and prefill
+        re-derives the KV — greedy decode is deterministic, so the
+        resumed outputs are bit-identical to what the crashed engine
+        would have served.  The pool/page-table sections exist for
+        POST-MORTEM diagnostics (what the allocator looked like at
+        the crash), not for reattachment — and since the worker
+        mutates the allocator without this lock, they can be torn
+        mid-tick on a LIVE engine: treat them as best-effort evidence
+        (a phantom inconsistency in a live-traffic snapshot is the
+        tear, not a leak); only the request set is exact.
+        :meth:`restore` never reads them."""
+        with self._cond:
+            entries = [{"rid": rid,
+                        "prompt": [int(t) for t in req.prompt],
+                        "n_new": int(req.n_new)}
+                       for rid, req in sorted(self._journal.items())
+                       if not req.future.done() and not req.cancelled]
+            state = {
+                "format": 1,
+                "config": {"max_len": self.max_len,
+                           "slots": self.slots,
+                           "prefill_chunk": self.prefill_chunk,
+                           "spec_k": self.spec_k,
+                           "paged_kv": bool(self._paged),
+                           "pool_pages": (self._pool.num_pages
+                                          if self._paged else 0)},
+                "requests": entries,
+                "slot_frontiers": {
+                    "pos": [int(x) for x in self._pos],
+                    "last": [int(x) for x in self._last]},
+            }
+            if self._paged:
+                state["pool"] = self._pool.snapshot()
+                state["page_tables"] = self._page_tables.tolist()
+            if self._trie is not None:
+                state["prefix_cache_chunks"] = self._trie.size
+        return state
+
+    def restore(self, state):
+        """Re-admit a :meth:`checkpoint`'s unresolved requests into
+        THIS (fresh, already :meth:`start`-ed) engine after a crash:
+        verifies the new pool's allocator invariants first (a restore
+        must never begin on a corrupt pool), then submits each
+        journaled request afresh.  Returns ``{rid: Future}`` so the
+        supervisor can hand results back to whoever was waiting.
+
+        In-flight-at-crash work is resumed AT-LEAST-ONCE from the
+        engine's point of view (a request that completed between the
+        checkpoint and the crash re-runs); exactly-once delivery is
+        the caller's layer (the router's drain/requeue discipline —
+        an old future that already delivered is simply gone with the
+        crashed process)."""
+        if not isinstance(state, dict) or state.get("format") != 1:
+            raise ValueError("not an LMEngine checkpoint (format %r)"
+                             % (state.get("format")
+                                if isinstance(state, dict) else state))
+        cfg = state.get("config", {})
+        if int(cfg.get("max_len", self.max_len)) > self.max_len:
+            raise ValueError(
+                "checkpoint was taken at max_len %d but this engine "
+                "holds %d — journaled prompts may not fit"
+                % (cfg["max_len"], self.max_len))
+        self.verify_pool_invariants()
+        futures = {}
+        entries = list(state.get("requests", ()))
+        # validate EVERY entry against this engine's geometry before
+        # admitting ANY: a structural refusal (span beyond max_len, a
+        # page demand the restoring pool can never cover) must be an
+        # all-or-nothing ValueError up front, not a mid-loop escape
+        # that strands already-re-admitted futures
+        for entry in entries:
+            span = len(entry["prompt"]) + int(entry["n_new"]) \
+                + self.spec_k
+            if span > self.max_len:
+                raise ValueError(
+                    "journaled request rid=%s needs %d cache positions "
+                    "but this engine holds %d"
+                    % (entry.get("rid"), span, self.max_len))
+            if self._paged and -(-span // self.prefill_chunk) \
+                    > self._pool.num_pages:
+                raise ValueError(
+                    "journaled request rid=%s needs %d KV pages but "
+                    "this engine's pool holds %d — restore into a "
+                    "pool at least as large as the checkpoint's "
+                    "(pool_pages=%s)"
+                    % (entry.get("rid"),
+                       -(-span // self.prefill_chunk),
+                       self._pool.num_pages, cfg.get("pool_pages")))
+        # a full-at-crash journal can exceed the fresh queue's capacity
+        # momentarily — the worker drains it, so re-admission is a
+        # closed loop honoring Retry-After, never a partial restore
+        # that strands already-admitted futures on an exception
+        stop = time.monotonic() + 30.0
+        for entry in entries:
+            while True:
+                try:
+                    futures[entry["rid"]] = self.submit(
+                        entry["prompt"], entry["n_new"])
+                    break
+                except Overloaded as e:
+                    if time.monotonic() > stop:
+                        raise RuntimeError(
+                            "restore stalled: %d/%d journaled requests "
+                            "re-admitted before the engine stopped "
+                            "accepting" % (len(futures), len(entries)))
+                    time.sleep(min(getattr(e, "retry_after", 0.05),
+                                   0.05))
+        self.metrics.inc("engine_restores")
+        self.metrics.inc("requests_restored", len(futures))
+        return futures
+
+    def verify_pool_invariants(self):
+        """Cross-check the paged allocator against the engine's OWN
+        references (ISSUE 10): every page's refcount must equal the
+        lane references (one per lane holding it, each also pinned)
+        plus the trie references (one per node storing it), and the
+        pool's internal free-list/ref/pin bookkeeping must be
+        self-consistent.  Raises RuntimeError naming the first
+        violated page; returns a summary dict when sound.  Call
+        quiesced (no worker mid-tick) — the chaos tests run it after
+        traffic drains and after restore."""
+        if not self._paged:
+            return {"paged": False}
+        self._pool.verify()
+        n = self._pool.num_pages
+        want_refs = [0] * (n + 1)
+        want_pins = [0] * (n + 1)
+        for lane in self._lanes:
+            if lane is None:
+                continue
+            for p in lane.pages:
+                want_refs[p] += 1
+                want_pins[p] += 1
+        if self._trie is not None:
+            stack = list(self._trie.root.children.values())
+            while stack:
+                node = stack.pop()
+                stack.extend(node.children.values())
+                want_refs[node.rows] += 1
+        for p in range(1, n + 1):
+            if self._pool.refs(p) != want_refs[p]:
+                raise RuntimeError(
+                    "page %d holds %d refs but lanes+trie account for "
+                    "%d — leaked or double-released"
+                    % (p, self._pool.refs(p), want_refs[p]))
+            if self._pool._pins[p] != want_pins[p]:
+                raise RuntimeError(
+                    "page %d holds %d pins but active lanes account "
+                    "for %d" % (p, self._pool._pins[p], want_pins[p]))
+        return {"paged": True, "free_pages": self._pool.free_pages,
+                "used_pages": self._pool.used_pages,
+                "pinned_pages": self._pool.pinned_pages}
 
     # ------------------------------------------------------------------ worker
     def _admit(self):
@@ -1091,6 +1296,7 @@ class LMEngine(Logger):
                 prompt = numpy.pad(prompt,
                                    (0, bucket - req.true_len))
             try:
+                self._fault("engine.prefill")
                 tok, rows = self._prefill_jit(
                     self.params, jnp.asarray(prompt[None], jnp.int32),
                     jnp.asarray(req.true_len, jnp.int32))
@@ -1255,6 +1461,7 @@ class LMEngine(Logger):
                 raise Overloaded()
             q = fresh[0]
             try:
+                self._fault("engine.cow")
                 self._kv_pools = self._page_copy_jit(
                     self._kv_pools, jnp.asarray(p, jnp.int32),
                     jnp.asarray(q, jnp.int32))
@@ -1377,6 +1584,7 @@ class LMEngine(Logger):
         last_idx = (req.true_len - 1 - start) if is_tail else 0
         t0 = time.monotonic()
         try:
+            self._fault("engine.chunk")
             self._caches, tok = self._chunk_jit(
                 self.params, self._caches,
                 jnp.asarray(tokens, jnp.int32),
@@ -1446,6 +1654,7 @@ class LMEngine(Logger):
         last_idx = (req.true_len - 1 - start) if is_tail else 0
         t0 = time.monotonic()
         try:
+            self._fault("engine.chunk")
             self._cow_guard(slot, lane, start, start + C)
             self._kv_pools, tok = self._chunk_jit(
                 self.params, self._kv_pools,
@@ -1568,6 +1777,7 @@ class LMEngine(Logger):
                 return
         t0 = time.monotonic()
         try:
+            self._fault("engine.step")
             if self._paged:
                 w = self._live_width(1)
                 self._kv_pools, toks = self._step_jit(
@@ -1634,6 +1844,7 @@ class LMEngine(Logger):
                 self.metrics.inc("draft_tokens", len(draft))
         t0 = time.monotonic()
         try:
+            self._fault("engine.verify")
             if self._paged:
                 w = self._live_width(k + 1)
                 self._kv_pools, out = self._verify_jit(
@@ -1681,6 +1892,20 @@ class LMEngine(Logger):
     def _worker(self):
         rr = 0
         while True:
+            # per-tick fault site (latency spikes / replica freezes —
+            # a freeze here wedges the worker exactly like a hung
+            # device call, the shape the health prober must catch);
+            # free when unarmed
+            if self._faults is not None:
+                try:
+                    self._faults.fire("engine.tick")
+                except Exception as e:   # noqa: BLE001 — injected
+                    # a raised tick fault poisons the whole engine
+                    # loop's turn: fail the in-flight lanes (the
+                    # fault-isolation discipline) and keep ticking
+                    self._fail_active(
+                        [i for i, ln in enumerate(self._lanes)
+                         if ln is not None], e)
             self._admit()
             busy = [i for i, lane in enumerate(self._lanes)
                     if lane is not None]

@@ -233,6 +233,17 @@ class ServingMetrics:
         with self._lock:
             return self.ewmas.get(name, default)
 
+    def latency_quantile(self, q, min_samples=8):
+        """Point read of a recent-latency quantile (the hedging
+        threshold's tail estimate, ISSUE 10) — None until
+        ``min_samples`` responses exist, so an empty router never
+        hedges against a guess."""
+        with self._lock:
+            if len(self._recent) < min_samples:
+                return None
+            vals = sorted(self._recent)
+        return _percentile(vals, q)
+
     def set_gauge_max(self, name, value):
         """High-water-mark gauge: keeps the largest value ever set —
         peak concurrent slot occupancy is what the fixed-KV-memory

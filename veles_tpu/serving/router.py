@@ -1,5 +1,5 @@
 """Data-parallel LM serving — N engine replicas behind a metrics-driven
-router (ISSUE 8).
+router (ISSUE 8), hardened into a RESILIENCE layer (ISSUE 10).
 
 Tensor parallelism (``LMEngine(tp=)``) scales ONE decode stream over a
 device mesh; this module adds the other serving axis: N INDEPENDENT
@@ -22,9 +22,11 @@ keeps the serving contract intact:
   placement order and re-raises the engines' own
   :class:`~veles_tpu.serving.batcher.Overloaded` /
   :class:`~veles_tpu.serving.batcher.PoolExhausted` only when EVERY
-  live replica refused (HTTP 429 upstream, same as one engine);
-  deadline sheds (503) and client errors (ValueError → 400) pass
-  through untouched.  A single replica degenerates to exactly today's
+  live replica refused (HTTP 429 upstream, same as one engine) — with
+  ``retry_after`` aggregated as the MINIMUM over the refusing replicas,
+  since the client may retry as soon as ANY replica frees; deadline
+  sheds (503) and client errors (ValueError → 400) pass through
+  untouched.  A single replica degenerates to exactly today's
   one-engine path — same outputs, same errors.
 - A SICK replica HOT-UNREGISTERS (:meth:`Router.unregister`): it
   leaves the placement rotation immediately and every request the
@@ -32,18 +34,46 @@ keeps the serving contract intact:
   withdrawn and REQUEUED on the surviving replicas.  A request is
   completed exactly once: a requeue only fires for work the drain
   itself interrupted (cancelled, or returned short), never for a
-  result that arrived whole, and never for engine-level failures on a
-  healthy replica (those keep their fault-isolation contract and fail
-  to the client).  Requests never wedge: when no live replica can
-  take a requeued request, its future fails loudly.
+  result that arrived whole.  Requests never wedge: when no live
+  replica can take a requeued request, its future fails loudly.
+
+The RESILIENCE layer (ISSUE 10) adds three opt-in behaviors, all
+default-off so an untouched router is bit-identical to the ISSUE 8
+contract:
+
+- RETRY (``retries=N``): an engine-level FAULT on a live replica
+  (injected dispatch error, poisoned step — not Overloaded, not a
+  deadline shed, not a client error) re-places the request WHOLE on a
+  different replica after an exponential, seeded-jitter backoff,
+  up to N times.  Re-placement is idempotent: replicas are
+  bit-identical greedy decoders, the failed attempt delivered nothing,
+  so the retried output is exactly what the first attempt would have
+  produced — exactly-once at the client, metered as
+  ``requests_retried``.
+- HEDGING (``hedge_after_s=T``): a request still outstanding past the
+  tail threshold (fixed ``T`` seconds, or ``T < 0`` for 1.5× the live
+  latency p95) is DUPLICATED on a second replica; the first completed
+  attempt wins and resolves the client future, the loser is cancelled
+  through the engines' existing sibling-cancellation path.  Greedy
+  parity makes both attempts bit-identical, so hedging can only move
+  latency, never output.  Metered as ``requests_hedged`` /
+  ``hedge_wins`` (wins = the hedge finished first).
+- HEALTH (:class:`HealthChecker`): a background prober that
+  auto-quarantines a wedged or failing replica through the existing
+  ``unregister`` draining path and auto-reregisters it after a
+  cooldown with half-open circuit-breaker semantics — see its
+  docstring for the state machine (also documented in USAGE.md
+  "Failure semantics").
 
 The router's own :class:`ServingMetrics` meters placement
 (``routed_requests{replica="i"}`` labeled counters, ``requeued``,
-rejected), and each replica's engine metrics register under one
-family name with a ``{replica="i"}`` label — ``/metrics`` renders one
-``# TYPE`` line per family with one row per replica, and
-``/metrics.json`` (via :class:`RouterMetrics`) embeds every replica's
-snapshot under ``"replicas"``.
+rejected), the resilience layer (``requests_retried``,
+``requests_hedged``, ``hedge_wins``, ``circuit_open_total``,
+``replica_health_state{replica="i"}``), and each replica's engine
+metrics register under one family name with a ``{replica="i"}`` label —
+``/metrics`` renders one ``# TYPE`` line per family with one row per
+replica, and ``/metrics.json`` (via :class:`RouterMetrics`) embeds
+every replica's snapshot under ``"replicas"``.
 """
 
 from __future__ import annotations
@@ -79,6 +109,19 @@ def replica_device_slices(replicas, tp, devices=None):
     return [[devices[i % len(devices)]] for i in range(n_rep)]
 
 
+class NoLiveReplicas(Overloaded):
+    """Every replica is out of rotation (quarantined or drained) — a
+    TRANSIENT unavailability, served upstream as the retryable 429 +
+    ``Retry-After`` the failure-semantics contract promises, never a
+    500 (the fleet usually returns at the next half-open probe)."""
+
+    def __init__(self, retry_after=1.0):
+        RuntimeError.__init__(
+            self, "router has no live replicas (all quarantined or "
+                  "drained); retry after %.1fs" % retry_after)
+        self.retry_after = retry_after
+
+
 class RouterMetrics(ServingMetrics):
     """Router-owned metrics whose ``snapshot()`` additionally embeds
     each replica engine's snapshot under ``"replicas"`` — one
@@ -97,12 +140,34 @@ class RouterMetrics(ServingMetrics):
         return snap
 
 
-class _Job:
-    """One routed request: the client-facing future plus the live
-    engine-side placement it currently rides on."""
+class _Attempt:
+    """One engine-side placement of a job.  A job normally has exactly
+    one; hedging adds a second, and the first to settle wins."""
 
-    __slots__ = ("prompt", "n_new", "future", "t0", "replica",
-                 "engine_future", "requeue", "attempts")
+    __slots__ = ("job", "replica", "engine_future", "requeue",
+                 "is_hedge", "abandoned")
+
+    def __init__(self, job, is_hedge=False):
+        self.job = job
+        self.replica = None
+        self.engine_future = None
+        #: set by unregister() right before it withdraws the engine-side
+        #: request: tells the completion callback that a cancellation or
+        #: short result is drain fallout to REPLACE, not a client event
+        self.requeue = False
+        self.is_hedge = is_hedge
+        #: set when a drain timeout force-replaced this attempt while
+        #: its engine was WEDGED: whatever the zombie engine eventually
+        #: resolves is ignored (the replacement owns the client future)
+        self.abandoned = False
+
+
+class _Job:
+    """One routed request: the client-facing future plus its live
+    engine-side placements."""
+
+    __slots__ = ("prompt", "n_new", "future", "t0", "replica", "live",
+                 "requeues", "retries", "hedged", "last_exc")
 
     def __init__(self, prompt, n_new):
         self.prompt = prompt
@@ -110,23 +175,33 @@ class _Job:
         self.future = Future()
         self.future.job = self          # router-level cancellation handle
         self.t0 = time.monotonic()
+        #: replica of the newest placement (the WINNING attempt's after
+        #: delivery) — what restful_api stamps into ``"replicas"``
         self.replica = None
-        self.engine_future = None
-        #: set by unregister() right before it withdraws the engine-side
-        #: request: tells the completion callback that a cancellation or
-        #: short result is drain fallout to REPLACE, not a client event
-        self.requeue = False
-        self.attempts = 0
+        #: live attempts (guarded by the router lock)
+        self.live = set()
+        self.requeues = 0
+        self.retries = 0
+        self.hedged = False
+        self.last_exc = None
 
 
 class Router(Logger):
     """Place requests on ``replicas`` (started/stopped together) by
-    their live metrics; see the module docstring for the contract."""
+    their live metrics; see the module docstring for the contract.
+
+    ``retries`` / ``hedge_after_s`` arm the ISSUE 10 resilience
+    behaviors (default OFF — zero behavior change for existing
+    callers); ``seed`` makes the retry jitter reproducible; ``faults``
+    attaches a :class:`~veles_tpu.serving.faults.FaultPlan` whose
+    ``router.place`` site fires per placement attempt."""
 
     POLICIES = ("metrics", "round_robin")
 
     def __init__(self, replicas, metrics=None, name="lm_router",
-                 policy="metrics"):
+                 policy="metrics", retries=0, retry_backoff_s=0.05,
+                 retry_backoff_cap_s=2.0, hedge_after_s=0.0,
+                 drain_timeout_s=5.0, seed=0, faults=None):
         replicas = list(replicas)
         if not replicas:
             raise ValueError("router needs at least one replica")
@@ -136,15 +211,26 @@ class Router(Logger):
         self.name = name
         self.replicas = replicas
         self.policy = policy
+        self.retries = int(retries)
+        self.retry_backoff_s = float(retry_backoff_s)
+        self.retry_backoff_cap_s = float(retry_backoff_cap_s)
+        self.hedge_after_s = float(hedge_after_s or 0.0)
+        self.drain_timeout_s = float(drain_timeout_s)
         self.metrics = metrics or ServingMetrics(name)
         if isinstance(self.metrics, RouterMetrics):
             self.metrics._router = self
+        self._faults = faults
         self._live = [True] * len(replicas)
         self._routed = [0] * len(replicas)
         self._pending = [set() for _ in replicas]
+        self._jobs = set()              # outstanding (hedge scan set)
+        self._timers = set()            # pending retry timers
         self._lock = threading.Lock()
+        self._rng = numpy.random.RandomState(seed)
         self._rr = 0
         self._stopping = False
+        self._hedge_thread = None
+        self._hedge_wake = threading.Event()
         self.metrics.set_gauge("replicas_total", len(replicas))
         self.metrics.set_gauge("replicas_live", len(replicas))
 
@@ -164,15 +250,55 @@ class Router(Logger):
     def start(self):
         for e in self.replicas:
             e.start()
+        if self.hedge_after_s:
+            self._hedge_wake.clear()
+            self._hedge_thread = threading.Thread(
+                target=self._hedge_loop, daemon=True,
+                name="router-hedge-%s" % self.name)
+            self._hedge_thread.start()
         return self
 
     def stop(self):
         with self._lock:
             self._stopping = True
+            timers = list(self._timers)
+            self._timers.clear()
+            jobs = list(self._jobs)
+        for t in timers:
+            t.cancel()
+        self._hedge_wake.set()
+        if self._hedge_thread is not None:
+            self._hedge_thread.join(timeout=10)
+            self._hedge_thread = None
+        # a job parked on a cancelled retry timer has no live attempt
+        # left to resolve it — fail it loudly instead of wedging the
+        # client on a future nobody owns
+        for job in jobs:
+            with self._lock:
+                orphan = not job.live and not job.future.done()
+            if orphan:
+                self._settle_exc(job,
+                                 job.last_exc
+                                 or RuntimeError("router stopped"))
         for e in self.replicas:
             e.stop()
 
+    @staticmethod
+    def _settle_exc(job, exc):
+        """Fail the client future unless a concurrent path (a hedge
+        sibling's delivery, stop()'s orphan sweep, a racing retry
+        timer) already settled it — the Future's own state transition
+        is the arbiter, exactly like _deliver's result race."""
+        try:
+            job.future.set_exception(exc)
+        except Exception:   # noqa: BLE001 — someone else settled it
+            pass
+
     # ------------------------------------------------------------ placement
+    def _fault(self, site):
+        if self._faults is not None:
+            self._faults.fire(site)
+
     def _score(self, i):
         """Smaller = place here.  Everything read from the replica's
         live ServingMetrics: outstanding work (queue depth + busy
@@ -201,7 +327,7 @@ class Router(Logger):
                 start = self._rr
             routed = list(self._routed)
         if not live:
-            raise RuntimeError("router has no live replicas")
+            raise NoLiveReplicas()
         if self.policy == "round_robin":
             return [live[(start + j) % len(live)]
                     for j in range(len(live))]
@@ -211,98 +337,197 @@ class Router(Logger):
         """Queue one prompt on the best replica; returns a Future for
         the (n_new,) greedy continuation.  Raises exactly what one
         engine would: ValueError for client errors, Overloaded /
-        PoolExhausted when every live replica refuses admission."""
+        PoolExhausted when every live replica refuses admission (with
+        ``retry_after`` = the MINIMUM over the refusing replicas)."""
         job = _Job(prompt, int(n_new))
-        self._place(job)
+        with self._lock:
+            self._jobs.add(job)
+        try:
+            self._place(job)
+        except Exception:
+            with self._lock:
+                self._jobs.discard(job)
+            raise
         return job.future
 
-    def _place(self, job):
+    def _place(self, job, exclude=(), hedge=False):
+        """Place one attempt for ``job``.  ``exclude`` replicas are
+        tried last (retry-on-a-different-replica) — or not at all when
+        ``hedge`` (a duplicate on the same replica hedges nothing).
+        Returns True when placed; a failed hedge returns False
+        (best-effort), a failed primary placement raises."""
         last_exc = None
-        for i in self._order():
+        min_retry = None
+        order = self._order()
+        if exclude:
+            preferred = [i for i in order if i not in exclude]
+            order = preferred if hedge \
+                else preferred + [i for i in order if i in exclude]
+        for i in order:
             engine = self.replicas[i]
             with self._lock:
                 if not self._live[i]:
                     continue
+            att = _Attempt(job, is_hedge=hedge)
             try:
+                self._fault("router.place")
                 f = engine.submit(job.prompt, job.n_new)
             except Overloaded as exc:
                 # queue/pool pressure on this replica: the next-best
                 # may still have room (ValueError — a client error —
                 # propagates immediately: it is identical on every
-                # replica of a homogeneous fleet)
+                # replica of a homogeneous fleet).  Track the SMALLEST
+                # Retry-After seen: the client may come back as soon
+                # as the soonest-freeing replica frees, not the
+                # last-tried one (ISSUE 10 satellite).
                 last_exc = exc
+                ra = getattr(exc, "retry_after", None)
+                if ra is not None:
+                    min_retry = ra if min_retry is None \
+                        else min(min_retry, ra)
                 continue
-            job.replica = i
-            job.engine_future = f
+            att.replica = i
+            att.engine_future = f
             with self._lock:
-                # re-check liveness at COMMIT: a drain that ran between
-                # the pre-submit check and here already snapshotted
-                # _pending[i] without this job, so committing would
-                # strand it on the drained replica — withdraw and keep
-                # looking instead
-                stale = not self._live[i]
+                # re-check at COMMIT: a drain that ran between the
+                # pre-submit check and here already snapshotted
+                # _pending[i] without this attempt (stranding it on the
+                # drained replica), and a sibling attempt may have
+                # DELIVERED in the same window (a committed duplicate
+                # would decode to completion for nobody) — withdraw in
+                # either case
+                done = job.future.done()
+                stale = done or not self._live[i]
                 if not stale:
-                    self._pending[i].add(job)
+                    self._pending[i].add(att)
+                    job.live.add(att)
                     self._routed[i] += 1
+                    job.replica = i
             if stale:
                 engine._cancel(f.request)
-                job.engine_future = None
-                job.replica = None
+                if done:
+                    return True      # settled — nothing left to place
                 continue
-            self.metrics.record_enqueue()
+            if hedge:
+                self.metrics.inc("requests_hedged")
+            else:
+                self.metrics.record_enqueue()
             self.metrics.inc("routed_requests",
                              labels={"replica": str(i)})
             f.add_done_callback(
-                lambda f, job=job, i=i: self._on_engine_done(job, i, f))
-            return
+                lambda f, att=att: self._on_attempt_done(att, f))
+            return True
+        if hedge:
+            return False
         self.metrics.record_reject()
-        raise last_exc if last_exc is not None else Overloaded()
+        if last_exc is not None:
+            if min_retry is not None:
+                last_exc.retry_after = min_retry
+            raise last_exc
+        raise Overloaded()
 
     # ----------------------------------------------------------- completion
-    def _on_engine_done(self, job, i, engine_future):
-        """Runs on the replica's worker (or canceller) thread when the
+    def _on_attempt_done(self, att, engine_future):
+        """Runs on the replica's worker (or canceller) thread when an
         engine-side future settles.  Exactly-once delivery: the
-        router future is resolved here and only here, and a requeue
-        fires only for drain fallout (see _Job.requeue)."""
+        router future is resolved here and only here — the first
+        settled attempt wins, siblings are cancelled and ignored —
+        and a requeue fires only for drain fallout (_Attempt.requeue)."""
+        job = att.job
+        i = att.replica
         with self._lock:
-            self._pending[i].discard(job)
+            # membership in job.live is the CLAIM: a drain timeout that
+            # force-replaced this attempt already removed it (and owns
+            # the job now) — this late resolution belongs to a zombie
+            claimed = att in job.live
+            self._pending[i].discard(att)
+            job.live.discard(att)
+            others = bool(job.live)
             live = self._live[i]
             stopping = self._stopping
-        if job.future.done():            # withdrawn at the router level
+        if att.abandoned or not claimed:
+            self._forget(job)
             return
-        requeue = job.requeue and not stopping
+        if job.future.done():            # withdrawn, or a sibling won
+            self._forget(job)
+            return
+        # a live SIBLING attempt already guarantees delivery: drain
+        # fallout on this one never needs a replacement decode (the
+        # `others` guards below) — re-placing anyway would duplicate
+        # the work on the shrunken fleet exactly when it is drained
+        requeue = att.requeue and not stopping
         if engine_future.cancelled():
-            # withdrawn before any decode: drain fallout replaces it,
-            # a router-level cancellation stays cancelled
-            if requeue:
+            if requeue and not others:
+                # withdrawn before any decode: drain fallout replaces
+                # it; a router-level cancellation stays cancelled
                 self._replace(job)
+            elif others:
+                pass                     # a cancelled hedge loser
             else:
                 job.future.cancel()
+                self._forget(job)
             return
         exc = engine_future.exception()
         if exc is not None:
             from veles_tpu.serving.batcher import DeadlineExceeded
-            if (requeue or not live) and not stopping \
-                    and not isinstance(exc, (Overloaded,
-                                             DeadlineExceeded)):
+            benign = isinstance(exc, (Overloaded, DeadlineExceeded))
+            if (requeue or not live) and not others and not stopping \
+                    and not benign:
                 # in-flight work dying WITH its drained/sick replica
                 # (engine stopped, poisoned step) is the router's
-                # problem; on a live replica the engine's
-                # fault-isolation contract stands and the client sees
-                # the fault
+                # problem, whatever the retry budget says
                 self._replace(job)
                 return
-            job.future.set_exception(exc)
+            if others:
+                # a hedge sibling is still decoding — let it deliver
+                job.last_exc = exc
+                return
+            if not benign and not stopping and self.retries \
+                    and job.retries < self.retries:
+                # engine-level FAULT on a live replica: re-place WHOLE
+                # on a different replica after a jittered backoff —
+                # idempotent, because greedy replicas are bit-identical
+                # and the failed attempt delivered nothing
+                self._schedule_retry(job, exc, exclude={i})
+                return
+            self._settle_exc(job, exc)
+            self._forget(job)
             return
         result = engine_future.result()
         if requeue and len(result) < job.n_new:
             # the drain interrupted this lane mid-decode: the engine
             # resolved it with the tokens it had (its cancellation
-            # path) — rerun the request whole on a live replica
-            self._replace(job)
+            # path) — rerun the request whole on a live replica,
+            # unless a sibling attempt is already decoding it
+            if not others:
+                self._replace(job)
             return
+        self._deliver(job, att, result)
+
+    def _deliver(self, job, att, result):
+        """First settled attempt wins; the set_result race (two
+        attempts completing concurrently) is decided by the Future's
+        own state transition."""
+        try:
+            job.future.set_result(result)
+        except Exception:   # noqa: BLE001 — a sibling already won
+            return
+        job.replica = att.replica
+        if att.is_hedge:
+            self.metrics.inc("hedge_wins")
         self.metrics.record_response(time.monotonic() - job.t0)
-        job.future.set_result(result)
+        with self._lock:
+            losers = list(job.live)
+        for loser in losers:
+            # the loser's callback sees the done future and exits
+            self.replicas[loser.replica]._cancel(
+                loser.engine_future.request)
+        self._forget(job)
+
+    def _forget(self, job):
+        with self._lock:
+            if not job.live:
+                self._jobs.discard(job)
 
     def _replace(self, job):
         """Re-place a drain-interrupted job on the surviving replicas —
@@ -311,20 +536,107 @@ class Router(Logger):
             # raced a router-level cancellation (generate() sibling
             # withdrawal): nobody reads this result — do not spend a
             # healthy replica's slots rerunning it
+            self._forget(job)
             return
-        job.requeue = False
-        job.attempts += 1
+        job.requeues += 1
         self.metrics.inc("requeued_requests")
-        if job.attempts > len(self.replicas) + 1:
-            job.future.set_exception(RuntimeError(
+        if job.requeues > len(self.replicas) + 1:
+            self._settle_exc(job, RuntimeError(
                 "request could not be re-placed after %d drain retries"
-                % job.attempts))
+                % job.requeues))
+            self._forget(job)
             return
         try:
             self._place(job)
         except Exception as exc:   # noqa: BLE001 — delivered, not raised
-            if not job.future.done():
-                job.future.set_exception(exc)
+            self._settle_exc(job, exc)
+            self._forget(job)
+
+    # -------------------------------------------------------------- retry
+    def _schedule_retry(self, job, exc, exclude):
+        job.retries += 1
+        job.last_exc = exc
+        self.metrics.inc("requests_retried")
+        delay = min(self.retry_backoff_cap_s,
+                    self.retry_backoff_s * (2 ** (job.retries - 1)))
+        with self._lock:
+            # seeded jitter (deterministic for a fixed retry order):
+            # desynchronizes a burst of same-fault retries so they do
+            # not land on the survivor as one thundering herd
+            delay += float(self._rng.uniform(0.0, delay * 0.5))
+            if self._stopping:
+                stopping = True
+            else:
+                stopping = False
+                timer = threading.Timer(
+                    delay, self._retry_place, args=(job, exclude))
+                timer.daemon = True
+                self._timers.add(timer)
+        if stopping:
+            self._settle_exc(job, exc)
+            self._forget(job)
+            return
+        timer.start()
+
+    def _retry_place(self, job, exclude):
+        with self._lock:
+            # drop timers whose threads finished (this one is still
+            # alive while its callback runs; it prunes next round)
+            self._timers = {t for t in self._timers if t.is_alive()}
+            stopping = self._stopping
+        if job.future.done():
+            self._forget(job)
+            return
+        if stopping:
+            self._settle_exc(job,
+                             job.last_exc
+                             or RuntimeError("router stopped"))
+            self._forget(job)
+            return
+        try:
+            self._place(job, exclude=exclude)
+        except Exception as exc:   # noqa: BLE001 — delivered, not raised
+            self._settle_exc(job, exc)
+            self._forget(job)
+
+    # ------------------------------------------------------------- hedging
+    def _hedge_threshold(self):
+        """Seconds outstanding before a request hedges: the fixed
+        ``hedge_after_s``, or (when negative) 1.5× the live latency
+        p95 — None until enough responses exist to estimate a tail."""
+        if self.hedge_after_s > 0:
+            return self.hedge_after_s
+        p95 = self.metrics.latency_quantile(0.95)
+        if p95 is None:
+            return None
+        return max(0.02, 1.5 * p95)
+
+    def _hedge_loop(self):
+        interval = max(0.005, self.hedge_after_s / 4) \
+            if self.hedge_after_s > 0 else 0.02
+        while not self._hedge_wake.wait(interval):
+            thr = self._hedge_threshold()
+            if thr is None:
+                continue
+            now = time.monotonic()
+            with self._lock:
+                jobs = [j for j in self._jobs
+                        if not j.hedged and len(j.live) == 1]
+                live_n = sum(1 for ok in self._live if ok)
+            if live_n < 2:
+                continue
+            for job in jobs:
+                if job.future.done() or now - job.t0 < thr:
+                    continue
+                with self._lock:
+                    exclude = {a.replica for a in job.live}
+                    job.hedged = True
+                try:
+                    # best-effort: a refused hedge (fleet under
+                    # pressure) just leaves the primary to finish
+                    self._place(job, exclude=exclude, hedge=True)
+                except Exception:   # noqa: BLE001 — hedge is optional
+                    pass
 
     # --------------------------------------------------------------- client
     def generate(self, prompts, n_new, return_replicas=False):
@@ -348,17 +660,19 @@ class Router(Logger):
         return out
 
     def cancel(self, future):
-        """Withdraw a routed request (sibling cancellation): the
-        engine-side request is cancelled and the router future will
+        """Withdraw a routed request (sibling cancellation): every
+        engine-side attempt is cancelled and the router future will
         NOT be re-placed."""
         job = future.job
-        job.requeue = False
         with self._lock:
-            engine_future = job.engine_future
-            i = job.replica
-        if engine_future is not None:
-            self.replicas[i]._cancel(engine_future.request)
+            attempts = list(job.live)
+        for att in attempts:
+            att.requeue = False
+            if att.engine_future is not None:
+                self.replicas[att.replica]._cancel(
+                    att.engine_future.request)
         future.cancel()
+        self._forget(job)
 
     # ---------------------------------------------------------------- drain
     def unregister(self, i, reason="sick"):
@@ -369,24 +683,59 @@ class Router(Logger):
         cancelled and its request reruns whole elsewhere — no loss,
         no duplicate completion).  The engine itself keeps running —
         the caller decides whether to stop or restart it; re-admit
-        with :meth:`reregister`.  Returns the number of requests
+        with :meth:`reregister`.  Returns the number of placements
         withdrawn."""
         with self._lock:
             if not self._live[i]:
                 return 0
             self._live[i] = False
-            jobs = list(self._pending[i])
+            attempts = list(self._pending[i])
             live_now = sum(1 for ok in self._live if ok)
         self.metrics.set_gauge("replicas_live", live_now)
         self.metrics.inc("replica_drains")
         self.warning("draining replica %d (%s): re-placing %d pending "
                      "request(s) on %d live replica(s)",
-                     i, reason, len(jobs), live_now)
+                     i, reason, len(attempts), live_now)
         engine = self.replicas[i]
-        for job in jobs:
-            job.requeue = True
-            engine._cancel(job.engine_future.request)
-        return len(jobs)
+        for att in attempts:
+            att.requeue = True
+            engine._cancel(att.engine_future.request)
+            if not att.engine_future.done():
+                # a WEDGED engine (frozen worker, hung device call)
+                # cannot resolve its side of a mid-decode withdrawal —
+                # after drain_timeout_s the attempt is force-abandoned
+                # and the request re-placed anyway, so a drain never
+                # wedges a client behind a dead worker.  If the zombie
+                # later thaws, its resolution is ignored (the claim
+                # check in _on_attempt_done) — exactly-once holds.
+                timer = threading.Timer(self.drain_timeout_s,
+                                        self._force_replace, args=(att,))
+                timer.daemon = True
+                with self._lock:
+                    if not self._stopping:
+                        self._timers.add(timer)
+                        timer.start()
+        return len(attempts)
+
+    def _force_replace(self, att):
+        """Drain-timeout fallout: abandon a wedged attempt and re-place
+        its job (see unregister)."""
+        job = att.job
+        with self._lock:
+            self._timers = {t for t in self._timers if t.is_alive()}
+            if self._stopping or att not in job.live:
+                return           # settled (or settling) normally
+            att.abandoned = True
+            job.live.discard(att)
+            self._pending[att.replica].discard(att)
+        if job.future.done():
+            self._forget(job)
+            return
+        self.metrics.inc("drain_forced_replacements")
+        self.warning("replica %d never resolved a drained request in "
+                     "%.1fs: force re-placing it", att.replica,
+                     self.drain_timeout_s)
+        self._replace(job)
 
     def reregister(self, i):
         """Return a drained replica to the placement rotation (after a
@@ -398,7 +747,206 @@ class Router(Logger):
 
     # ------------------------------------------------------------- evidence
     def routed_counts(self):
-        """Requests placed per replica (including requeues) — the
-        server-side balance evidence the bench records."""
+        """Requests placed per replica (including requeues, retries and
+        hedges) — the server-side balance evidence the bench records."""
         with self._lock:
             return list(self._routed)
+
+
+class HealthChecker(Logger):
+    """Background health prober with half-open circuit-breaker
+    semantics per replica (ISSUE 10).
+
+    STATE MACHINE (gauge ``replica_health_state{replica="i"}``):
+
+    - HEALTHY (0): every :meth:`step`, the replica is checked two ways.
+      STALENESS — if it holds work (queue depth + busy lanes > 0) but
+      its progress counters (tokens emitted, prefill dispatches, i.e.
+      the facts behind the decode-step EWMA) have not advanced for
+      ``stall_s``, the decode loop is wedged: one failure.  PROBE — an
+      IDLE replica gets a synthetic 1-token decode
+      (``probe_timeout``-bounded, withdrawn on timeout so a wedged
+      queue cannot accumulate probes): a failed or timed-out probe is
+      one failure.  Any success resets the count;
+      ``fail_threshold`` consecutive failures OPEN the circuit.
+    - OPEN (1): the replica was auto-quarantined through
+      :meth:`Router.unregister` — out of rotation, pending work
+      drained onto the survivors (``circuit_open_total`` incremented).
+      After ``cooldown_s`` (doubling per consecutive re-open, capped
+      at ``cooldown_cap_s``) the circuit goes half-open.
+    - HALF-OPEN (2): ONE synthetic probe, straight to the engine
+      (it is out of rotation, so no client traffic is at risk).
+      Success → :meth:`Router.reregister`, state HEALTHY, cooldown
+      reset.  Failure → back to OPEN with the doubled cooldown.
+
+    A replica an OPERATOR unregistered (router not-live while this
+    checker still holds state HEALTHY) is left alone — the checker
+    never fights a manual drain.
+
+    SIZING ``stall_s``: the progress counters also stand still while
+    the engine compiles a new program (a lazily-compiled prompt
+    bucket on the non-chunked path can take seconds on CPU), which is
+    indistinguishable from a wedge from out here — set ``stall_s``
+    above the worst first-compile, or serve with ``prefill_chunk``
+    (every program warmed at start) as production does.
+
+    ``step()`` is public and synchronous: tests and the chaos harness
+    drive the state machine deterministically without the thread;
+    ``start()`` runs it every ``interval_s`` in the background."""
+
+    HEALTHY, OPEN, HALF_OPEN = 0, 1, 2
+
+    def __init__(self, router, interval_s=1.0, probe_timeout_s=5.0,
+                 fail_threshold=3, cooldown_s=5.0, cooldown_cap_s=60.0,
+                 stall_s=None, probe_token=1, name="lm_health"):
+        if fail_threshold < 1:
+            raise ValueError("fail_threshold must be >= 1")
+        self.name = name
+        self.router = router
+        self.metrics = router.metrics
+        self.interval_s = float(interval_s)
+        self.probe_timeout_s = float(probe_timeout_s)
+        self.fail_threshold = int(fail_threshold)
+        self.cooldown_s = float(cooldown_s)
+        self.cooldown_cap_s = float(cooldown_cap_s)
+        self.stall_s = float(stall_s) if stall_s is not None \
+            else 3.0 * self.interval_s
+        self.probe_token = int(probe_token)
+        n = len(router.replicas)
+        now = time.monotonic()
+        self._state = [self.HEALTHY] * n
+        self._fails = [0] * n
+        self._cooldown = [self.cooldown_s] * n
+        self._reopen_at = [0.0] * n
+        self._last_progress = [now] * n
+        self._last_counts = [None] * n
+        self._stop = threading.Event()
+        self._thread = None
+        for i in range(n):
+            self._set_state(i, self.HEALTHY)
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self):
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name="health-%s" % self.name)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=max(10.0,
+                                          2 * self.probe_timeout_s))
+            self._thread = None
+
+    def _loop(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.step()
+            except Exception as e:   # noqa: BLE001 — prober must survive
+                self.warning("health step failed: %s", e)
+
+    # ----------------------------------------------------------- the check
+    def states(self):
+        """Per-replica circuit state (the gauge's source of truth)."""
+        return list(self._state)
+
+    def step(self, now=None):
+        """One synchronous scan of every replica (see the class
+        docstring for the state machine)."""
+        now = time.monotonic() if now is None else now
+        for i, engine in enumerate(self.router.replicas):
+            state = self._state[i]
+            if state == self.OPEN:
+                if now >= self._reopen_at[i]:
+                    self._half_open_probe(i, engine, now)
+                continue
+            if state == self.HALF_OPEN:
+                # a previous half-open probe is decided synchronously,
+                # so landing here means the state was left mid-flight
+                # by an exception — re-probe
+                self._half_open_probe(i, engine, now)
+                continue
+            with self.router._lock:
+                router_live = self.router._live[i]
+            if not router_live:
+                continue        # operator drain — not ours to manage
+            m = engine.metrics
+            progress = (m.counter("tokens_out")
+                        + m.counter("prefill_dispatches"))
+            if self._last_counts[i] is None \
+                    or progress != self._last_counts[i]:
+                self._last_counts[i] = progress
+                self._last_progress[i] = now
+            busy = (m.gauge("queue_depth", 0)
+                    + m.gauge("slots_busy", 0)) > 0
+            if busy:
+                # staleness check: work pending but the decode loop is
+                # not advancing (the EWMA's underlying facts are stale)
+                failed = (now - self._last_progress[i]) > self.stall_s
+            else:
+                failed = not self._probe(engine)
+            if failed:
+                self._fails[i] += 1
+                if self._fails[i] >= self.fail_threshold:
+                    self._quarantine(i, now)
+            else:
+                self._fails[i] = 0
+
+    def _probe(self, engine):
+        """Synthetic 1-token decode against ``engine`` — bounded, and
+        withdrawn on timeout so probes never pile up in a wedged
+        queue.  Greedy and lane-isolated: a probe can never perturb a
+        client lane's output."""
+        self.metrics.inc("health_probes")
+        try:
+            fut = engine.submit([self.probe_token], 1)
+            fut.result(timeout=self.probe_timeout_s)
+            return True
+        except Exception:   # noqa: BLE001 — any failure is the signal
+            try:
+                if "fut" in locals():
+                    engine._cancel(fut.request)
+            except Exception:   # noqa: BLE001 — best-effort withdrawal
+                pass
+            self.metrics.inc("health_probe_failures")
+            return False
+
+    # ------------------------------------------------------ state changes
+    def _set_state(self, i, state):
+        self._state[i] = state
+        self.metrics.set_gauge("replica_health_state", state,
+                               labels={"replica": str(i)})
+
+    def _quarantine(self, i, now):
+        self._fails[i] = 0
+        self._set_state(i, self.OPEN)
+        self._reopen_at[i] = now + self._cooldown[i]
+        self.metrics.inc("circuit_open_total")
+        self.warning("replica %d failed %d consecutive health checks: "
+                     "circuit OPEN for %.1fs", i, self.fail_threshold,
+                     self._cooldown[i])
+        self.router.unregister(i, reason="health circuit open")
+
+    def _half_open_probe(self, i, engine, now):
+        self._set_state(i, self.HALF_OPEN)
+        if self._probe(engine):
+            self._set_state(i, self.HEALTHY)
+            self._cooldown[i] = self.cooldown_s
+            self._fails[i] = 0
+            self._last_counts[i] = None
+            self._last_progress[i] = now
+            self.info("replica %d passed the half-open probe: "
+                      "re-registered", i)
+            self.router.reregister(i)
+        else:
+            self._cooldown[i] = min(self.cooldown_cap_s,
+                                    2 * self._cooldown[i])
+            self._set_state(i, self.OPEN)
+            self._reopen_at[i] = now + self._cooldown[i]
+            self.metrics.inc("circuit_open_total")
+            self.warning("replica %d failed the half-open probe: "
+                         "circuit re-OPEN for %.1fs", i,
+                         self._cooldown[i])
