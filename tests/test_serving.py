@@ -1199,6 +1199,43 @@ class TestResilience:
             checker.stop()
             router.stop()
 
+    def test_probe_warm_absorbs_first_compile(self):
+        """Satellite (ISSUE 11): warm_probes() runs each replica's
+        first synthetic probe with a generous budget BEFORE monitoring
+        starts, so a slow first-compile of the probe's prompt bucket
+        (the foot-gun the HealthChecker docstring warns about) can
+        never count as a failed probe and walk an innocent replica
+        toward quarantine."""
+        from veles_tpu.serving import HealthChecker, LMEngine, Router
+        params = _tiny_params()
+        engine = LMEngine(params, n_heads=2, max_len=48, slots=1,
+                          name="warm_r0").start()
+        # emulate a slow first probe-bucket compile: the FIRST prefill
+        # dispatch after start stalls well past the probe timeout
+        real = engine._prefill_jit
+        state = {"first": True}
+
+        def slow_first(*a):
+            if state["first"]:
+                state["first"] = False
+                time.sleep(0.6)
+            return real(*a)
+
+        engine._prefill_jit = slow_first
+        router = Router([engine])
+        checker = HealthChecker(router, interval_s=0.05,
+                                probe_timeout_s=0.25,
+                                fail_threshold=1, stall_s=5.0)
+        try:
+            checker.warm_probes()      # absorbs the 0.6s "compile"
+            for _ in range(3):
+                checker.step()
+            assert checker.states() == [HealthChecker.HEALTHY]
+            assert router.metrics.counter("health_probe_failures") == 0
+            assert router._live[0]
+        finally:
+            router.stop()
+
     def test_429_retry_after_is_minimum_over_replicas(self):
         """Satellite: when every replica refuses, the surfaced
         Retry-After is the MINIMUM over the refusing replicas — the
@@ -1357,6 +1394,306 @@ class TestInjectedHTTPFaults:
         assert summary["shed_not_errored"] is False
 
 
+class TestWeightSwap:
+    """ISSUE 11: zero-downtime weight updates — engine hot-swap (lanes
+    finish on the old weights or drain onto the new), tp-mesh swap
+    without recompiles, structural-mismatch refusal, canary rollback
+    driven by the synchronous HealthChecker, and the publisher loop."""
+
+    def _expected(self, params, prompts, n_new, max_len=48):
+        import jax.numpy as jnp
+        from veles_tpu.ops.transformer import generate
+        return [numpy.asarray(generate(
+            params, jnp.asarray([p], jnp.int32), n_new, 2,
+            temperature=0.0, max_len=max_len))[0] for p in prompts]
+
+    def _wait_busy(self, engine, n, timeout=10.0):
+        deadline = time.monotonic() + timeout
+        while engine.metrics.gauge("slots_busy") < n \
+                and time.monotonic() < deadline:
+            time.sleep(0.002)
+        assert engine.metrics.gauge("slots_busy") >= n
+
+    def test_swap_parity_straddling_lanes(self):
+        """swap_weights mid-traffic: every request completes whole and
+        exactly once, each delivered row is bit-identical to the
+        weights version its future is stamped with (straddling lanes
+        finish on the OLD weights — the default), and post-swap
+        traffic serves the new weights."""
+        from veles_tpu.serving import LMEngine
+        pa = _tiny_params()
+        pb = _tiny_params()       # fresh draws: same shapes, new weights
+        prompts = [[1, 2, 3], [2, 4, 6, 8], [5, 1, 5], [7, 7, 1]]
+        n_new = 12
+        exp_a = self._expected(pa, prompts, n_new)
+        exp_b = self._expected(pb, prompts, n_new)
+        engine = LMEngine(pa, n_heads=2, max_len=48, slots=2,
+                          name="sw_par").start()
+        try:
+            futures = [engine.submit(p, n_new) for p in prompts]
+            self._wait_busy(engine, 2)
+            v = engine.swap_weights(pb, version=7)
+            assert v == 7 and engine.weights_version == 7
+            seen = set()
+            for p, f, ea, eb in zip(prompts, futures, exp_a, exp_b):
+                out = f.result(timeout=60)
+                assert len(out) == n_new      # whole, exactly once
+                seen.add(f.version)
+                numpy.testing.assert_array_equal(
+                    numpy.concatenate([p, out]),
+                    ea if f.version == 0 else eb)
+            assert seen <= {0, 7}
+            assert 0 in seen        # the confirmed-busy lanes finished
+            #                         on the old weights
+            fut = engine.submit(prompts[0], n_new)
+            out = fut.result(timeout=60)
+            assert fut.version == 7
+            numpy.testing.assert_array_equal(
+                numpy.concatenate([prompts[0], out]), exp_b[0])
+            assert engine.metrics.counter("weight_swaps") == 1
+            assert engine.metrics.gauge("weights_version") == 7
+        finally:
+            engine.stop()
+
+    def test_swap_drain_requeues_on_new_weights_paged(self):
+        """drain=True on a paged engine: in-flight lanes are withdrawn
+        whole and re-decode from scratch on the NEW weights — futures
+        resolve exactly once with the new stamp, and the page pool
+        survives the requeue leak-free (allocator invariants)."""
+        from veles_tpu.serving import FaultPlan, LMEngine
+        pa = _tiny_params()
+        pb = _tiny_params()
+        prompts = [[1, 2, 3], [2, 4, 6, 8]]
+        n_new = 16
+        exp_b = self._expected(pb, prompts, n_new)
+        # slow ticks so the swap provably lands mid-decode
+        plan = FaultPlan().arm("engine.step", kind="latency",
+                               latency_s=0.02)
+        engine = LMEngine(pa, n_heads=2, max_len=48, slots=2,
+                          paged_kv=True, prefill_chunk=8,
+                          name="sw_drain", faults=plan).start()
+        try:
+            futures = [engine.submit(p, n_new) for p in prompts]
+            self._wait_busy(engine, 2)
+            engine.swap_weights(pb, version=3, drain=True)
+            for p, f, eb in zip(prompts, futures, exp_b):
+                out = f.result(timeout=60)
+                assert len(out) == n_new and f.version == 3
+                numpy.testing.assert_array_equal(
+                    numpy.concatenate([p, out]), eb)
+            assert engine.metrics.counter(
+                "requests_requeued_for_swap") >= 1
+            deadline = time.monotonic() + 15
+            while engine.metrics.gauge("slots_busy") > 0 \
+                    and time.monotonic() < deadline:
+                time.sleep(0.02)
+            inv = engine.verify_pool_invariants()
+            assert inv["free_pages"] == engine._pool.num_pages
+        finally:
+            plan.release()
+            engine.stop()
+
+    def test_swap_mismatch_refuses_loudly(self):
+        """A shape- or structure-incompatible tree refuses with a loud
+        ValueError and the OLD weights keep serving bit-exactly."""
+        import jax
+        import jax.numpy as jnp
+        from veles_tpu import prng
+        from veles_tpu.ops.transformer import init_transformer_params
+        from veles_tpu.serving import LMEngine
+        pa = _tiny_params()
+        wrong = jax.tree.map(jnp.asarray, init_transformer_params(
+            prng.get("init"), 16, d_model=16, n_heads=2, n_layers=2,
+            max_len=48))
+        [exp] = self._expected(pa, [[1, 2, 3]], 5)
+        engine = LMEngine(pa, n_heads=2, max_len=48, slots=1,
+                          name="sw_bad").start()
+        try:
+            with pytest.raises(ValueError, match="swap refused"):
+                engine.swap_weights(wrong)
+            broken = dict(pa)
+            broken.pop("embed")            # different tree structure
+            with pytest.raises(ValueError, match="swap refused"):
+                engine.swap_weights(broken)
+            assert engine.weights_version == 0
+            assert engine.metrics.counter("weight_swaps") == 0
+            out = engine.generate(numpy.asarray([[1, 2, 3]]), 5)
+            numpy.testing.assert_array_equal(out[0], exp)
+        finally:
+            engine.stop()
+
+    def test_tp_mesh_swap_no_recompile(self, serving_mesh):
+        """A tp=2 engine swaps shard-by-shard under its existing mesh
+        (lm_param_specs placement): output flips to the new weights
+        bit-exactly, the swapped tree is REALLY sharded, and no
+        program compiled a twin (same shapes + pinned shardings → the
+        jit-guard bound holds across the swap)."""
+        serving_mesh(2)
+        from veles_tpu.serving import LMEngine
+        pa = _tiny_params()
+        pb = _tiny_params()
+        prompts = [[1, 2, 3], [2, 4, 6, 8]]
+        exp_a = self._expected(pa, prompts, 6)
+        exp_b = self._expected(pb, prompts, 6)
+        engine = LMEngine(pa, n_heads=2, max_len=48, slots=2, tp=2,
+                          prefill_chunk=8, name="sw_tp").start()
+        try:
+            for p, ea in zip(prompts, exp_a):
+                out = engine.submit(p, 6).result(timeout=60)
+                numpy.testing.assert_array_equal(
+                    numpy.concatenate([p, out]), ea)
+            progs = {"step": engine._step_jit,
+                     "chunk": engine._chunk_jit}
+            sizes = {n: fn._cache_size() for n, fn in progs.items()}
+            engine.swap_weights(pb, version=1)
+            for p, eb in zip(prompts, exp_b):
+                fut = engine.submit(p, 6)
+                out = fut.result(timeout=60)
+                assert fut.version == 1
+                numpy.testing.assert_array_equal(
+                    numpy.concatenate([p, out]), eb)
+            for name, fn in progs.items():
+                assert fn._cache_size() == sizes[name], (
+                    "%s compiled a twin program across the swap"
+                    % name)
+            wq = engine.params["blocks"][0]["attn"]["wq"]
+            assert len(wq.addressable_shards) == 2   # really sharded
+        finally:
+            engine.stop()
+
+    def test_canary_rollback_driven_by_health_checker_step(self):
+        """Router.deploy watches the health circuit during the canary
+        window: a canary the synchronously-driven HealthChecker.step()
+        quarantines mid-watch rolls the deploy back to the previous
+        version, and the fleet keeps serving the old weights."""
+        import jax
+        from veles_tpu.serving import (FaultPlan, HealthChecker,
+                                       LMEngine, Router)
+        pa = _tiny_params()
+        pb = _tiny_params()
+        [exp_a] = self._expected(pa, [[1, 2, 3]], 4)
+        plan = FaultPlan()
+        devs = jax.devices()
+        replicas = [LMEngine(pa, n_heads=2, max_len=48, slots=2,
+                             devices=[devs[i % len(devs)]],
+                             name="cb_r%d" % i,
+                             faults=plan if i == 0 else None)
+                    for i in range(2)]
+        router = Router(replicas, drain_timeout_s=0.3).start()
+        checker = HealthChecker(router, interval_s=0.05,
+                                probe_timeout_s=2.0, fail_threshold=2,
+                                cooldown_s=600.0, stall_s=0.3)
+        checker.warm_probes()
+        result = {}
+
+        def run_deploy():
+            result["rec"] = router.deploy(
+                pb, version=1, canary=1, canary_fraction=0.5,
+                watch_s=30.0, checker=checker, probe_n_new=1)
+
+        t = threading.Thread(target=run_deploy, daemon=True)
+        t.start()
+        try:
+            # the canary (replica 0) swaps, passes its parity probe and
+            # rejoins — the deploy is now in its watch window
+            deadline = time.monotonic() + 60
+            while (replicas[0].weights_version != 1
+                   or not router._live[0]) \
+                    and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert replicas[0].weights_version == 1
+            # NOW the canary goes bad: every prefill faults, so the
+            # checker's synthetic 1-token probe dies — step()
+            # (synchronous) walks it to quarantine, and the deploy's
+            # watch sees the circuit
+            plan.arm("engine.prefill", kind="error")
+            deadline = time.monotonic() + 60
+            while router._live[0] and time.monotonic() < deadline:
+                checker.step()
+                time.sleep(0.03)
+            assert not router._live[0]
+            t.join(timeout=60)
+            assert not t.is_alive()
+            rec = result["rec"]
+            assert rec["rolled_back"] is True
+            assert "canary 0" in rec["reason"]
+            assert router.metrics.counter("rollbacks_total") == 1
+            plan.disarm()
+            # rolled all the way back: both replicas on v0, the
+            # survivor serves the OLD weights bit-exactly
+            assert replicas[0].weights_version == 0
+            assert replicas[1].weights_version == 0
+            fut = router.submit([1, 2, 3], 4)
+            out = fut.result(timeout=60)
+            assert fut.job.version == 0
+            numpy.testing.assert_array_equal(
+                numpy.concatenate([[1, 2, 3], out]), exp_a)
+        finally:
+            plan.disarm()
+            router.stop()
+
+    def _snapshot_payload(self, params):
+        import jax
+        host = jax.tree.map(numpy.asarray, params)
+        return {"format": 1, "framework_version": "test",
+                "workflow_class": "t", "workflow_name": "t",
+                "epoch": 1, "best_metric": None, "time": time.time(),
+                "state": {"units": {"TransformerTrainer": {
+                    "params": host, "opt_state": None, "time": 0}},
+                    "prng": {}},
+                "config": {}}
+
+    def test_model_manager_publishes_and_rejects(self, tmp_path):
+        """The publisher loop end to end: a snapshot landing in the
+        watched directory deploys across the fleet exactly once (the
+        unchanged directory is a no-op next poll), replies flip to the
+        new version, and a numerically-broken checkpoint is rejected
+        OFF the hot path with the fleet untouched."""
+        import gzip
+        import pickle
+        from veles_tpu.serving import LMEngine, ModelManager, Router
+        pa = _tiny_params()
+        pb = _tiny_params()
+        [exp_b] = self._expected(pb, [[1, 2, 3]], 5)
+        engine = LMEngine(pa, n_heads=2, max_len=48, slots=2,
+                          name="mm_r0")
+        router = Router([engine]).start()
+        manager = ModelManager(router, str(tmp_path), interval_s=3600,
+                               probe_n_new=2)
+
+        def write(params, mtime):
+            path = tmp_path / "wf_current.pickle.gz"
+            with gzip.open(path, "wb") as f:
+                pickle.dump(self._snapshot_payload(params), f)
+            os.utime(path, (mtime, mtime))
+            return path
+
+        try:
+            assert manager.poll_once() is None          # empty dir
+            write(pb, time.time())
+            rec = manager.poll_once()
+            assert rec["deployed"] and not rec["rolled_back"]
+            assert rec["version"] == 1 and rec["epoch"] == 1
+            assert manager.poll_once() is None          # unchanged
+            fut = router.submit([1, 2, 3], 5)
+            out = fut.result(timeout=60)
+            assert fut.job.version == 1
+            numpy.testing.assert_array_equal(
+                numpy.concatenate([[1, 2, 3], out]), exp_b)
+            # a NaN checkpoint is rejected before any engine sees it
+            bad_embed = numpy.array(pb["embed"], numpy.float32)
+            bad_embed[0, 0] = numpy.nan
+            write({**pb, "embed": bad_embed}, time.time() + 60)
+            rec = manager.poll_once()
+            assert rec["deployed"] is False
+            assert "non-finite" in rec["rejected"]
+            assert engine.weights_version == 1          # untouched
+            assert router.metrics.counter("publish_rejected") == 1
+            assert router.metrics.counter("publishes_total") == 1
+        finally:
+            router.stop()
+
+
 class TestChaosSmoke:
     def test_chaos_smoke_kill_one_replica(self):
         """Satellite: the <60s chaos-smoke subset runs tier-1 so the
@@ -1367,6 +1704,21 @@ class TestChaosSmoke:
         assert record["completed_exactly_once"] == record["requests"]
         assert record["parity_vs_generate"] is True
         assert record["replica0_quarantined"] is True
+        assert record["smoke_wall_s"] < 60
+
+    def test_chaos_smoke_weight_swap(self):
+        """Satellite (ISSUE 11): the <60s weight-swap-under-load
+        subset rides tier-1 — requests straddling a canary deploy
+        complete exactly once with per-stamped-version parity and
+        zero 5xx, and an injected bad canary auto-rolls back with no
+        client-visible errors."""
+        from chaos_smoke import run_swap_smoke
+        record = run_swap_smoke()
+        assert record["completed_exactly_once"] == record["requests"]
+        assert record["zero_5xx"] is True
+        assert record["parity_per_stamped_version"] is True
+        assert record["bad_canary_rolled_back"] is True
+        assert record["rollbacks_total"] == 1
         assert record["smoke_wall_s"] < 60
 
 

@@ -7,6 +7,7 @@ import subprocess
 import sys
 
 import numpy
+import pytest
 
 
 def _mnist_config(max_epochs=3, n_train=192, n_valid=64, mb=64,
@@ -187,6 +188,52 @@ def test_snapshotter_skip_gates_stop_write(tmp_path):
         # the snapshotter config must not leak into later tests that
         # share the process-global root
         root.__dict__.pop("mnist", None)
+
+
+def test_atomic_write_and_corrupt_rejection(tmp_path):
+    """Satellite (ISSUE 11): snapshots publish via temp-file + fsync +
+    atomic rename, so a crash mid-write leaves only a ``*.tmp``
+    staging file — the old snapshot still resolves and loads — and
+    the loader rejects a partial/corrupt file with a LOUD ValueError
+    (the model_manager's publish loop must never act on one)."""
+    from veles_tpu import snapshotter
+
+    class _WF:
+        name = "t"
+
+        @staticmethod
+        def snapshot_state():
+            return {"units": {}, "prng": {}}
+
+    path = str(tmp_path / "wf_current.pickle.gz")
+    snapshotter.save(_WF(), path)
+    assert snapshotter.import_(path)["format"] == snapshotter.FORMAT
+    # no staging residue after a clean save
+    assert not list(tmp_path.glob("*.tmp"))
+    # "kill mid-write": the staging file exists, truncated — the
+    # resolver must ignore it and keep serving the OLD snapshot
+    (tmp_path / "wf_current.pickle.gz.tmp").write_bytes(
+        (tmp_path / "wf_current.pickle.gz").read_bytes()[:17])
+    assert snapshotter.find_current(str(tmp_path)) == path
+    assert snapshotter.import_(path)["workflow_name"] == "t"
+    # a truncated published file (torn copy, not our writer) is a loud
+    # structured refusal, not a codec traceback
+    bad = tmp_path / "bad_current.pickle.gz"
+    whole = (tmp_path / "wf_current.pickle.gz").read_bytes()
+    bad.write_bytes(whole[:25])
+    with pytest.raises(ValueError, match="corrupt or truncated"):
+        snapshotter.import_(str(bad))
+    # garbage that is not a pickled archive at all
+    raw = tmp_path / "junk_current.pickle"
+    raw.write_bytes(b"this is not a snapshot")
+    with pytest.raises(ValueError, match="corrupt or truncated"):
+        snapshotter.import_(str(raw))
+    # a valid pickle that is not a snapshot payload
+    import pickle
+    notsnap = tmp_path / "n_current.pickle"
+    notsnap.write_bytes(pickle.dumps(["not", "a", "payload"]))
+    with pytest.raises(ValueError, match="format"):
+        snapshotter.import_(str(notsnap))
 
 
 def test_snapshotter_keep_last_prunes(tmp_path):

@@ -1,7 +1,7 @@
 """Chaos bench (ISSUE 10): the serving resilience layer under
 deterministic injected faults.
 
-Four scenarios, each driven by a seeded
+Five scenarios, each driven by a seeded
 ``veles_tpu/serving/faults.py::FaultPlan`` so a given run always
 injects at the same dispatches:
 
@@ -24,6 +24,12 @@ injects at the same dispatches:
   429/PoolExhausted/503 — never another error class, never a hang —
   and afterwards the pool drains back to FULL with allocator
   invariants re-verified (leak-freedom).
+- ``weight_swap_under_load`` — requests straddle a canary-first
+  ``Router.deploy`` (ISSUE 11): all complete exactly once with zero
+  5xx, every delivered row is bit-identical to the weights version
+  its reply is stamped with (pre-swap → old, post-swap → new), and an
+  injected bad canary (``engine.swap`` fault) auto-rolls back with no
+  client-visible errors.
 - ``fault_free_overhead`` — the acceptance leg for "unarmed is
   free": measures the per-call cost of an UNARMED fault hook and the
   health checker's per-scan cost, expresses both as a fraction of a
@@ -375,19 +381,127 @@ def scenario_overhead(params, n_heads, max_len, prompts, n_new,
         router.stop()
 
 
+def scenario_weight_swap(params_old, params_new, n_heads, max_len,
+                         prompts, n_new, expect_old, expect_new,
+                         slots=2):
+    """Weight-swap-under-load (ISSUE 11): N requests STRADDLE a
+    canary-first ``Router.deploy`` — every request completes exactly
+    once with zero 5xx, each delivered row is bit-identical to the
+    weights version its reply is stamped with (pre-swap rows → old
+    weights, post-swap rows → new), and an injected BAD canary
+    (``engine.swap`` fault) auto-rolls back with no client-visible
+    errors."""
+    from veles_tpu.serving import FaultPlan, Router
+    plan = FaultPlan(seed=0)        # replica 0: armed for the BAD deploy
+    replicas = _build_replicas(params_old, n_heads, max_len, 2, slots,
+                               [plan, None], tag="chaos_swap",
+                               prefill_chunk=16)
+    router = Router(replicas)
+    router.start()
+    t0 = time.monotonic()
+    try:
+        # ---- phase 1: a GOOD deploy with requests in flight
+        futures = _submit_all(router, prompts, n_new)
+        rec1 = router.deploy(params_new, version=1, canary=1,
+                             canary_fraction=0.5, watch_s=0.0)
+        if rec1["rolled_back"] or not rec1["completed"]:
+            raise AssertionError("good deploy did not complete: %r"
+                                 % rec1)
+        # post-swap wave: every row must decode on the NEW weights
+        futures2 = _submit_all(router, prompts, n_new)
+        versions_seen = {}
+        completed = 0
+        for wave, fleet_version in ((futures, None), (futures2, 1)):
+            for p, f in zip(prompts, wave):
+                out = f.result(timeout=120)   # raises on ANY failure
+                if len(out) != n_new:
+                    raise AssertionError(
+                        "partial result delivered: %d/%d"
+                        % (len(out), n_new))
+                ver = f.job.version
+                if fleet_version is not None and ver != fleet_version:
+                    raise AssertionError(
+                        "post-swap row stamped v%s, fleet is v%s"
+                        % (ver, fleet_version))
+                idx = [i for i, q in enumerate(prompts) if q is p][0]
+                exp = (expect_old if ver == 0 else expect_new)[idx]
+                if not numpy.array_equal(
+                        numpy.concatenate([p, out]), exp):
+                    raise AssertionError(
+                        "row stamped v%s is not bit-identical to that "
+                        "version's greedy generate" % ver)
+                versions_seen[ver] = versions_seen.get(ver, 0) + 1
+                completed += 1
+        # ---- phase 2: injected BAD canary — the swap apply faults
+        plan.arm("engine.swap", kind="error",
+                 calls={plan.calls("engine.swap") + 1})
+        futures3 = _submit_all(router, prompts, n_new)
+        rec2 = router.deploy(params_old, version=2, canary=1,
+                             canary_fraction=0.5, watch_s=0.0)
+        if not rec2["rolled_back"]:
+            raise AssertionError("bad canary did not roll back: %r"
+                                 % rec2)
+        for p, f in zip(prompts, futures3):
+            out = f.result(timeout=120)       # no client-visible errors
+            if len(out) != n_new:
+                raise AssertionError("partial result after rollback")
+            idx = [i for i, q in enumerate(prompts) if q is p][0]
+            if not numpy.array_equal(numpy.concatenate([p, out]),
+                                     expect_new[idx]):
+                raise AssertionError(
+                    "post-rollback row diverged from the serving (v1) "
+                    "weights")
+            completed += 1
+        m = router.metrics
+        for i, e in enumerate(replicas):
+            if e.weights_version != 1:
+                raise AssertionError(
+                    "replica %d serves v%s after the rollback (fleet "
+                    "must still be v1)" % (i, e.weights_version))
+        snap = m.snapshot()
+        record = {
+            "scenario": "weight_swap_under_load",
+            "requests": 3 * len(prompts),
+            "completed_exactly_once": completed,
+            "zero_5xx": True,               # else we raised above
+            "versions_observed": {str(k): v for k, v
+                                  in sorted(versions_seen.items())},
+            "parity_per_stamped_version": True,
+            "deploys_total": m.counter("deploys_total"),
+            "rollbacks_total": m.counter("rollbacks_total"),
+            "bad_canary_rolled_back": rec2["rolled_back"],
+            "rollback_reason": rec2["reason"],
+            "weights_version_gauges": {
+                k: v for k, v in snap["gauges"].items()
+                if k.startswith("weights_version")},
+            "wall_s": round(time.monotonic() - t0, 3),
+        }
+        if m.counter("rollbacks_total") != 1:
+            raise AssertionError("expected exactly one rollback, saw %d"
+                                 % m.counter("rollbacks_total"))
+        if completed != 3 * len(prompts):
+            raise AssertionError("%d/%d requests completed"
+                                 % (completed, 3 * len(prompts)))
+        return record
+    finally:
+        plan.release()
+        router.stop()
+
+
 # ------------------------------------------------------------------- bench
 def summary_record(results):
     """(record, exit_code) in the bench.py shape — metric priority in
     ONE place: scenarios completed / total once any ran."""
     done = [k for k in ("kill_one_replica_under_load",
                         "slow_replica_tail", "pool_exhaustion_storm",
+                        "weight_swap_under_load",
                         "fault_free_overhead") if k in results]
     if done:
         return {
             "metric": "chaos_scenarios_passed",
             "value": len(done),
             "unit": "scenarios",
-            "vs_baseline": 4,
+            "vs_baseline": 5,
             "configs": results,
         }, 0
     return {"metric": "chaos_no_scenarios_completed", "value": None,
@@ -420,6 +534,14 @@ def run_bench(smoke=False, n_new=16, requests=12, seed=0):
     stream()
     results["pool_exhaustion_storm"] = scenario_pool_storm(
         params, n_heads, max_len, prompts, n_new, expect)
+    stream()
+    params_new = build_params(vocab=vocab, d_model=32, n_heads=2,
+                              n_layers=2, max_len=max_len, seed=11)
+    expect_new = expected_rows(params_new, prompts, n_new, n_heads,
+                               max_len)
+    results["weight_swap_under_load"] = scenario_weight_swap(
+        params, params_new, n_heads, max_len, prompts, n_new, expect,
+        expect_new)
     stream()
     results["fault_free_overhead"] = scenario_overhead(
         params, n_heads, max_len, prompts[:4], n_new)

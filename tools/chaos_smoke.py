@@ -1,9 +1,10 @@
-"""Chaos smoke (ISSUE 10 satellite): the <60s, tier-1-safe subset of
-``tools/chaos_bench.py`` — ONE scenario (kill-one-replica-under-load)
-on a tiny model, CPU, deterministic — wired into
-``tests/test_serving.py`` so the fault-injection plumbing, the health
-checker's quarantine path, and the router's drain/retry exactly-once
-contract cannot rot between TPU sessions.
+"""Chaos smoke (ISSUE 10/11 satellite): the <60s, tier-1-safe subsets
+of ``tools/chaos_bench.py`` — kill-one-replica-under-load and
+weight-swap-under-load on a tiny model, CPU, deterministic — wired
+into ``tests/test_serving.py`` so the fault-injection plumbing, the
+health checker's quarantine path, the router's drain/retry
+exactly-once contract, and the hot-swap/canary-rollback path cannot
+rot between TPU sessions.
 
 Standalone::
 
@@ -22,7 +23,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 from chaos_bench import (build_params, expected_rows,  # noqa: E402
-                         mixed_length_prompts, scenario_kill_replica)
+                         mixed_length_prompts, scenario_kill_replica,
+                         scenario_weight_swap)
 
 #: the smoke's wall budget — asserted, so a slow drift fails loudly
 #: instead of silently eating the tier-1 watchdog's headroom
@@ -50,13 +52,41 @@ def run_smoke(n_new=6, requests=6):
     return record
 
 
+def run_swap_smoke(n_new=6, requests=4):
+    """Run the weight-swap-under-load scenario at smoke size (ISSUE
+    11): requests straddle a canary deploy, the injected bad canary
+    rolls back.  Returns the scenario record (raises on any violated
+    invariant)."""
+    vocab, max_len, n_heads = 16, 48, 2
+    params = build_params(vocab=vocab, d_model=32, n_heads=n_heads,
+                          n_layers=2, max_len=max_len, seed=7)
+    params_new = build_params(vocab=vocab, d_model=32, n_heads=n_heads,
+                              n_layers=2, max_len=max_len, seed=11)
+    prompts = mixed_length_prompts(requests, vocab, 3,
+                                   max_len - n_new - 4, seed=5)
+    expect_old = expected_rows(params, prompts, n_new, n_heads,
+                               max_len)
+    expect_new = expected_rows(params_new, prompts, n_new, n_heads,
+                               max_len)
+    t0 = time.monotonic()
+    record = scenario_weight_swap(params, params_new, n_heads, max_len,
+                                  prompts, n_new, expect_old,
+                                  expect_new, slots=2)
+    record["smoke_wall_s"] = round(time.monotonic() - t0, 2)
+    if record["smoke_wall_s"] >= BUDGET_S:
+        raise AssertionError("swap smoke took %.1fs (budget %.0fs)"
+                             % (record["smoke_wall_s"], BUDGET_S))
+    return record
+
+
 def main(argv=None):
     record = run_smoke()
+    swap = run_swap_smoke()
     print(json.dumps({"metric": "chaos_smoke_kill_one_replica",
                       "value": record["completed_exactly_once"],
                       "unit": "requests_completed_exactly_once",
                       "vs_baseline": record["requests"],
-                      "configs": record}))
+                      "configs": {"kill": record, "swap": swap}}))
     return 0
 
 

@@ -24,6 +24,12 @@ other HTTP errors, and ``shed_not_errored`` is True exactly when every
 non-200 was a graceful shed (429/503) — what the chaos harness asserts
 after a fault-injection run.
 
+LM replies' per-row ``weights_version`` stamps (ISSUE 11) aggregate
+into ``lm.weights_versions`` — per-version request counts plus
+first/last-seen completion offsets — so a zero-downtime weight swap's
+client-observed cutover is measurable from outside the server, the
+way ``lm.per_replica_requests`` measures router balance.
+
 Standalone::
 
     python tools/load_gen.py --url http://127.0.0.1:8180/predict \
@@ -119,7 +125,8 @@ def run_load(url, payload, clients=8, requests_per_client=20, qps=None,
             dt = time.monotonic() - t0
             with lock:
                 results.append((code, dt, out, ci, n,
-                                failure_class(code, exc)))
+                                failure_class(code, exc),
+                                t0 - t_start))
             n += 1
             if interval and dt < interval:
                 time.sleep(interval - dt)
@@ -138,11 +145,12 @@ def run_load(url, payload, clients=8, requests_per_client=20, qps=None,
     by_status = {}
     failures = {"timeout": 0, "http_429": 0, "http_503": 0,
                 "connection": 0, "http_other": 0}
-    for code, _, _, _, _, klass in results:
+    for code, _, _, _, _, klass, _ in results:
         by_status[str(code)] = by_status.get(str(code), 0) + 1
         if klass != "ok":
             failures[klass] += 1
-    lats = sorted(dt for code, dt, _, _, _, _ in results if code == 200)
+    lats = sorted(dt for code, dt, _, _, _, _, _ in results
+                  if code == 200)
     return {
         "url": url,
         "clients": clients,
@@ -164,12 +172,14 @@ def run_load(url, payload, clients=8, requests_per_client=20, qps=None,
             "p99": _percentile(lats, 0.99),
             "max": lats[-1] if lats else 0.0,
         },
-        "responses": [r for _, _, r, _, _, _ in results],
+        "responses": [r for _, _, r, _, _, _, _ in results],
         #: per-request facts aligned with ``responses`` — LM mode reads
-        #: these to pair each reply with its generating (client, index)
+        #: these to pair each reply with its generating (client, index);
+        #: ``t`` is the submit offset from the run start (seconds), so
+        #: a weight-swap cutover is placeable on the run's timeline
         "records": [{"status": code, "latency_s": dt, "client": ci,
-                     "req": n, "class": klass}
-                    for code, dt, _, ci, n, klass in results],
+                     "req": n, "class": klass, "t": round(t, 6)}
+                    for code, dt, _, ci, n, klass, t in results],
     }
 
 
@@ -227,6 +237,7 @@ def run_lm_load(url, clients=8, requests_per_client=20, vocab=16,
                        payload_fn=payload_fn)
     gen_counts, rates = [], []
     replica_counts = {}
+    version_stats = {}
     for rec, resp in zip(summary["records"], summary["responses"]):
         if rec["status"] != 200 or not resp or "tokens" not in resp:
             continue
@@ -243,6 +254,26 @@ def run_lm_load(url, clients=8, requests_per_client=20, vocab=16,
         for rid in resp.get("replicas", ()):
             key = str(rid)
             replica_counts[key] = replica_counts.get(key, 0) + 1
+        # zero-downtime updates (ISSUE 11): each row is stamped with
+        # the weights_version that decoded it — per-version request
+        # counts plus first/last-seen completion times make a swap's
+        # CLIENT-observed cutover measurable (mirrors the replica-
+        # balance accounting above)
+        done_at = rec["t"] + rec["latency_s"]
+        for ver in resp.get("weights_version", ()):
+            if ver is None:
+                continue
+            key = str(ver)
+            st = version_stats.get(key)
+            if st is None:
+                st = version_stats[key] = {
+                    "requests": 0, "first_seen_s": done_at,
+                    "last_seen_s": done_at}
+            st["requests"] += 1
+            st["first_seen_s"] = round(
+                min(st["first_seen_s"], done_at), 4)
+            st["last_seen_s"] = round(
+                max(st["last_seen_s"], done_at), 4)
     summary["lm"] = {
         "vocab": vocab, "mean_len": mean_len,
         "shared_frac": shared_frac, "n_new": n_new,
@@ -261,6 +292,12 @@ def run_lm_load(url, clients=8, requests_per_client=20, vocab=16,
             "p50": _percentile(sorted(rates), 0.50),
         },
     }
+    if version_stats:
+        summary["lm"]["weights_versions"] = dict(
+            sorted(version_stats.items()))
+        summary["lm"]["per_version_requests"] = {
+            v: st["requests"]
+            for v, st in sorted(version_stats.items())}
     if replica_counts:
         # balance ratio: max/min requests per replica as THE CLIENT
         # saw them (1.0 = perfect spread; the acceptance criterion

@@ -283,6 +283,43 @@ def build_argparser():
                              "with exponential jittered backoff; "
                              "0 = off (default, the fault fails to "
                              "the client)")
+    parser.add_argument("--serve-model-dir", default=None,
+                        metavar="DIR",
+                        help="with --serve-slots: continuous "
+                             "training→serving — watch DIR for the "
+                             "snapshotter's *_current.* checkpoints "
+                             "and hot-swap each new one across the "
+                             "fleet with zero downtime (canary-first "
+                             "deploy, parity probe, automatic "
+                             "rollback; in-flight requests finish on "
+                             "the weights they started on; replies "
+                             "stamp the serving weights_version)")
+    parser.add_argument("--serve-canary", type=int, default=1,
+                        metavar="N",
+                        help="with --serve-model-dir: swap N canary "
+                             "replica(s) first and watch the live "
+                             "health signals before ramping the rest "
+                             "of the fleet (default 1)")
+    parser.add_argument("--serve-publish-interval", type=float,
+                        default=5.0, metavar="SECONDS",
+                        help="with --serve-model-dir: how often the "
+                             "publisher loop polls the snapshot "
+                             "directory (default 5s)")
+    parser.add_argument("--serve-canary-watch", type=float,
+                        default=2.0, metavar="SECONDS",
+                        help="with --serve-model-dir: how long the "
+                             "deploy observes the canary's live "
+                             "health signals (errors, decode-step/"
+                             "TTFT EWMAs, the health circuit) with "
+                             "traffic steered at it before ramping "
+                             "the rest of the fleet; 0 = one "
+                             "instantaneous signal check (default 2s)")
+    parser.add_argument("--serve-no-auto-rollback",
+                        action="store_true",
+                        help="with --serve-model-dir: do NOT roll a "
+                             "failed canary back automatically — "
+                             "leave the mixed fleet for the operator "
+                             "(default: auto-rollback)")
     parser.add_argument("--fault-plan", default=None, metavar="FILE",
                         help="with --serve: arm the deterministic "
                              "fault-injection layer from a JSON plan "
@@ -497,7 +534,14 @@ def main(argv=None):
                            health=args.serve_health,
                            hedge=args.serve_hedge,
                            retries=args.serve_retries,
-                           fault_plan=fault_plan)
+                           fault_plan=fault_plan,
+                           model_dir=args.serve_model_dir,
+                           publish_interval_s=(
+                               args.serve_publish_interval),
+                           canary=args.serve_canary,
+                           canary_watch_s=args.serve_canary_watch,
+                           auto_rollback=(
+                               not args.serve_no_auto_rollback))
         else:
             api = RESTfulAPI(
                 wf, normalizer=getattr(wf.loader, "normalizer", None),

@@ -58,6 +58,9 @@ class RESTfulAPI(Logger):
         #: optional HealthChecker owned by serve_lm (stopped with the
         #: server)
         self.health_checker = None
+        #: optional ModelManager publisher loop owned by serve_lm
+        #: (stopped with the server, before the engines)
+        self.model_manager = None
         #: optional input normalizer (a loader's fitted normalizer) applied
         #: before the forward, so clients send raw feature scale
         self.normalizer = normalizer
@@ -304,6 +307,10 @@ class RESTfulAPI(Logger):
             self._server = None
         if self.batcher is not None:
             self.batcher.stop()
+        if self.model_manager is not None:
+            # the publisher must stop BEFORE the fleet it deploys to
+            self.model_manager.stop()
+            self.model_manager = None
         if self.health_checker is not None:
             # the prober must stop BEFORE its engines do, or its next
             # probe lands on a stopped engine and counts a fake failure
@@ -319,7 +326,9 @@ def serve_lm(workflow, host="127.0.0.1", port=8180, max_new=256,
              queue_tokens=0, paged_kv=0, attn_kernel=None,
              tp=0, replicas=1, router="metrics",
              health=False, health_interval_s=1.0, hedge=0.0,
-             retries=0, fault_plan=None):
+             retries=0, fault_plan=None, model_dir=None,
+             publish_interval_s=5.0, canary=1, canary_watch_s=2.0,
+             auto_rollback=True):
     """Serve a trained transformer-trainer workflow (e.g. char_lm) for
     autoregressive continuation: POST ``{"input": [[tok, ...]],
     "n_new": N, "temperature": T, "top_k": K, "seed": S}`` to
@@ -386,6 +395,22 @@ def serve_lm(workflow, host="127.0.0.1", port=8180, max_new=256,
     arming the deterministic fault-injection sites — test/chaos gear,
     never armed in production.  See USAGE.md "Failure semantics".
 
+    ZERO-DOWNTIME WEIGHT UPDATES (ISSUE 11): ``model_dir=DIR`` starts
+    a :class:`veles_tpu.serving.ModelManager` publisher loop watching
+    DIR for the snapshotter's ``*_current.*`` checkpoints every
+    ``publish_interval_s`` seconds — each new file is validated and
+    loaded OFF the hot path, then rolled across the fleet via
+    ``Router.deploy``: ``canary=N`` replicas swap and answer a
+    parity probe first, traffic steers at them for ``canary_watch_s``
+    seconds while the deploy watches the live health signals (0
+    reduces the watch to one instantaneous signal check), and a bad
+    canary auto-rolls back
+    (``auto_rollback=False`` leaves the mixed fleet for the operator).
+    In-flight requests finish on the weights they started on; every
+    engine-path reply carries a per-row ``"weights_version"`` stamp
+    so clients can observe the cutover (``tools/load_gen.py --lm``
+    aggregates it).  See USAGE.md "Zero-downtime weight updates".
+
     The direct path decodes one prompt batch at a time via the
     KV-cached ``transformer.generate``, one jitted dispatch per
     request.  Compile count and per-request cost are both BOUNDED
@@ -414,9 +439,11 @@ def serve_lm(workflow, host="127.0.0.1", port=8180, max_new=256,
     tiers = sorted({t for t in (8, 32, 128, max_new) if t <= max_new})
     engine = None
     checker = None
+    manager = None
     routed = False
     if slots > 0:
-        from veles_tpu.serving import (HealthChecker, LMEngine, Router,
+        from veles_tpu.serving import (HealthChecker, LMEngine,
+                                       ModelManager, Router,
                                        RouterMetrics,
                                        replica_device_slices)
         from veles_tpu.serving import metrics as metrics_mod
@@ -424,8 +451,10 @@ def serve_lm(workflow, host="127.0.0.1", port=8180, max_new=256,
         tp_n = int(tp or 0)
         # the RESILIENCE layer (ISSUE 10) lives on the Router — a
         # single replica wraps in the (bit-identical) degenerate
-        # router when health/hedge/retries are requested
-        resilient = bool(health) or bool(hedge) or int(retries) > 0
+        # router when health/hedge/retries are requested; the
+        # publisher loop (ISSUE 11) deploys through the router too
+        resilient = bool(health) or bool(hedge) or int(retries) > 0 \
+            or bool(model_dir)
         slices = (replica_device_slices(n_rep, tp_n)
                   if n_rep > 1 else None)
 
@@ -467,6 +496,13 @@ def serve_lm(workflow, host="127.0.0.1", port=8180, max_new=256,
                 checker = HealthChecker(
                     engine, interval_s=float(health_interval_s),
                     probe_timeout_s=max(5.0, deadline_s / 2)).start()
+            if model_dir:
+                manager = ModelManager(
+                    engine, model_dir,
+                    interval_s=float(publish_interval_s),
+                    canary=int(canary),
+                    watch_s=float(canary_watch_s),
+                    auto_rollback=bool(auto_rollback)).start()
         else:
             engine = build_engine().start()
 
@@ -491,14 +527,17 @@ def serve_lm(workflow, host="127.0.0.1", port=8180, max_new=256,
             # continuous batching: exact n_new (no tier), concurrent
             # prompts share the decode step across slots
             if routed:
-                toks, reps = engine.generate(
+                toks, reps, vers = engine.generate(
                     prompt, min(want, eng_headroom),
-                    return_replicas=True)
-                # per-row replica ids: the client-side balance
-                # evidence load_gen --lm aggregates
-                return {"tokens": toks.tolist(), "replicas": reps}
-            return {"tokens": engine.generate(
-                prompt, min(want, eng_headroom)).tolist()}
+                    return_replicas=True, return_versions=True)
+                # per-row replica ids and weights_version stamps: the
+                # client-side balance and swap-cutover evidence
+                # load_gen --lm aggregates
+                return {"tokens": toks.tolist(), "replicas": reps,
+                        "weights_version": vers}
+            toks, vers = engine.generate(
+                prompt, min(want, eng_headroom), return_versions=True)
+            return {"tokens": toks.tolist(), "weights_version": vers}
         # decode length: round the request UP to a tier; near the cache
         # cap fall back to the largest tier that fits (or the exact
         # headroom when even the smallest doesn't — rare, self-limiting)
@@ -533,6 +572,7 @@ def serve_lm(workflow, host="127.0.0.1", port=8180, max_new=256,
                      else None, faults=fault_plan)
     api.lm_engine = engine
     api.health_checker = checker
+    api.model_manager = manager
     return api.start(host=host, port=port)
 
 

@@ -49,6 +49,19 @@ request, this package amortizes dispatch across concurrent clients.
   ``--serve-health`` / ``--serve-hedge`` / ``--serve-retries`` /
   ``--fault-plan``; harness ``tools/chaos_bench.py`` /
   ``tools/chaos_smoke.py``.
+- :mod:`veles_tpu.serving.model_manager` — :class:`ModelManager`
+  (ISSUE 11): the publisher loop closing trainer→serving — watches a
+  snapshot directory (the snapshotter's atomic output), validates and
+  loads new checkpoints off the hot path, and drives zero-downtime
+  weight updates: ``LMEngine.swap_weights()`` hot-installs a
+  checkpoint into a live engine (in-flight lanes finish on the old
+  weights or drain-and-requeue; structural mismatch refuses loudly),
+  ``Router.deploy()`` rolls it out canary-first with a parity probe,
+  live-signal watch and automatic rollback, and every reply is
+  stamped with the ``weights_version`` that served it.
+  ``serve_lm(model_dir=, canary=, auto_rollback=)``, CLI
+  ``--serve-model-dir`` / ``--serve-canary`` /
+  ``--serve-publish-interval``.
 - :mod:`veles_tpu.serving.metrics` — :class:`ServingMetrics`:
   lock-cheap counters/histograms (queue wait, batch size, latency
   percentiles, shed/429, slot occupancy) with a snapshot API and a
@@ -70,15 +83,20 @@ from veles_tpu.serving.lm_engine import (LMEngine, RadixPrefixCache,
                                          prompt_bucket, propose_draft)
 from veles_tpu.serving.metrics import (ServingMetrics, get,
                                        render_prometheus)
+from veles_tpu.serving.model_manager import (ModelManager,
+                                             load_lm_params,
+                                             validate_lm_params)
 from veles_tpu.serving.router import (HealthChecker, NoLiveReplicas,
                                       Router, RouterMetrics,
                                       replica_device_slices)
 
 __all__ = ["MicroBatcher", "LMEngine", "RadixPrefixCache",
            "KVPagePool", "Router", "RouterMetrics", "HealthChecker",
-           "ServingMetrics", "FaultPlan", "InjectedFault",
+           "ModelManager", "ServingMetrics", "FaultPlan",
+           "InjectedFault",
            "InjectedHTTPError", "NoLiveReplicas", "Overloaded",
            "DeadlineExceeded",
            "PoolExhausted", "batch_buckets", "prompt_bucket",
-           "propose_draft", "get", "render_prometheus",
-           "replica_device_slices"]
+           "propose_draft", "get", "load_lm_params",
+           "render_prometheus",
+           "replica_device_slices", "validate_lm_params"]

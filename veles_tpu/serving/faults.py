@@ -40,6 +40,9 @@ Sites (each a no-op when unarmed):
 ``engine.cow``        paged copy-on-write page-copy dispatch
 ``engine.step``       batched decode-step dispatch
 ``engine.verify``     speculative verify dispatch
+``engine.swap``       weight-swap apply (LMEngine.swap_weights; a
+                      raised fault refuses the swap, old weights
+                      keep serving — the bad-canary chaos shape)
 ``batcher.submit``    MicroBatcher.submit admission
 ``batcher.dispatch``  MicroBatcher forward dispatch
 ``router.place``      Router placement, per replica attempt

@@ -103,6 +103,23 @@ slice — N independent engine REPLICAS (each optionally TP-sharded
 over a disjoint slice) stack behind ``serving/router.py`` for the
 data-parallel axis.
 
+ZERO-DOWNTIME WEIGHT UPDATES (ISSUE 11, :meth:`LMEngine.swap_weights`)
+hot-install a new checkpoint into a LIVE engine: the new tree is
+validated structurally (shape/dtype/treedef — a mismatch refuses
+loudly and the old weights keep serving), ``device_put`` under the
+engine's existing placement (the tp mesh re-shards shard-by-shard via
+``lm_param_specs``; same shapes → the already-compiled programs serve
+the new weights, zero recompiles), and applied by the worker at a tick
+boundary.  In-flight lanes either FINISH on the old weights (the
+default: admission holds, the old tree stays pinned until its last
+lane completes, then one pointer assignment swaps) or — ``drain=True``
+— are withdrawn whole and re-queued at the head, re-decoding from
+scratch on the new weights with their futures resolving exactly once
+(the engine-internal analogue of the router's drain re-placement).
+Every result is stamped with the ``weights_version`` that produced it,
+so mixed-fleet replies are attributable during a rolling deploy
+(``serving/router.py::Router.deploy``).
+
 Decoding is GREEDY (temperature 0) — bit-identical to
 ``ops/transformer.py::generate`` for the same prompt WHATEVER fast-path
 combination is enabled, which is the serving contract (sampled
@@ -393,7 +410,7 @@ class LMEngine(Logger):
                  metrics=None, name="lm", prefill_chunk=0,
                  prefix_cache=0, spec_k=0, spec_ngram=3,
                  queue_tokens=0, paged_kv=0, attn_kernel=None,
-                 tp=0, devices=None, faults=None):
+                 tp=0, devices=None, faults=None, version=0):
         import jax
         import jax.numpy as jnp
         if slots < 1:
@@ -481,6 +498,14 @@ class LMEngine(Logger):
         self.metrics.set_gauge("slots_total", self.slots)
         self.metrics.set_gauge("slots_busy", 0)
         self.metrics.set_gauge("tp_devices", self.tp or 1)
+        #: the checkpoint generation currently serving (ISSUE 11):
+        #: swap_weights bumps it, every finished request is stamped
+        #: with the version that produced its tokens
+        self.weights_version = int(version)
+        self.metrics.set_gauge("weights_version", self.weights_version)
+        #: in-flight swap_weights request (worker applies at tick
+        #: boundaries; None almost always)
+        self._pending_swap = None
 
         embed = params["embed"]
         d_model = embed.shape[1]
@@ -488,28 +513,19 @@ class LMEngine(Logger):
         kv_heads = params["blocks"][0]["attn"]["wk"].shape[1] // head_dim
         if self._mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
-            from veles_tpu.ops.transformer import lm_param_specs
             if kv_heads % self.tp:
                 raise ValueError(
                     "tp=%d must divide kv_heads %d (the KV cache "
                     "shards head-wise)" % (self.tp, kv_heads))
-            # place the weights by the megatron specs; the KV arrays
-            # below shard over their kv_heads axis so paged_view /
-            # mha_paged_chunk_step (and the contiguous decode) stay
-            # one-program-per-family — the page-table indirection and
-            # the head shard compose, neither is a shape
-            self.params = jax.tree.map(
-                lambda a, s: jax.device_put(
-                    a, NamedSharding(self._mesh, s)),
-                self.params, lm_param_specs(self.params))
+            # the KV arrays below shard over their kv_heads axis so
+            # paged_view / mha_paged_chunk_step (and the contiguous
+            # decode) stay one-program-per-family — the page-table
+            # indirection and the head shard compose, neither is a
+            # shape
             self._kv_shard = NamedSharding(
                 self._mesh, P(None, "tp", None, None))
             self._repl_shard = NamedSharding(self._mesh, P())
-        elif self._device is not None:
-            # a single-device replica: commit the weights (and the KV
-            # arrays below) so every program runs on THIS device slice
-            # instead of whatever the process default is
-            self.params = jax.device_put(self.params, self._device)
+        self.params = self._place_params(self.params)
         # ---- serving attention kernels (ISSUE 7): resolve the routing
         # ONCE here — platform and geometry are fixed for the engine's
         # lifetime, so the fallback decision never flaps mid-traffic.
@@ -621,6 +637,27 @@ class LMEngine(Logger):
         attached — one attribute-is-None check on the hot path."""
         if self._faults is not None:
             self._faults.fire(site)
+
+    def _place_params(self, params):
+        """Place one param tree per the engine's layout: megatron
+        specs over the tp mesh (``lm_param_specs`` — weights head-/
+        column-sharded, shard-by-shard device_put), committed to the
+        replica's device, or left as given (the single-device
+        default).  THE one placement path — construction and
+        :meth:`swap_weights` share it, so a hot-swapped tree lands in
+        exactly the layout the compiled programs expect (same shapes +
+        same shardings = zero recompiles)."""
+        import jax
+        if self._mesh is not None:
+            from jax.sharding import NamedSharding
+            from veles_tpu.ops.transformer import lm_param_specs
+            return jax.tree.map(
+                lambda a, s: jax.device_put(
+                    a, NamedSharding(self._mesh, s)),
+                params, lm_param_specs(params))
+        if self._device is not None:
+            return jax.device_put(params, self._device)
+        return params
 
     def _place_kv(self, arr):
         """Place one KV array per the engine's layout: head-sharded
@@ -946,6 +983,176 @@ class LMEngine(Logger):
             self._thread.join(timeout=60)
             self._thread = None
 
+    # ---------------------------------------------------------------- hot swap
+    def _check_swap_structure(self, params):
+        """Refuse a structurally incompatible tree LOUDLY before
+        anything is placed: the compiled programs are specialized on
+        the current shapes/dtypes, so a mismatch would either recompile
+        every family mid-traffic or crash a dispatch.  Old weights keep
+        serving on refusal — nothing is touched here."""
+        import jax
+        from jax.tree_util import keystr, tree_flatten_with_path
+        old, old_def = tree_flatten_with_path(self.params)
+        new, new_def = jax.tree_util.tree_flatten(params)
+        if old_def != new_def:
+            raise ValueError(
+                "swap refused: new param tree structure differs from "
+                "the serving tree (%s vs %s) — old weights keep "
+                "serving" % (new_def, old_def))
+        for (path, o), n in zip(old, new):
+            shape = tuple(getattr(n, "shape", ()) or ())
+            dtype = getattr(n, "dtype", None)
+            if shape != tuple(o.shape) or dtype != o.dtype:
+                raise ValueError(
+                    "swap refused: param %s is %s%s but the serving "
+                    "tree holds %s%s — old weights keep serving"
+                    % (keystr(path), shape, dtype, tuple(o.shape),
+                       o.dtype))
+
+    def swap_weights(self, params, version=None, drain=False,
+                     timeout_s=120.0):
+        """Hot-install ``params`` (same structure/shapes/dtypes as the
+        serving tree) into this LIVE engine without dropping lanes.
+
+        The tree is validated and ``device_put`` under the engine's
+        existing placement HERE, on the caller's thread (off the decode
+        hot path; tp engines re-shard by ``lm_param_specs`` shard-by-
+        shard); the worker applies the swap at a tick boundary.  By
+        default in-flight lanes FINISH on the old weights first —
+        admission holds while they do, the old tree stays pinned until
+        its last lane completes, and the apply itself is one pointer
+        assignment (no decode tick stalls longer than a step).  With
+        ``drain=True`` active lanes are withdrawn whole and re-queued
+        at the head instead: they re-decode from scratch on the new
+        weights, resolving their (unchanged) futures exactly once.
+
+        ``version`` (int; default: current + 1) becomes
+        :attr:`weights_version` — stamped on every result produced by
+        the new weights and exported as the ``weights_version`` gauge.
+        Returns the installed version; raises ValueError on structural
+        mismatch and re-raises an apply-time fault (``engine.swap``
+        site), in both cases leaving the old weights serving.  Blocks
+        until applied (``timeout_s`` bounds a wedged worker)."""
+        self._check_swap_structure(params)
+        placed = self._place_params(params)
+        if version is None:
+            version = self.weights_version + 1
+        version = int(version)
+        with self._cond:
+            if self._pending_swap is not None:
+                raise RuntimeError("a weight swap is already in flight")
+            if self._thread is None or self._stop:
+                # not serving: apply directly (start() warms the new
+                # tree like any other)
+                self.params = placed
+                self._set_version(version)
+                self.metrics.inc("weight_swaps")
+                return version
+            swap = {"params": placed, "version": version,
+                    "drain": bool(drain), "done": threading.Event(),
+                    "exc": None, "t0": time.monotonic()}
+            self._pending_swap = swap
+            self._cond.notify_all()
+        if not swap["done"].wait(timeout_s):
+            with self._cond:
+                withdrawn = self._pending_swap is swap
+                if withdrawn:
+                    self._pending_swap = None
+            if not withdrawn:
+                # the worker CLAIMED the swap right at the deadline —
+                # the apply is a pointer assignment, so give it a
+                # moment rather than reporting a state we know is
+                # about to be wrong
+                swap["done"].wait(5.0)
+            if not swap["done"].is_set():
+                raise RuntimeError(
+                    "weight swap did not apply within %.0fs (worker "
+                    "wedged or lanes never finished); %s"
+                    % (timeout_s,
+                       "old weights keep serving" if withdrawn else
+                       "swap state INDETERMINATE — the worker claimed "
+                       "it but never finished applying"))
+        if swap["exc"] is not None:
+            raise swap["exc"]
+        return version
+
+    def _set_version(self, version):
+        self.weights_version = int(version)
+        self.metrics.set_gauge("weights_version", self.weights_version)
+
+    def _maybe_apply_swap(self):
+        """Worker-side swap application (one is-None check per tick).
+        Finish-on-old waits for the active lanes (admission is held in
+        ``_admit`` so the wait is bounded by their remaining n_new);
+        drain mode re-queues them whole first.  The apply itself is a
+        pointer assignment — the tree was placed on the caller's
+        thread."""
+        swap = self._pending_swap
+        if swap is None:
+            return
+        active = [i for i, lane in enumerate(self._lanes)
+                  if lane is not None]
+        if active and not swap["drain"]:
+            return           # lanes finish on the OLD weights first
+        with self._cond:
+            # CLAIM before mutating anything: a timed-out caller may
+            # have withdrawn the swap — applying (or requeueing lanes
+            # for) a withdrawn swap would serve weights the caller was
+            # told never installed
+            if self._pending_swap is not swap:
+                return
+            self._pending_swap = None
+        if active:
+            self._requeue_active(active)
+        try:
+            self._fault("engine.swap")
+            self.params = swap["params"]
+        except Exception as e:   # noqa: BLE001 — refuse, keep serving
+            swap["exc"] = e
+            self.metrics.record_error()
+            self.metrics.inc("weight_swap_failures")
+            self.warning("weight swap refused at apply: %s (old "
+                         "weights keep serving)", e)
+        else:
+            self._set_version(swap["version"])
+            self.metrics.inc("weight_swaps")
+            self.metrics.set_gauge("swap_quiesce_s",
+                                   time.monotonic() - swap["t0"])
+        swap["done"].set()
+
+    def _requeue_active(self, active):
+        """Drain-mode swap: withdraw every active lane WHOLE and put
+        its request back at the queue head in original admission order
+        — the engine-internal analogue of the router's drain
+        re-placement.  The futures are untouched: each request
+        re-decodes from scratch (on the new weights) and resolves
+        exactly once."""
+        order = sorted(active,
+                       key=lambda s: self._lanes[s].request.t_enq)
+        reqs = []
+        fresh_deadline = time.monotonic() + self.deadline_s
+        for slot in order:
+            lane = self._lanes[slot]
+            self._vacate_slot(slot, lane)
+            # the re-decode gets a fresh admission-sized budget: the
+            # request already spent its wait DECODING — shedding it
+            # 503 at its original deadline would turn the deploy into
+            # a client-visible error
+            lane.request.deadline = max(lane.request.deadline,
+                                        fresh_deadline)
+            reqs.append(lane.request)
+        with self._cond:
+            for req in reversed(reqs):
+                self._queue.appendleft(req)
+                self._queued_tokens += req.true_len
+                self._queued_pages += req.pages
+            self.metrics.set_gauge("queue_depth", len(self._queue))
+            self.metrics.set_gauge("queue_tokens", self._queued_tokens)
+            if self._paged:
+                self.metrics.set_gauge("queue_pages",
+                                       self._queued_pages)
+        self.metrics.inc("requests_requeued_for_swap", len(reqs))
+
     # ------------------------------------------------------------------ client
     def submit(self, prompt, n_new):
         """Queue one prompt ((s,) ints) for ``n_new`` greedy tokens;
@@ -1028,13 +1235,16 @@ class LMEngine(Logger):
             self._cond.notify()
         return req.future
 
-    def generate(self, prompts, n_new):
+    def generate(self, prompts, n_new, return_versions=False):
         """Decode a whole (b, s) prompt batch; returns (b, s + n_new)
         int32 — prompt plus greedy continuation per row (rows decode
-        concurrently across slots).  All-or-nothing: if a later row is
-        refused (Overloaded/...), the rows already queued are CANCELLED
-        instead of decoding to discarded results — a rejected batch must
-        not keep consuming slots exactly when the engine is overloaded."""
+        concurrently across slots; with ``return_versions`` also the
+        ``weights_version`` that served each row — rows straddling a
+        hot swap carry different stamps).  All-or-nothing: if a later
+        row is refused (Overloaded/...), the rows already queued are
+        CANCELLED instead of decoding to discarded results — a rejected
+        batch must not keep consuming slots exactly when the engine is
+        overloaded."""
         prompts = numpy.asarray(prompts, numpy.int32)
         futures = []
         try:
@@ -1048,7 +1258,10 @@ class LMEngine(Logger):
             for f in futures:
                 self._cancel(f.request)
             raise
-        return numpy.concatenate([prompts, news], axis=1)
+        out = numpy.concatenate([prompts, news], axis=1)
+        if return_versions:
+            return out, [getattr(f, "version", None) for f in futures]
+        return out
 
     def _cancel(self, req):
         """Withdraw a request: dequeue it if still queued; if already in
@@ -1243,6 +1456,11 @@ class LMEngine(Logger):
         queue head (FIFO — retried next tick as lanes free pages, shed
         at its deadline) instead of wedging or being skipped."""
         import jax.numpy as jnp
+        if self._pending_swap is not None:
+            # a finish-on-old swap is quiescing: admitting now would
+            # extend old-weights serving indefinitely — the queue
+            # waits the (bounded) remaining lane ticks instead
+            return
         self._pool_blocked = False
         while self._free:
             with self._cond:
@@ -1721,13 +1939,12 @@ class LMEngine(Logger):
             lane.pages = []
             self._update_pool_gauges()
 
-    def _teardown_slot(self, slot, lane, exc=None):
-        """THE failure/cancellation teardown (every fault path funnels
-        here so none can forget a step): release the lane's trie pins,
-        clear and free the slot, park the step position at 0 (a free
-        slot's garbage writes land where the next admission overwrites
-        them), and fail — or, when ``exc`` is None, cancel — the
-        request's future."""
+    def _vacate_slot(self, slot, lane):
+        """Release a lane's trie pins/pages and free its slot WITHOUT
+        touching the request future — finish, teardown and the swap
+        requeue all funnel here so none can forget a step.  The step
+        position parks at 0 (a free slot's garbage writes land where
+        the next admission overwrites them)."""
         self._release_lane(lane)
         self._lanes[slot] = None
         if slot not in self._free:
@@ -1736,6 +1953,11 @@ class LMEngine(Logger):
         self._last[slot] = 0
         if self._paged:
             self._page_tables[slot, :] = KVPagePool.SCRATCH
+
+    def _teardown_slot(self, slot, lane, exc=None):
+        """THE failure/cancellation teardown: vacate the slot and fail
+        — or, when ``exc`` is None, cancel — the request's future."""
+        self._vacate_slot(slot, lane)
         fut = lane.request.future
         if exc is None:
             fut.cancel()
@@ -1744,15 +1966,12 @@ class LMEngine(Logger):
 
     def _finish(self, slot):
         lane = self._lanes[slot]
-        self._lanes[slot] = None
-        self._free.append(slot)
-        self._pos[slot] = 0
-        self._last[slot] = 0
-        if self._paged:
-            self._page_tables[slot, :] = KVPagePool.SCRATCH
-        self._release_lane(lane)
+        self._vacate_slot(slot, lane)
         fut = lane.request.future
         if not fut.cancelled():          # withdrawn mid-decode
+            # stamped with the generation that produced these tokens —
+            # the mixed-fleet attribution a rolling deploy needs
+            fut.version = self.weights_version
             fut.set_result(numpy.asarray(lane.emitted, numpy.int32))
 
     def _fail_active(self, active, exc):
@@ -1906,6 +2125,7 @@ class LMEngine(Logger):
                     self._fail_active(
                         [i for i, ln in enumerate(self._lanes)
                          if ln is not None], e)
+            self._maybe_apply_swap()
             self._admit()
             busy = [i for i, lane in enumerate(self._lanes)
                     if lane is not None]
@@ -1947,6 +2167,13 @@ class LMEngine(Logger):
             self._queue.clear()
             self._queued_tokens = 0
             self._queued_pages = 0
+            swap = self._pending_swap
+            self._pending_swap = None
+        if swap is not None:
+            # never strand a swap_weights caller on a stopping engine
+            swap["exc"] = RuntimeError("LM engine stopped before the "
+                                       "swap applied")
+            swap["done"].set()
         for req in pending:
             req.future.set_exception(RuntimeError("LM engine stopped"))
         for slot, lane in enumerate(self._lanes):

@@ -1,0 +1,254 @@
+"""Fleet-level model management (ISSUE 11) — the publisher loop that
+closes the trainer→serving gap.
+
+The training stack publishes checkpoints through ``snapshotter.py``
+(atomic temp-file + fsync + rename writes, a stable ``*_current.*``
+pointer); the serving stack can hot-swap a live fleet through
+``Router.deploy`` / ``LMEngine.swap_weights``.  Until now a human
+connected the two.  :class:`ModelManager` is that human, as a loop:
+
+- WATCH a snapshot directory on a cadence
+  (``snapshotter.find_current`` — the same resolver ``--snapshot
+  auto`` uses, so the manager follows exactly what a resumed run
+  would), keyed by (path, mtime) so each published file is acted on
+  once;
+- VALIDATE + LOAD the checkpoint OFF the hot path: the default
+  :func:`load_lm_params` unpickles the payload (the snapshotter's
+  loader already rejects truncated/corrupt files loudly — and its
+  atomic writes mean a half-written file can never be seen at all),
+  digs the portable LM param tree out of the trainer unit's state,
+  and :func:`validate_lm_params` refuses non-finite weights before
+  they get near a serving engine;
+- DEPLOY through ``Router.deploy`` (canary-first, parity-probed,
+  auto-rollback — see ``serving/router.py``) or, for a bare engine,
+  ``LMEngine.swap_weights`` — either way the decode loop never sees
+  the load/validate cost, and a bad checkpoint is a rejected record
+  plus a warning, never an outage.
+
+Wired as ``serve_lm(model_dir=, canary=, auto_rollback=)`` and the CLI
+``--serve-model-dir`` / ``--serve-canary`` /
+``--serve-publish-interval`` flags: a trainer writing snapshots into a
+directory and a fleet pointed at it is the whole continuous
+training→serving loop, end to end.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+import numpy
+
+from veles_tpu.logger import Logger
+from veles_tpu.serving.metrics import ServingMetrics
+
+
+def validate_lm_params(params):
+    """Refuse a checkpoint whose weights could poison a fleet: the
+    tree must look like a portable LM param tree (``embed`` +
+    ``blocks``) and every array leaf must be finite.  Raises
+    ValueError naming the offense; returns the leaf count when
+    sound.  Structural compatibility with the SERVING tree (shapes,
+    dtypes) is the swap's own check — this one catches what a swap
+    cannot: a numerically-exploded checkpoint that would swap cleanly
+    and serve garbage."""
+    if not isinstance(params, dict) or "embed" not in params \
+            or "blocks" not in params:
+        raise ValueError(
+            "not an LM param tree (need 'embed' and 'blocks' keys, "
+            "got %s)" % (sorted(params) if isinstance(params, dict)
+                         else type(params).__name__))
+    from jax.tree_util import keystr, tree_flatten_with_path
+    leaves, _ = tree_flatten_with_path(params)
+    for path, leaf in leaves:
+        arr = numpy.asarray(leaf)
+        if arr.dtype.kind == "f" and not numpy.isfinite(arr).all():
+            raise ValueError("param %s holds non-finite values — "
+                             "refusing to publish" % keystr(path))
+    return len(leaves)
+
+
+def load_lm_params(path):
+    """Extract the portable LM param tree from a snapshotter payload:
+    scan the workflow state's units for the transformer trainer's
+    ``state_dict`` (``{"params": {"embed": ..., "blocks": [...]}}`` —
+    the same portable form ``serve_lm`` marshals at startup).
+    Returns ``(params, payload)``; raises ValueError when no LM
+    trainer state is present (a non-LM workflow's snapshot directory
+    is a configuration error, not something to retry)."""
+    from veles_tpu import snapshotter
+    payload = snapshotter.import_(path)
+    units = payload.get("state", {}).get("units", {})
+    for state in units.values():
+        params = state.get("params") if isinstance(state, dict) else None
+        if isinstance(params, dict) and "embed" in params \
+                and "blocks" in params:
+            return params, payload
+    raise ValueError(
+        "no LM trainer params found in snapshot %s (units: %s) — is "
+        "this an LM workflow's snapshot directory?"
+        % (path, sorted(units) or "none"))
+
+
+class ModelManager(Logger):
+    """Watch ``model_dir`` and drive ``target`` (a Router, or a bare
+    LMEngine) to the newest published checkpoint; see the module
+    docstring.  ``start()`` polls every ``interval_s`` on a background
+    thread; :meth:`poll_once` is public and synchronous so tests and
+    operators can drive one watch→validate→deploy pass
+    deterministically.
+
+    ``load(path) -> params | (params, payload)`` and
+    ``validate(params)`` override the checkpoint reader and the
+    pre-deploy validation; ``canary`` / ``canary_fraction`` /
+    ``watch_s`` / ``auto_rollback`` / ``drain`` / ``probe_prompt`` /
+    ``probe_n_new`` forward to ``Router.deploy``.  Versions count up
+    from the fleet's current ``weights_version``; a rolled-back
+    deploy burns its number (the gauge history stays monotone)."""
+
+    def __init__(self, target, model_dir, interval_s=5.0, canary=1,
+                 canary_fraction=0.25, watch_s=0.0, auto_rollback=True,
+                 drain=False, prefix=None, load=None, validate=None,
+                 probe_prompt=(1, 2, 3), probe_n_new=4,
+                 name="lm_publisher", metrics=None):
+        self.name = name
+        self.target = target
+        self.model_dir = model_dir
+        self.interval_s = float(interval_s)
+        self.canary = int(canary)
+        self.canary_fraction = float(canary_fraction)
+        self.watch_s = float(watch_s)
+        self.auto_rollback = bool(auto_rollback)
+        self.drain = bool(drain)
+        self.prefix = prefix
+        self._load = load or load_lm_params
+        self._validate = validate or validate_lm_params
+        self.probe_prompt = tuple(probe_prompt)
+        self.probe_n_new = int(probe_n_new)
+        self.metrics = metrics or getattr(target, "metrics", None) \
+            or ServingMetrics(name)
+        replicas = getattr(target, "replicas", [target])
+        self._version = max(
+            int(getattr(e, "weights_version", 0) or 0)
+            for e in replicas)
+        self._seen = None          # (path, mtime) last acted on
+        #: the last poll's outcome record (deploy result / rejection)
+        self.last_record = None
+        self._stop = threading.Event()
+        self._thread = None
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self):
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name="publisher-%s" % self.name)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=60)
+            self._thread = None
+
+    def _loop(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.poll_once()
+            except Exception as e:   # noqa: BLE001 — loop must survive
+                self.warning("publisher pass failed: %s", e)
+
+    # ------------------------------------------------------------- the pass
+    def poll_once(self):
+        """One watch→validate→deploy pass.  Returns None when nothing
+        new was published, otherwise a record dict: the deploy's own
+        record (plus ``path``/``epoch``/``load_s``), or ``{"rejected":
+        reason}`` for a checkpoint that failed validation.  A bad file
+        is remembered as seen — the manager never hot-loops on it —
+        but a TRANSIENT deploy failure (no live replicas, a racing
+        deploy) is forgotten so the same checkpoint retries at the
+        next poll."""
+        from veles_tpu import snapshotter
+        path = snapshotter.find_current(self.model_dir, self.prefix)
+        if path is None:
+            return None
+        try:
+            st = os.stat(path)
+        except OSError:
+            return None              # pruned between listdir and stat
+        # nanosecond mtime + size: the *_current.* path never changes,
+        # and two publishes inside one coarse-mtime tick must still
+        # read as distinct
+        key = (path, st.st_mtime_ns, st.st_size)
+        if key == self._seen:
+            return None
+        self._seen = key
+        t0 = time.monotonic()
+        epoch = None
+        try:
+            loaded = self._load(path)
+            params, payload = loaded if isinstance(loaded, tuple) \
+                else (loaded, None)
+            if payload is not None:
+                epoch = payload.get("epoch")
+            self._validate(params)
+        except OSError as e:
+            # transient I/O (flaky mount, file replaced mid-read):
+            # forget the key so the next poll retries — a BAD file is
+            # a ValueError from the loader/validator, never OSError
+            self._seen = None
+            self.metrics.inc("publish_retries")
+            self.warning("checkpoint %s unreadable (%s): retrying "
+                         "next poll", path, e)
+            return {"path": path, "deployed": False, "retry": str(e)}
+        except Exception as e:   # noqa: BLE001 — reject, keep serving
+            self.metrics.inc("publish_rejected")
+            self.warning("checkpoint %s rejected: %s", path, e)
+            self.last_record = {"path": path, "deployed": False,
+                                "rejected": str(e)}
+            return self.last_record
+        self._version += 1
+        version = self._version
+        self.info("publishing %s as v%d (epoch %s)", path, version,
+                  epoch)
+        try:
+            if hasattr(self.target, "deploy"):
+                rec = self.target.deploy(
+                    params, version=version, canary=self.canary,
+                    canary_fraction=self.canary_fraction,
+                    watch_s=self.watch_s,
+                    auto_rollback=self.auto_rollback, drain=self.drain,
+                    probe_prompt=self.probe_prompt,
+                    probe_n_new=self.probe_n_new)
+                rec = dict(rec, deployed=not rec.get("rolled_back"))
+            else:
+                self.target.swap_weights(params, version=version,
+                                         drain=self.drain)
+                rec = {"version": version, "deployed": True,
+                       "rolled_back": False}
+        except ValueError as e:
+            # structurally impossible for THIS fleet — permanent for
+            # this file, stays seen (no hot-loop)
+            self.metrics.inc("publish_rejected")
+            self.warning("swap of %s refused: %s", path, e)
+            rec = {"version": version, "deployed": False,
+                   "rejected": str(e)}
+        except Exception as e:   # noqa: BLE001 — transient, retry
+            # a TRANSIENT deploy failure (fleet momentarily all
+            # quarantined, another deploy in flight) must not burn the
+            # checkpoint: forget it so the next poll retries — else
+            # the last checkpoint of a finished run could be lost
+            self._seen = None
+            self.metrics.inc("publish_retries")
+            self.warning("deploy of %s failed (%s): retrying next "
+                         "poll", path, e)
+            self.last_record = {"path": path, "deployed": False,
+                                "retry": str(e)}
+            return self.last_record
+        rec.update(path=path, epoch=epoch,
+                   load_s=round(time.monotonic() - t0, 4))
+        self.metrics.inc("publishes_total")
+        self.last_record = rec
+        return rec

@@ -65,6 +65,26 @@ contract:
   docstring for the state machine (also documented in USAGE.md
   "Failure semantics").
 
+ZERO-DOWNTIME WEIGHT UPDATES (ISSUE 11, :meth:`Router.deploy`) roll a
+new checkpoint across the fleet canary-first: one replica leaves the
+rotation (pending work drains onto the survivors through the exact
+path above), hot-swaps via ``LMEngine.swap_weights`` (structural
+mismatch → the deploy auto-rolls back before any client saw the new
+weights), answers a PARITY PROBE whose expected continuation is
+computed from the new weights themselves (a swap that serves anything
+else is corrupt), then rejoins with a configurable traffic fraction
+steered at it while the deploy WATCHES the same live signals the
+:class:`HealthChecker` reads — decode-step/TTFT EWMAs vs the fleet,
+the error counters, and the health circuit itself (a canary the
+checker quarantines mid-watch rolls back).  Healthy canaries ramp to
+the rest of the fleet in ``ramp``-sized groups; anything else swaps
+the canary back to the previous version.  Evidence:
+``weights_version{replica=}`` gauges, ``deploys_total`` /
+``rollbacks_total`` counters, and every reply stamped with the
+``weights_version`` that produced it (mixed fleets are attributable
+mid-rollout).  ``serving/model_manager.py`` drives this loop from a
+snapshot directory.
+
 The router's own :class:`ServingMetrics` meters placement
 (``routed_requests{replica="i"}`` labeled counters, ``requeued``,
 rejected), the resilience layer (``requests_retried``,
@@ -167,7 +187,8 @@ class _Job:
     engine-side placements."""
 
     __slots__ = ("prompt", "n_new", "future", "t0", "replica", "live",
-                 "requeues", "retries", "hedged", "last_exc")
+                 "requeues", "retries", "hedged", "last_exc", "version",
+                 "delivered")
 
     def __init__(self, prompt, n_new):
         self.prompt = prompt
@@ -178,6 +199,12 @@ class _Job:
         #: replica of the newest placement (the WINNING attempt's after
         #: delivery) — what restful_api stamps into ``"replicas"``
         self.replica = None
+        #: the weights_version that produced the delivered tokens
+        #: (ISSUE 11) — what restful_api stamps into "weights_version"
+        self.version = None
+        #: delivery claim (router lock): exactly one attempt stamps
+        #: replica/version and resolves the future
+        self.delivered = False
         #: live attempts (guarded by the router lock)
         self.live = set()
         self.requeues = 0
@@ -231,8 +258,16 @@ class Router(Logger):
         self._stopping = False
         self._hedge_thread = None
         self._hedge_wake = threading.Event()
+        #: canary traffic steering (ISSUE 11): while a deploy watches
+        #: its canary, placement prefers the canary set with this
+        #: probability and the rest of the fleet otherwise
+        self._canary = frozenset()
+        self._canary_fraction = 0.0
+        self._deploy_lock = threading.Lock()
         self.metrics.set_gauge("replicas_total", len(replicas))
         self.metrics.set_gauge("replicas_live", len(replicas))
+        for i in range(len(replicas)):
+            self._note_version(i)
 
     # ----------------------------------------------------------- properties
     @property
@@ -318,20 +353,41 @@ class Router(Logger):
                       / kv_total) * step
         return score
 
+    def _note_version(self, i):
+        """Export replica i's serving checkpoint generation as the
+        ``weights_version{replica=}`` gauge (ISSUE 11)."""
+        v = getattr(self.replicas[i], "weights_version", None)
+        if isinstance(v, (int, float)):
+            self.metrics.set_gauge("weights_version", v,
+                                   labels={"replica": str(i)})
+
     def _order(self):
-        """Live replica indices, best placement first."""
+        """Live replica indices, best placement first.  While a deploy
+        watches a canary, a seeded coin steers ``_canary_fraction`` of
+        placements to the canary set first (the rest of the fleet
+        remains the admission fallback either way)."""
         with self._lock:
             live = [i for i, ok in enumerate(self._live) if ok]
             if self.policy == "round_robin":
                 self._rr += 1
                 start = self._rr
             routed = list(self._routed)
+            canary = self._canary
+            pick_canary = bool(canary) and \
+                self._rng.random_sample() < self._canary_fraction
         if not live:
             raise NoLiveReplicas()
         if self.policy == "round_robin":
-            return [live[(start + j) % len(live)]
-                    for j in range(len(live))]
-        return sorted(live, key=lambda i: (self._score(i), routed[i], i))
+            order = [live[(start + j) % len(live)]
+                     for j in range(len(live))]
+        else:
+            order = sorted(live,
+                           key=lambda i: (self._score(i), routed[i], i))
+        if canary:
+            order = ([i for i in order if (i in canary) == pick_canary]
+                     + [i for i in order
+                        if (i in canary) != pick_canary])
+        return order
 
     def submit(self, prompt, n_new):
         """Queue one prompt on the best replica; returns a Future for
@@ -506,13 +562,23 @@ class Router(Logger):
 
     def _deliver(self, job, att, result):
         """First settled attempt wins; the set_result race (two
-        attempts completing concurrently) is decided by the Future's
-        own state transition."""
+        attempts completing concurrently) is decided under the router
+        lock: exactly ONE attempt claims delivery and stamps
+        replica/version — a losing hedge sibling must never overwrite
+        the winner's stamps (during a canary deploy the two replicas
+        can serve different weights_version)."""
+        with self._lock:
+            if job.delivered or job.future.done():
+                return
+            job.delivered = True
+            # stamped BEFORE the result resolves so a waiter unblocked
+            # by set_result reads the WINNING attempt's stamps
+            job.replica = att.replica
+            job.version = getattr(att.engine_future, "version", None)
         try:
             job.future.set_result(result)
-        except Exception:   # noqa: BLE001 — a sibling already won
+        except Exception:   # noqa: BLE001 — cancelled/settled meanwhile
             return
-        job.replica = att.replica
         if att.is_hedge:
             self.metrics.inc("hedge_wins")
         self.metrics.record_response(time.monotonic() - job.t0)
@@ -639,11 +705,14 @@ class Router(Logger):
                     pass
 
     # --------------------------------------------------------------- client
-    def generate(self, prompts, n_new, return_replicas=False):
+    def generate(self, prompts, n_new, return_replicas=False,
+                 return_versions=False):
         """Decode a (b, s) prompt batch across the fleet; returns
-        (b, s + n_new) int32 (and, with ``return_replicas``, the
-        replica index that served each row).  All-or-nothing sibling
-        cancellation, exactly like ``LMEngine.generate``."""
+        (b, s + n_new) int32 (with ``return_replicas`` also the
+        replica index that served each row, with ``return_versions``
+        the ``weights_version`` each row decoded under — mixed during
+        a rolling deploy).  All-or-nothing sibling cancellation,
+        exactly like ``LMEngine.generate``."""
         prompts = numpy.asarray(prompts, numpy.int32)
         futures = []
         try:
@@ -655,8 +724,13 @@ class Router(Logger):
                 self.cancel(f)
             raise
         out = numpy.concatenate([prompts, news], axis=1)
+        extras = []
         if return_replicas:
-            return out, [f.job.replica for f in futures]
+            extras.append([f.job.replica for f in futures])
+        if return_versions:
+            extras.append([f.job.version for f in futures])
+        if extras:
+            return (out, *extras)
         return out
 
     def cancel(self, future):
@@ -745,6 +819,278 @@ class Router(Logger):
             live_now = sum(1 for ok in self._live if ok)
         self.metrics.set_gauge("replicas_live", live_now)
 
+    # -------------------------------------------------------------- deploy
+    def deploy(self, params, version=None, canary=1,
+               canary_fraction=0.25, ramp=0, watch_s=0.0,
+               watch_slow_ratio=5.0, probe=None, probe_prompt=(1, 2, 3),
+               probe_n_new=4, probe_timeout_s=60.0, drain=False,
+               checker=None, auto_rollback=True, swap_timeout_s=120.0):
+        """Roll ``params`` (a portable LM param tree matching the
+        fleet's structure) across the fleet canary-first; see the
+        module docstring for the state flow.  Returns a record dict —
+        ``{"version", "swapped", "rolled_back", "reason", ...}`` —
+        and never raises for a bad canary when ``auto_rollback`` (the
+        rollback IS the result); a structurally impossible tree
+        surfaces as a rolled-back record too, since the old weights
+        never stopped serving.
+
+        ``canary``: replicas swapped (and probed) before any traffic
+        ramp; >= the live fleet size means a plain rolling update.
+        ``canary_fraction``: share of placements steered at the canary
+        during the ``watch_s`` observation window.  ``ramp``: fleet
+        replicas swapped per round after the canary passes (0 = rest
+        at once).  ``probe``: ``(prompt, expected_tokens)`` known-good
+        pair — default None computes the expected continuation from
+        ``params`` itself via ``ops.transformer.generate`` (off the
+        hot path; catches a swap that serves anything but the new
+        weights); ``False`` disables the probe.  ``checker``: a
+        :class:`HealthChecker` whose circuit state the watch phase
+        also consults — a canary it quarantines (via its synchronous
+        ``step()`` or its thread) rolls the deploy back.  ``drain``
+        is forwarded to ``swap_weights`` (True replaces in-flight
+        lanes on the new weights instead of finishing them on the
+        old)."""
+        if not self._deploy_lock.acquire(blocking=False):
+            raise RuntimeError("another deploy is already in flight")
+        try:
+            return self._deploy(params, version, canary,
+                                canary_fraction, ramp, watch_s,
+                                watch_slow_ratio, probe, probe_prompt,
+                                probe_n_new, probe_timeout_s, drain,
+                                checker, auto_rollback, swap_timeout_s)
+        finally:
+            self._deploy_lock.release()
+
+    def _deploy(self, params, version, canary, canary_fraction, ramp,
+                watch_s, watch_slow_ratio, probe, probe_prompt,
+                probe_n_new, probe_timeout_s, drain, checker,
+                auto_rollback, swap_timeout_s):
+        with self._lock:
+            live = [i for i, ok in enumerate(self._live) if ok]
+        if not live:
+            raise NoLiveReplicas()
+        if version is None:
+            version = 1 + max(
+                int(getattr(e, "weights_version", 0) or 0)
+                for e in self.replicas)
+        version = int(version)
+        self.metrics.inc("deploys_total")
+        record = {"version": version, "canary": [], "swapped": [],
+                  "rolled_back": False, "reason": None,
+                  "probe_ok": None, "completed": False}
+        prev = {}       # i -> (old params, old version) for rollback
+        pulled = set()  # replicas deploy unregistered and still holds
+        expected = self._probe_expected(params, probe, probe_prompt,
+                                        probe_n_new)
+        canaries = live[:max(0, min(int(canary), len(live)))]
+        rest = [i for i in live if i not in canaries]
+        record["canary"] = list(canaries)
+
+        def fail(why, bad=None):
+            """``bad`` names a replica PROVEN to serve wrong output
+            (failed parity probe): without auto-rollback it must stay
+            out of rotation — clients never reach it."""
+            if auto_rollback:
+                self._rollback(prev, pulled, record, why, drain,
+                               swap_timeout_s)
+            else:
+                record["reason"] = why
+                record["needs_attention"] = True
+                if bad is not None:
+                    record["quarantined"] = [bad]
+                for i in sorted(pulled):
+                    if i != bad:
+                        self.reregister(i)
+                pulled.clear()
+            return record
+
+        for i in canaries:
+            ok, why, bad = self._swap_replica(
+                i, params, version, expected, drain, prev, pulled,
+                record, probe_timeout_s, swap_timeout_s)
+            if not ok:
+                return fail(why, bad=i if bad else None)
+        if canaries and rest:
+            with self._lock:
+                self._canary = frozenset(canaries)
+                self._canary_fraction = float(canary_fraction)
+            try:
+                healthy, why = self._watch_canary(
+                    canaries, watch_s, watch_slow_ratio, checker)
+            finally:
+                with self._lock:
+                    self._canary = frozenset()
+                    self._canary_fraction = 0.0
+            if not healthy:
+                return fail(why)
+        group = max(1, int(ramp)) if ramp else max(1, len(rest))
+        for g0 in range(0, len(rest), group):
+            for i in rest[g0:g0 + group]:
+                ok, why, bad = self._swap_replica(
+                    i, params, version, expected, drain, prev, pulled,
+                    record, probe_timeout_s, swap_timeout_s)
+                if not ok:
+                    return fail(why, bad=i if bad else None)
+        record["completed"] = True
+        self.info("deploy v%d complete: %d replica(s) swapped "
+                  "(canary %s)", version, len(record["swapped"]),
+                  canaries)
+        return record
+
+    def _probe_expected(self, params, probe, probe_prompt, probe_n_new):
+        """The parity probe's (prompt, known-good continuation): the
+        caller's pair, or computed from the NEW params with the
+        fleet's own decode config via the reference ``generate`` —
+        off the hot path, so a correctly-swapped canary must
+        reproduce it bit-exactly."""
+        if probe is False or not probe_n_new:
+            return None
+        if probe is not None:
+            prompt, want = probe
+            return list(prompt), numpy.asarray(want, numpy.int32)
+        import jax.numpy as jnp
+        from veles_tpu.ops.transformer import generate
+        e0 = self.replicas[0]
+        prompt = list(probe_prompt)
+        row = numpy.asarray(generate(
+            params, jnp.asarray([prompt], jnp.int32),
+            int(probe_n_new), e0.n_heads, temperature=0.0,
+            max_len=e0.max_len, rope=e0.rope, window=e0.window,
+            sinks=e0.sinks))[0]
+        return prompt, numpy.asarray(row[len(prompt):], numpy.int32)
+
+    def _swap_replica(self, i, params, version, expected, drain, prev,
+                      pulled, record, probe_timeout_s, swap_timeout_s):
+        """Swap ONE replica out of rotation: unregister (pending work
+        drains onto the survivors — the exactly-once path), hot-swap,
+        parity-probe straight at the engine (no client traffic can
+        reach bad weights), then rejoin.  A solo fleet skips the
+        unregister — swap_weights alone keeps its lanes whole, at the
+        cost of a brief no-isolation window the docstring owns up to.
+        Returns ``(ok, why, bad)`` — ``bad`` True only when the
+        replica was PROVEN to serve wrong output (failed probe), the
+        one case it must never rejoin unrestored."""
+        engine = self.replicas[i]
+        prev.setdefault(i, (engine.params,
+                            getattr(engine, "weights_version", 0)))
+        with self._lock:
+            solo = sum(1 for ok in self._live if ok) <= 1
+            was_live = self._live[i]
+        if was_live and not solo:
+            self.unregister(i, reason="deploy v%d" % version)
+            pulled.add(i)
+        try:
+            engine.swap_weights(params, version=version, drain=drain,
+                                timeout_s=swap_timeout_s)
+        except Exception as e:   # noqa: BLE001 — old weights serving
+            return False, ("swap refused on replica %d: %s"
+                           % (i, e)), False
+        self._note_version(i)
+        record["swapped"].append(i)
+        if expected is not None:
+            ok = self._parity_probe(engine, expected, probe_timeout_s)
+            record["probe_ok"] = ok
+            if not ok:
+                # the replica serves WRONG output for the new weights:
+                # leave it out of rotation until the rollback restores
+                # the old ones
+                return False, ("parity probe failed on replica %d "
+                               "(v%d output != known-good)"
+                               % (i, version)), True
+        if i in pulled:
+            self.reregister(i)
+            pulled.discard(i)
+        return True, None, False
+
+    def _parity_probe(self, engine, expected, timeout_s):
+        prompt, want = expected
+        try:
+            out = engine.submit(prompt, len(want)).result(
+                timeout=timeout_s)
+        except Exception as e:   # noqa: BLE001 — any failure = not ok
+            self.warning("deploy parity probe errored: %s", e)
+            return False
+        return numpy.array_equal(numpy.asarray(out, numpy.int32), want)
+
+    def _watch_canary(self, canaries, watch_s, slow_ratio, checker):
+        """Observe the canary set for ``watch_s`` against the SAME
+        live signals the health layer reads: quarantine (ours or the
+        checker's circuit), new engine errors, and decode-step/TTFT
+        EWMAs beyond ``slow_ratio``× the rest of the fleet."""
+        base_err = {i: self.replicas[i].metrics.errors
+                    for i in canaries}
+        deadline = time.monotonic() + max(0.0, float(watch_s))
+        while True:
+            with self._lock:
+                others = [j for j, ok in enumerate(self._live)
+                          if ok and j not in canaries]
+            for i in canaries:
+                with self._lock:
+                    live = self._live[i]
+                if not live:
+                    return False, ("canary %d was quarantined during "
+                                   "the watch window" % i)
+                if checker is not None \
+                        and checker.states()[i] != checker.HEALTHY:
+                    return False, ("canary %d health circuit is not "
+                                   "closed" % i)
+                m = self.replicas[i].metrics
+                if m.errors > base_err[i]:
+                    return False, ("canary %d errored during the "
+                                   "watch window (%d new error(s))"
+                                   % (i, m.errors - base_err[i]))
+                for sig in ("decode_step", "ttft"):
+                    mine = m.ewma(sig, 0.0)
+                    ref = sorted(self.replicas[j].metrics.ewma(sig,
+                                                               0.0)
+                                 for j in others)
+                    ref = [r for r in ref if r > 0.0]
+                    if mine and ref \
+                            and mine > slow_ratio * ref[len(ref) // 2]:
+                        return False, (
+                            "canary %d %s EWMA %.4fs exceeds %.1fx "
+                            "the fleet median %.4fs"
+                            % (i, sig, mine, slow_ratio,
+                               ref[len(ref) // 2]))
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return True, None
+            time.sleep(min(0.05, remaining))
+
+    def _rollback(self, prev, pulled, record, why, drain,
+                  swap_timeout_s):
+        """Swap every replica that ACTUALLY swapped (``record
+        ["swapped"]`` — the authoritative list; version-number equality
+        is not, since a deploy may legitimately reuse the current
+        number) back to its retained previous params.  A replica whose
+        rollback swap itself fails stays OUT of rotation — bad weights
+        must never rejoin."""
+        record["rolled_back"] = True
+        record["reason"] = why
+        self.metrics.inc("rollbacks_total")
+        self.warning("deploy v%s rolling back: %s", record["version"],
+                     why)
+        restored = set()
+        for i in record["swapped"]:
+            old_params, old_version = prev[i]
+            try:
+                self.replicas[i].swap_weights(
+                    old_params, version=old_version, drain=drain,
+                    timeout_s=swap_timeout_s)
+            except Exception as e:   # noqa: BLE001 — stays quarantined
+                self.warning(
+                    "rollback of replica %d to v%s FAILED (%s): "
+                    "leaving it out of rotation", i, old_version, e)
+                continue
+            self._note_version(i)
+            restored.add(i)
+        for i in sorted(pulled):
+            # a refused swap never installed anything (safe to rejoin);
+            # a swapped replica rejoins only once its restore succeeded
+            if i not in record["swapped"] or i in restored:
+                self.reregister(i)
+        pulled.clear()
+
     # ------------------------------------------------------------- evidence
     def routed_counts(self):
         """Requests placed per replica (including requeues, retries and
@@ -788,7 +1134,12 @@ class HealthChecker(Logger):
     bucket on the non-chunked path can take seconds on CPU), which is
     indistinguishable from a wedge from out here — set ``stall_s``
     above the worst first-compile, or serve with ``prefill_chunk``
-    (every program warmed at start) as production does.
+    (every program warmed at start) as production does.  The PROBE's
+    own bucket is immune either way: :meth:`start` runs
+    :meth:`warm_probes` first, so the synthetic probe's first compile
+    happens before the monitoring clock starts and can never count as
+    a probe timeout (drive :meth:`step` by hand without
+    :meth:`start`? call ``warm_probes()`` yourself first).
 
     ``step()`` is public and synchronous: tests and the chaos harness
     drive the state machine deterministically without the thread;
@@ -820,13 +1171,48 @@ class HealthChecker(Logger):
         self._reopen_at = [0.0] * n
         self._last_progress = [now] * n
         self._last_counts = [None] * n
+        self._warmed = False
         self._stop = threading.Event()
         self._thread = None
         for i in range(n):
             self._set_state(i, self.HEALTHY)
 
     # ------------------------------------------------------------ lifecycle
+    def warm_probes(self, timeout_s=60.0):
+        """Run one synthetic probe against every replica BEFORE
+        monitoring begins, so the probe prompt's first compile
+        (seconds on CPU for a never-seen bucket) happens here instead
+        of inside a ``probe_timeout_s`` window where it would count as
+        a failure and walk an innocent replica toward quarantine (the
+        stall_s sizing foot-gun the class docstring warns about).
+        Failures are logged, never counted; the progress clocks reset
+        afterwards so warm-up wall time cannot read as a stall."""
+        for i, engine in enumerate(self.router.replicas):
+            fut = None
+            try:
+                fut = engine.submit([self.probe_token], 1)
+                fut.result(timeout=timeout_s)
+            except Exception as e:   # noqa: BLE001 — warm-up only
+                try:
+                    if fut is not None:
+                        engine._cancel(fut.request)
+                except Exception:   # noqa: BLE001 — best-effort
+                    pass
+                self.warning("probe warm-up failed on replica %d: %s",
+                             i, e)
+        now = time.monotonic()
+        for i in range(len(self.router.replicas)):
+            self._last_progress[i] = now
+            self._last_counts[i] = None
+        self._warmed = True
+        self.metrics.inc("health_probe_warmups")
+        return self
+
     def start(self):
+        """Start the background monitor.  Returns immediately: the
+        warm-up probes run as the checker THREAD's first act (before
+        any scan), so a wedged-at-boot replica delays its own
+        quarantine, never the server's startup."""
         self._stop.clear()
         self._thread = threading.Thread(
             target=self._loop, daemon=True,
@@ -842,6 +1228,11 @@ class HealthChecker(Logger):
             self._thread = None
 
     def _loop(self):
+        if not self._warmed:
+            try:
+                self.warm_probes()
+            except Exception as e:   # noqa: BLE001 — warm-up only
+                self.warning("probe warm-up failed: %s", e)
         while not self._stop.wait(self.interval_s):
             try:
                 self.step()

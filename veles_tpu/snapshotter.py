@@ -27,6 +27,7 @@ import os
 import pickle
 import shutil
 import time
+import zlib
 
 from veles_tpu.mutable import Bool
 from veles_tpu.units import Unit
@@ -52,6 +53,26 @@ def _open_for(path, mode):
 def _open_for_suffix(path, compression):
     """Open with an EXPLICIT codec (path may carry a .tmp suffix)."""
     return _OPENERS[compression](path, "wb")
+
+
+def _fsync_path(path):
+    """fsync one file (and best-effort its directory) so the rename
+    that publishes it cannot be reordered past the data by a crash —
+    the atomic-write contract the serving model_manager depends on: a
+    published snapshot is ALWAYS complete, never a torn page short."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+    try:
+        dfd = os.open(os.path.dirname(path) or ".", os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:            # some filesystems refuse directory fds
+        pass
 
 
 class SnapshotterBase(Unit):
@@ -163,14 +184,19 @@ class SnapshotterToFile(SnapshotterBase):
         name = "%s_%d_%s%s" % (self.prefix, payload["epoch"], tag,
                                self._suffix())
         path = os.path.join(self.directory, name)
-        # serialize+compress ONCE; both files are published atomically so a
-        # crash mid-write never leaves a truncated snapshot behind
+        # serialize+compress ONCE; both files are staged, fsync'd and
+        # published via atomic rename so a crash mid-write (or a power
+        # cut re-ordering the rename past the data) never leaves a
+        # truncated snapshot behind — the loader side (import_) still
+        # rejects any corrupt file loudly as the second line of defense
         tmp = path + ".tmp"
         with _open_for_suffix(tmp, self.compression) as f:
             pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
+        _fsync_path(tmp)
         current = os.path.join(self.directory,
                                "%s_current%s" % (self.prefix, self._suffix()))
         shutil.copyfile(tmp, current + ".tmp")   # streams in chunks
+        _fsync_path(current + ".tmp")
         os.replace(tmp, path)
         os.replace(current + ".tmp", current)
         self.destination = path
@@ -240,12 +266,31 @@ def find_current(directory, prefix=None):
 
 
 def import_(path):
-    """Load a snapshot payload from disk (ref: Snapshotter.import_ [H])."""
-    with _open_for(path, "rb") as f:
-        payload = pickle.load(f)
-    if payload.get("format") != FORMAT:
+    """Load a snapshot payload from disk (ref: Snapshotter.import_ [H]).
+
+    A partial or corrupt file — a torn copy, a bit-flipped archive, a
+    file that is not a snapshot at all — raises a LOUD ValueError
+    naming the file instead of leaking a codec/pickle traceback: the
+    model_manager's publish loop (and any resume) must be able to
+    tell "bad checkpoint, refuse it" from a real I/O bug.  Thanks to
+    the atomic writes above, the snapshotter itself can never publish
+    such a file; this guards against everything else."""
+    # open() failures (missing path, permissions, a directory) are REAL
+    # I/O errors and propagate untouched — only decode/unpickle errors
+    # from reading the stream mean corruption
+    f = _open_for(path, "rb")
+    try:
+        with f:
+            payload = pickle.load(f)
+    except (OSError, EOFError, pickle.UnpicklingError, AttributeError,
+            ImportError, IndexError, lzma.LZMAError, zlib.error) as e:
+        raise ValueError("corrupt or truncated snapshot %s: %s: %s"
+                         % (path, type(e).__name__, e)) from e
+    if not isinstance(payload, dict) or payload.get("format") != FORMAT:
         raise ValueError("unsupported snapshot format %r in %s" %
-                         (payload.get("format"), path))
+                         (payload.get("format")
+                          if isinstance(payload, dict) else
+                          type(payload).__name__, path))
     return payload
 
 
@@ -285,6 +330,7 @@ def save(workflow, path):
     tmp = path + ".tmp"
     with _open_for_suffix(tmp, compression) as f:
         pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
+    _fsync_path(tmp)
     os.replace(tmp, path)
     return path
 
