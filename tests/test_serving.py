@@ -694,6 +694,76 @@ class TestMetrics:
         assert metrics_mod.get("fresh_t") is m2
         assert m2.snapshot()["requests"] == 0
 
+    def test_concurrent_writers_snapshot_and_render(self):
+        """ISSUE 12 satellite: threads hammering inc/observe/set_gauge
+        (labeled and not) while another thread snapshots and renders —
+        no exceptions, counters monotone across successive snapshots,
+        histogram _bucket/_sum/_count families intact with ONE # TYPE
+        line each, and the final totals exact."""
+        from veles_tpu.serving import ServingMetrics
+        from veles_tpu.serving.metrics import render_instances
+        m = ServingMetrics("conc_t")
+        writers, per_writer = 4, 400
+        errors = []
+
+        def hammer(wid):
+            try:
+                for i in range(per_writer):
+                    m.record_enqueue()
+                    m.record_response(0.001 * (i % 7 + 1))
+                    m.record_decode_step(0.002)
+                    m.inc("tokens_out", 2)
+                    m.inc("routed_requests",
+                          labels={"replica": str(wid % 2)})
+                    m.set_gauge("queue_depth", i)
+                    m.set_gauge("queue_depth", i,
+                                labels={"replica": str(wid % 2)})
+                    m.set_gauge_max("queue_depth_peak", i)
+            except Exception as e:   # noqa: BLE001 — the assertion
+                errors.append(e)
+
+        threads = [threading.Thread(target=hammer, args=(w,))
+                   for w in range(writers)]
+        for t in threads:
+            t.start()
+        prev_requests = prev_latency = -1
+        try:
+            while any(t.is_alive() for t in threads):
+                snap = m.snapshot()
+                text = render_instances([m])
+                # counters never go backwards mid-storm
+                assert snap["requests"] >= prev_requests
+                assert snap["latency"]["count"] >= prev_latency
+                prev_requests = snap["requests"]
+                prev_latency = snap["latency"]["count"]
+                # families are never torn: one # TYPE per family, and
+                # the histogram triplet is complete in every render
+                assert text.count(
+                    "# TYPE veles_serving_latency histogram") == 1
+                assert "veles_serving_latency_sum" in text
+                assert "veles_serving_latency_count" in text
+                assert 'le="+Inf"' in text
+        finally:
+            for t in threads:
+                t.join(timeout=30)
+        assert not errors, errors
+        snap = m.snapshot()
+        total = writers * per_writer
+        assert snap["requests"] == total
+        assert snap["latency"]["count"] == total
+        assert snap["counters"]["tokens_out"] == 2 * total
+        assert (snap["counters"]['routed_requests{replica="0"}']
+                + snap["counters"]['routed_requests{replica="1"}']
+                == total)
+        assert snap["gauges"]["queue_depth_peak"] == per_writer - 1
+        # the cumulative bucket counts sum to the observation count
+        text = m.render_prometheus()
+        inf_line = next(
+            line for line in text.splitlines()
+            if line.startswith("veles_serving_latency_bucket")
+            and 'le="+Inf"' in line)
+        assert inf_line.endswith(" %d" % total)
+
     def test_web_status_metrics_endpoint(self):
         """GET /metrics on the dashboard: registered serving engines +
         workflow rows as gauges, one scrape surface."""

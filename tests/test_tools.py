@@ -1,14 +1,18 @@
 """Repo tools: the trace analyzer (tools/trace_analyze.py) against a
-synthetic Chrome trace, and the committed round-4 artifact."""
+synthetic Chrome trace, the committed round-4 artifact, the serving
+trace report (tools/trace_report.py), and the streamed-summary-record
+schema guard (tools/check_stream_records.py)."""
 
 import gzip
 import json
 import os
 import sys
+import time
 
 import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
 sys.path.insert(0, os.path.join(REPO, "tools"))
 
 import trace_analyze  # noqa: E402
@@ -68,3 +72,88 @@ def test_analyze_committed_round4_artifact():
     top = res["rows"][0]
     assert top["category"] == "convolution fusion"
     assert 3.5 < top["ms_per_step"] < 4.5
+
+
+def test_check_stream_records_builtin_contract():
+    """ISSUE 12 satellite, tier-1 (<30s): every streaming tool's
+    summary_record — bench.py, lm_bench, chaos_bench, profile_ops,
+    trace_report — carries the shared required keys even for the
+    empty-results worst case, so a schema drift fails HERE instead of
+    silently breaking bench_report.py."""
+    import check_stream_records
+    assert check_stream_records.check_builtin() == []
+
+
+def test_check_stream_records_flags_bad_lines():
+    import check_stream_records
+    good = json.dumps({"metric": "m", "value": 1, "unit": "x",
+                       "vs_baseline": None, "configs": {}})
+    assert check_stream_records.check_line(good) == []
+    # missing keys, non-JSON, empty metric, NaN all flagged
+    assert check_stream_records.check_line(json.dumps({"metric": "m"}))
+    assert check_stream_records.check_line("{not json")
+    assert check_stream_records.check_line(json.dumps(
+        {"metric": "", "value": 1, "unit": "x", "vs_baseline": None,
+         "configs": {}}))
+    nan = ('{"metric": "m", "value": NaN, "unit": "x", '
+           '"vs_baseline": null, "configs": {}}')
+    assert check_stream_records.check_line(nan)
+    # a stream with one bad line among good ones names its line number
+    problems = check_stream_records.check_stream(
+        good + "\n" + "{broken\n" + good, "s")
+    assert len(problems) == 1 and "s:2" in problems[0]
+
+
+def test_trace_report_roundtrip(tmp_path, capsys):
+    """tools/trace_report.py rebuilds per-request records from an
+    exported Chrome trace: waterfall renders, ledger dedups batched
+    dispatches, integrity check passes, and the streamed summary
+    lines honor the shared record schema."""
+    import check_stream_records
+    import trace_report
+    from veles_tpu.serving.tracing import SpanTracer
+    tr = SpanTracer(mode="all", last=8)
+    a = tr.start_request(rid="req-a", name="http.request", cat="http")
+    b = tr.start_request(rid="req-b", name="http.request", cat="http")
+    t = time.monotonic()
+    tr.add_many([a, b], "decode.step", "decode", t, t + 0.004,
+                attrs={"backend": "xla", "bucket": 4})
+    tr.add_many([a], "prefill.chunk", "prefill", t, t + 0.002,
+                attrs={"backend": "xla", "bucket": 8})
+    tr.finish_request(a)
+    tr.finish_request(b, error=RuntimeError("boom"))
+    path = str(tmp_path / "serve.trace.json")
+    with open(path, "w") as f:
+        json.dump(tr.export_chrome(), f)
+    rc = trace_report.main([path, "--all", "--check",
+                            "--ledger-json",
+                            str(tmp_path / "ledger.json")])
+    assert rc == 0
+    out = capsys.readouterr()
+    # stdout lines are all schema-conforming records, last-line-wins
+    assert check_stream_records.check_stream(out.out) == []
+    last = json.loads(out.out.strip().splitlines()[-1])
+    assert last["metric"] == "trace_ledger_dispatches"
+    # the 2-lane decode.step dedups to ONE dispatch + one prefill
+    assert last["value"] == 2
+    assert last["configs"]["requests"] == 2
+    assert last["configs"]["errored"] == 1
+    # waterfalls went to stderr for both requests (req-b also shows
+    # up once more as the auto-dump log line from finish_request)
+    assert "request req-a" in out.err and "request req-b" in out.err
+    ledger = json.load(open(str(tmp_path / "ledger.json")))["ledger"]
+    by_op = {r["op"]: r for r in ledger}
+    assert by_op["decode.step"]["dispatches"] == 1
+    assert by_op["decode.step"]["lanes"] == 2
+
+
+def test_trace_report_unknown_request_errors(tmp_path, capsys):
+    import trace_report
+    from veles_tpu.serving.tracing import SpanTracer
+    tr = SpanTracer(mode="all")
+    tr.finish_request(tr.start_request(rid="only"))
+    path = str(tmp_path / "t.json")
+    with open(path, "w") as f:
+        json.dump(tr.export_chrome(), f)
+    assert trace_report.main([path, "--request", "nope"]) == 1
+    capsys.readouterr()

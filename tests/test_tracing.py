@@ -1,0 +1,598 @@
+"""End-to-end request tracing (ISSUE 12): the span tracer, the flight
+recorder, the cost ledger, and the tracer threaded through engine /
+router / HTTP — including the acceptance combo (prefix_cache +
+prefill_chunk + spec_k + paged_kv + tp dryrun) exporting a valid
+Chrome trace with complete span trees."""
+
+import json
+import time
+import urllib.request
+
+import numpy
+import pytest
+
+import jax.numpy as jnp
+
+from veles_tpu import prng
+from veles_tpu.ops.transformer import generate, init_transformer_params
+
+
+def tiny_params(vocab=16, d_model=32, n_heads=2, n_layers=2,
+                max_len=64, seed=7):
+    import jax
+    prng.reset()
+    prng.seed_all(seed)
+    host = init_transformer_params(prng.get("init"), vocab,
+                                   d_model=d_model, n_heads=n_heads,
+                                   n_layers=n_layers, max_len=max_len)
+    return jax.tree.map(jnp.asarray, host)
+
+
+def greedy_rows(params, prompts, n_new, n_heads=2, max_len=64):
+    return [numpy.asarray(generate(
+        params, jnp.asarray([p], jnp.int32), n_new, n_heads,
+        temperature=0.0, max_len=max_len))[0] for p in prompts]
+
+
+class TestSpanTracer:
+    def test_span_tree_ring_and_waterfall(self):
+        from veles_tpu.serving.tracing import (SpanTracer,
+                                               format_waterfall,
+                                               verify_integrity)
+        tr = SpanTracer(mode="all", last=2)
+        ctx = tr.start_request(rid="abc", name="http.request",
+                               cat="http")
+        h = tr.begin(ctx, "queue.wait", cat="queue")
+        tr.end(h, attrs={"wait_s": 0.001})
+        h2 = tr.begin(ctx, "attempt", cat="router",
+                      attrs={"replica": 0})
+        child = ctx.at(h2[1])
+        t = time.monotonic()
+        tr.add_many([child], "decode.step", "decode", t, t + 0.002,
+                    attrs={"backend": "xla", "bucket": 4})
+        tr.end(h2)
+        rec = tr.finish_request(ctx)
+        assert rec["rid"] == "abc" and rec["error"] is None
+        assert verify_integrity([rec])["spans"] == 4
+        # the decode span nests under the attempt, not the root
+        step = next(s for s in rec["spans"]
+                    if s["name"] == "decode.step")
+        assert step["parent"] == h2[1]
+        text = format_waterfall(rec)
+        assert "http.request" in text and "decode.step" in text
+        # ring bound: a third request evicts the first
+        for i in range(2):
+            c = tr.start_request(rid="r%d" % i)
+            tr.finish_request(c)
+        rids = [r["rid"] for r in tr.requests()]
+        assert rids == ["r0", "r1"]
+        assert tr.find("abc") is None and tr.find("r1") is not None
+
+    def test_modes_errors_and_sampling(self):
+        from veles_tpu.serving.tracing import SpanTracer
+        tr = SpanTracer(mode="errors")
+        ok = tr.start_request()
+        tr.finish_request(ok)
+        bad = tr.start_request()
+        tr.finish_request(bad, error=RuntimeError("boom"))
+        recs = tr.requests()
+        assert len(recs) == 1 and "boom" in recs[0]["error"]
+        # errored requests auto-dump their waterfall
+        assert len(tr.dumps()) == 1 and tr.dumps()[0]["text"]
+        # deadline-blown requests are retained and dumped too
+        shed = tr.start_request()
+        tr.finish_request(shed, deadline=True)
+        assert tr.requests()[-1]["deadline_blown"]
+        assert len(tr.dumps()) == 2
+        # sample:0 traces nothing, sample:1 everything — seeded
+        none = SpanTracer(mode="sample", sample=0.0)
+        assert none.start_request() is None
+        assert none.stats()["sampled_out"] == 1
+        full = SpanTracer(mode="sample", sample=1.0)
+        assert full.start_request() is not None
+
+    def test_from_spec(self):
+        from veles_tpu.serving.tracing import SpanTracer
+        assert SpanTracer.from_spec(None) is None
+        assert SpanTracer.from_spec("off") is None
+        assert SpanTracer.from_spec(False) is None
+        assert SpanTracer.from_spec("all").mode == "all"
+        assert SpanTracer.from_spec(True).mode == "all"
+        assert SpanTracer.from_spec("errors").mode == "errors"
+        s = SpanTracer.from_spec("sample:0.25")
+        assert s.mode == "sample" and s.sample == 0.25
+        t = SpanTracer(mode="all")
+        assert SpanTracer.from_spec(t) is t
+        with pytest.raises(ValueError):
+            SpanTracer.from_spec("sometimes")
+
+    def test_unclosed_span_flagged_and_caught(self):
+        from veles_tpu.serving.tracing import (SpanTracer,
+                                               verify_integrity)
+        tr = SpanTracer(mode="all")
+        ctx = tr.start_request()
+        tr.begin(ctx, "leaky")           # never ended
+        rec = tr.finish_request(ctx)
+        assert rec["unclosed"] == ["leaky"]
+        with pytest.raises(AssertionError, match="unclosed"):
+            verify_integrity([rec])
+        # an orphan parent is caught too
+        orphan = {"rid": "x", "error": None, "deadline_blown": False,
+                  "unclosed": [],
+                  "spans": [{"sid": 1, "parent": None, "name": "root",
+                             "cat": "r", "t0": 0.0, "t1": 1.0,
+                             "attrs": {}},
+                            {"sid": 2, "parent": 99, "name": "lost",
+                             "cat": "s", "t0": 0.0, "t1": 1.0,
+                             "attrs": {}}]}
+        with pytest.raises(AssertionError, match="ORPHAN"):
+            verify_integrity([orphan])
+
+    def test_ledger_dedups_batched_dispatches(self):
+        from veles_tpu.serving.tracing import SpanTracer, cost_ledger
+        tr = SpanTracer(mode="all")
+        a, b = tr.start_request(), tr.start_request()
+        t = time.monotonic()
+        # one batched dispatch serving two requests...
+        tr.add_many([a, b], "decode.step", "decode", t, t + 0.004,
+                    attrs={"backend": "xla", "bucket": 2})
+        # ...and one single-lane dispatch
+        tr.add_many([a], "decode.step", "decode", t, t + 0.002,
+                    attrs={"backend": "xla", "bucket": 2})
+        recs = [tr.finish_request(a), tr.finish_request(b)]
+        rows = cost_ledger(recs)
+        assert len(rows) == 1
+        row = rows[0]
+        assert row["dispatches"] == 2 and row["lanes"] == 3
+        # spans without a backend attr (non-device marks) stay out
+        assert cost_ledger([{"rid": "x", "spans": [
+            {"sid": 1, "parent": None, "name": "queue.wait",
+             "cat": "queue", "t0": 0.0, "t1": 1.0, "attrs": {}}],
+            "error": None, "deadline_blown": False,
+            "unclosed": []}]) == []
+
+    def test_max_spans_bounds_a_request(self):
+        from veles_tpu.serving.tracing import SpanTracer
+        tr = SpanTracer(mode="all", max_spans=4)
+        ctx = tr.start_request()
+        handles = [tr.begin(ctx, "s%d" % i) for i in range(6)]
+        assert sum(1 for h in handles if h is not None) == 3  # + root
+        for h in handles:
+            tr.end(h)
+        rec = tr.finish_request(ctx)
+        assert len(rec["spans"]) == 4
+        assert tr.stats()["dropped_spans"] == 3
+
+
+class TestEngineTracing:
+    N_NEW = 8
+
+    def _run(self, tracer, prompts, expect, tp=0, **kw):
+        from veles_tpu.serving import LMEngine, ServingMetrics
+        params = tiny_params()
+        engine = LMEngine(params, n_heads=2, max_len=64, slots=2,
+                          metrics=ServingMetrics("trc_t"),
+                          tracer=tracer, tp=tp, **kw).start()
+        try:
+            futures = [engine.submit(p, self.N_NEW) for p in prompts]
+            outs = [f.result(timeout=120) for f in futures]
+        finally:
+            engine.stop()
+        for p, out, exp in zip(prompts, outs, expect):
+            numpy.testing.assert_array_equal(
+                numpy.concatenate([p, out]), exp)
+        return futures
+
+    def test_full_fastpath_traced_chrome_export(self):
+        """The acceptance combo minus tp: prefix_cache + prefill_chunk
+        + spec_k + paged_kv, traced — parity unchanged, every span
+        tree complete, the Chrome export strict-valid with root →
+        queue/prefill/decode spans, and the cost ledger populated."""
+        from veles_tpu.serving.tracing import (SpanTracer, cost_ledger,
+                                               verify_integrity)
+        params = tiny_params()
+        prompts = [[1, 2, 3, 4, 5, 6, 7, 8, 9, 10], [2, 4, 6, 8],
+                   [1, 2, 3, 4, 5, 6, 7, 8, 2, 1]]
+        expect = greedy_rows(params, prompts, self.N_NEW)
+        tracer = SpanTracer(mode="all", last=16)
+        self._run(tracer, prompts, expect, prefill_chunk=8,
+                  prefix_cache=32, spec_k=2, paged_kv=True)
+        recs = tracer.requests()
+        integ = verify_integrity(recs)
+        assert integ["requests"] == len(prompts)
+        names = {s["name"] for r in recs for s in r["spans"]}
+        assert {"engine.request", "queue.wait", "prefill.chunk",
+                "decode.verify"} <= names
+        chrome = tracer.export_chrome()
+        # strict JSON (what Perfetto/chrome://tracing require) with
+        # X events carrying rid/sid/parent join keys
+        parsed = json.loads(json.dumps(chrome, allow_nan=False))
+        xs = [e for e in parsed["traceEvents"] if e["ph"] == "X"]
+        assert xs and all("rid" in e["args"] and "ts" in e
+                          and "dur" in e for e in xs)
+        rows = cost_ledger(recs)
+        assert rows and all(r["backend"] == "xla" for r in rows)
+        ops = {r["op"] for r in rows}
+        assert "decode.verify" in ops and "prefill.chunk" in ops
+        # dispatch counts are deduped: total dispatches must not
+        # exceed total lanes
+        assert all(r["dispatches"] <= r["lanes"] for r in rows)
+
+    def test_tp_traced_acceptance_combo(self, serving_mesh):
+        """The FULL acceptance combo: prefix_cache + prefill_chunk +
+        spec_k + paged_kv + tp=2 (CPU dryrun mesh), traced end to
+        end — greedy parity, complete span trees, and the ledger's
+        backend column names the tp path."""
+        serving_mesh(2)
+        from veles_tpu.serving.tracing import (SpanTracer, cost_ledger,
+                                               verify_integrity)
+        params = tiny_params()
+        prompts = [[1, 2, 3, 4, 5, 6, 7, 8, 9, 10], [2, 4, 6, 8]]
+        expect = greedy_rows(params, prompts, self.N_NEW)
+        tracer = SpanTracer(mode="all", last=16)
+        self._run(tracer, prompts, expect, tp=2, prefill_chunk=8,
+                  prefix_cache=32, spec_k=2, paged_kv=True)
+        recs = tracer.requests()
+        assert verify_integrity(recs)["requests"] == len(prompts)
+        rows = cost_ledger(recs)
+        assert rows and all(r["backend"] == "xla-tp2" for r in rows)
+        json.loads(json.dumps(tracer.export_chrome(), allow_nan=False))
+
+    def test_flight_recorder_reconstructs_faulted_request(self):
+        """Inject a chunk fault mid-prefill: the failed request's
+        timeline — including the failed dispatch — reconstructs from
+        the ring AFTER the fact, and was auto-dumped on failure."""
+        from veles_tpu.serving import (FaultPlan, LMEngine,
+                                       ServingMetrics)
+        from veles_tpu.serving.tracing import (SpanTracer,
+                                               format_waterfall,
+                                               verify_integrity)
+        params = tiny_params()
+        prompts = [[1, 2, 3, 4, 5, 6, 7, 8, 9, 10],
+                   [2, 4, 6, 8, 1, 3], [5, 5, 5, 5, 5, 5, 5, 5]]
+        expect = greedy_rows(params, prompts, self.N_NEW)
+        plan = FaultPlan(seed=0).arm("engine.chunk", kind="error",
+                                     calls={2})
+        tracer = SpanTracer(mode="all", last=16)
+        engine = LMEngine(params, n_heads=2, max_len=64, slots=2,
+                          prefill_chunk=8, faults=plan, tracer=tracer,
+                          metrics=ServingMetrics("rec_t")).start()
+        try:
+            futures = [engine.submit(p, self.N_NEW) for p in prompts]
+            failed, survived = [], 0
+            for p, f, exp in zip(prompts, futures, expect):
+                try:
+                    out = f.result(timeout=120)
+                except Exception:   # noqa: BLE001 — the injected fault
+                    failed.append(f)
+                    continue
+                numpy.testing.assert_array_equal(
+                    numpy.concatenate([p, out]), exp)
+                survived += 1
+        finally:
+            engine.stop()
+        assert len(failed) == 1 and survived == 2
+        rid = failed[0].request.trace.rid
+        rec = tracer.find(rid)
+        assert rec is not None and "InjectedFault" in rec["error"]
+        fault_span = [s for s in rec["spans"]
+                      if s["name"] == "prefill.chunk"
+                      and "error" in s["attrs"]]
+        assert fault_span, "failed dispatch missing from the timeline"
+        assert "InjectedFault" in format_waterfall(rec)
+        assert rid in {d["rid"] for d in tracer.dumps()}
+        verify_integrity(tracer.requests())
+
+    def test_untraced_engine_unchanged(self):
+        """tracer=None is the default: no trace fields set, no spans
+        anywhere, parity as ever — the unarmed contract."""
+        params = tiny_params()
+        prompts = [[1, 2, 3, 4]]
+        expect = greedy_rows(params, prompts, self.N_NEW)
+        futures = self._run(None, prompts, expect, prefill_chunk=8)
+        assert futures[0].request.trace is None
+
+
+class TestRouterTracing:
+    def test_retry_shows_both_attempts(self):
+        """A request whose first attempt dies on a faulted replica
+        completes on the second; its ONE trace shows the errored
+        attempt, the retry marker, and the winning attempt with the
+        engine spans nested under it."""
+        from veles_tpu.serving import (FaultPlan, LMEngine, Router,
+                                       ServingMetrics)
+        from veles_tpu.serving.tracing import (SpanTracer,
+                                               verify_integrity)
+        params = tiny_params()
+        prompts = [[1, 2, 3, 4, 5, 6, 7, 8, 9, 10], [2, 4, 6, 8]]
+        expect = greedy_rows(params, prompts, 8)
+        plan = FaultPlan(seed=0).arm("engine.chunk", kind="error",
+                                     calls={1})
+        tracer = SpanTracer(mode="all", last=16)
+        replicas = [
+            LMEngine(params, n_heads=2, max_len=64, slots=2,
+                     prefill_chunk=8, name="rtr_t_r%d" % i,
+                     metrics=ServingMetrics(
+                         "rtr_t", labels={"replica": str(i)}),
+                     faults=plan if i == 0 else None, tracer=tracer)
+            for i in range(2)]
+        router = Router(replicas, retries=2, tracer=tracer).start()
+        try:
+            futures = [router.submit(p, 8) for p in prompts]
+            for p, f, exp in zip(prompts, futures, expect):
+                numpy.testing.assert_array_equal(
+                    numpy.concatenate([p, f.result(timeout=120)]), exp)
+        finally:
+            time.sleep(0.1)      # let hedge-loser/zombie spans settle
+            router.stop()
+        assert router.metrics.counter("requests_retried") >= 1
+        recs = tracer.requests()
+        verify_integrity(recs)
+        retried = [r for r in recs
+                   if sum(1 for s in r["spans"]
+                          if s["name"] == "attempt") > 1]
+        assert retried, "no trace shows a second attempt"
+        rec = retried[0]
+        attempts = [s for s in rec["spans"] if s["name"] == "attempt"]
+        assert any("error" in s["attrs"] for s in attempts)
+        winner = next(s for s in attempts
+                      if s["attrs"].get("outcome") == "ok")
+        # engine spans of the winning attempt nest under it
+        nested = [s for s in rec["spans"]
+                  if s["parent"] == winner["sid"]]
+        assert any(s["name"] == "queue.wait" for s in nested)
+        assert any(s["name"] == "retry.backoff"
+                   for s in rec["spans"])
+
+
+class TestHTTPTracing:
+    def _api(self, tracer, params):
+        """A serve_lm-shaped API (engine handler + tracer) without the
+        char_lm training cost."""
+        from veles_tpu.restful_api import RESTfulAPI
+        from veles_tpu.serving import LMEngine, ServingMetrics
+
+        engine = LMEngine(params, n_heads=2, max_len=64, slots=2,
+                          prefill_chunk=8,
+                          metrics=ServingMetrics("http_trc"),
+                          tracer=tracer).start()
+
+        def handler(request):
+            prompt = numpy.asarray(request["input"], numpy.int32)
+            toks = engine.generate(prompt,
+                                   int(request.get("n_new", 4)))
+            return {"tokens": toks.tolist()}
+
+        api = RESTfulAPI(None, handler=handler, metrics=engine.metrics,
+                         tracer=tracer)
+        api.lm_engine = engine
+        return api.start(port=0)
+
+    def _post(self, port, payload, rid=None, path="/predict"):
+        headers = {"Content-Type": "application/json"}
+        if rid:
+            headers["X-Request-Id"] = rid
+        req = urllib.request.Request(
+            "http://127.0.0.1:%d%s" % (port, path),
+            data=json.dumps(payload).encode(), headers=headers)
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return resp.status, json.loads(resp.read()), \
+                    resp.headers
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read()), e.headers
+
+    def test_request_id_echo_and_trace_json(self):
+        """Satellite + tentpole surface: every reply (success AND
+        structured error) carries request_id — echoed from
+        X-Request-Id or generated — and GET /trace.json exports the
+        flight recorder with the client's rid as the join key."""
+        from veles_tpu.serving.tracing import SpanTracer
+        params = tiny_params()
+        tracer = SpanTracer(mode="all", last=32)
+        api = self._api(tracer, params)
+        try:
+            code, out, hdrs = self._post(
+                api.port, {"input": [[1, 2, 3]], "n_new": 4},
+                rid="client-key-1")
+            assert code == 200
+            assert out["request_id"] == "client-key-1"
+            assert hdrs["X-Request-Id"] == "client-key-1"
+            # generated when absent — echoed in header and body alike
+            code, out, hdrs = self._post(
+                api.port, {"input": [[2, 4, 6]], "n_new": 4})
+            assert code == 200
+            assert out["request_id"] == hdrs["X-Request-Id"]
+            assert len(out["request_id"]) == 16
+            # structured errors carry it too
+            code, out, _ = self._post(api.port, {"nope": 1},
+                                      rid="bad-1")
+            assert code == 400 and out["request_id"] == "bad-1"
+            code, out, _ = self._post(api.port, {"input": [[1]]},
+                                      rid="lost-1", path="/nowhere")
+            assert code == 404 and out["request_id"] == "lost-1"
+            # the exported trace joins on the same ids
+            with urllib.request.urlopen(
+                    "http://127.0.0.1:%d/trace.json?last=8" % api.port,
+                    timeout=10) as resp:
+                trace = json.loads(resp.read())
+            xs = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+            rids = {e["args"].get("rid") for e in xs}
+            assert "client-key-1" in rids and "bad-1" in rids
+            names = {e["name"] for e in xs}
+            assert "http.request" in names and "decode.step" in names
+            # root spans carry the reply status
+            statuses = {e["args"].get("status") for e in xs
+                        if e["name"] == "http.request"}
+            assert {200, 400, 404} <= statuses
+        finally:
+            api.stop()
+
+    def test_request_id_stamped_without_tracer(self):
+        """The request_id satellite holds with tracing off."""
+        params = tiny_params()
+        api = self._api(None, params)
+        try:
+            code, out, hdrs = self._post(
+                api.port, {"input": [[1, 2, 3]], "n_new": 4},
+                rid="no-trace-1")
+            assert code == 200 and out["request_id"] == "no-trace-1"
+            assert hdrs["X-Request-Id"] == "no-trace-1"
+            # /trace.json is 404 when no tracer is armed
+            try:
+                urllib.request.urlopen(
+                    "http://127.0.0.1:%d/trace.json" % api.port,
+                    timeout=10)
+                assert False, "expected 404"
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+        finally:
+            api.stop()
+
+
+class TestReviewHardening:
+    """Pins for the review fixes: sampled-out propagation, hedge-loser
+    span closure under an upstream-owned root, last=0 trim."""
+
+    def test_sample_decision_made_once_across_layers(self):
+        """sample:P rolls the coin ONCE at the outermost armed layer:
+        a sampled-out request must not re-root partial trees at the
+        router or engine (the 1-(1-P)^3 inflation bug)."""
+        from veles_tpu.serving import (LMEngine, Router,
+                                       ServingMetrics)
+        from veles_tpu.serving.tracing import SpanTracer
+        params = tiny_params()
+        tracer = SpanTracer(mode="sample", sample=0.0)
+        replicas = [
+            LMEngine(params, n_heads=2, max_len=64, slots=2,
+                     prefill_chunk=8, name="smp_r%d" % i,
+                     metrics=ServingMetrics(
+                         "smp", labels={"replica": str(i)}),
+                     tracer=tracer)
+            for i in range(2)]
+        router = Router(replicas, tracer=tracer).start()
+        try:
+            futs = [router.submit([1, 2, 3, 4], 4) for _ in range(3)]
+            for f in futs:
+                f.result(timeout=60)
+        finally:
+            router.stop()
+        stats = tracer.stats()
+        # one roll per request — the engines never rolled again
+        assert stats["started"] == 3
+        assert stats["sampled_out"] == 3
+        assert stats["retained"] == 0 and stats["live"] == 0
+
+    def test_hedge_loser_spans_closed_under_upstream_root(self):
+        """An upstream-owned (HTTP-shaped) root seals the trace the
+        moment the handler returns — the hedge loser's attempt span
+        must already be closed (outcome hedge-lost), never flagged
+        unclosed."""
+        from veles_tpu.serving import (FaultPlan, LMEngine, Router,
+                                       ServingMetrics)
+        from veles_tpu.serving import tracing
+        from veles_tpu.serving.tracing import (SpanTracer,
+                                               verify_integrity)
+        params = tiny_params()
+        prompts = [[1, 2, 3, 4, 5, 6], [2, 4, 6, 8]]
+        expect = greedy_rows(params, prompts, 8)
+        plan = FaultPlan(seed=0).arm("engine.step", kind="latency",
+                                     latency_s=0.15)
+        tracer = SpanTracer(mode="all", last=16)
+        replicas = [
+            LMEngine(params, n_heads=2, max_len=64, slots=2,
+                     prefill_chunk=8, name="hdg_r%d" % i,
+                     metrics=ServingMetrics(
+                         "hdg", labels={"replica": str(i)}),
+                     faults=plan if i == 0 else None, tracer=tracer)
+            for i in range(2)]
+        router = Router(replicas, hedge_after_s=0.25,
+                        tracer=tracer).start()
+        recs = []
+        try:
+            for p, exp in zip(prompts, expect):
+                root = tracer.start_request(rid="up-%d" % len(recs),
+                                            name="http.request",
+                                            cat="http")
+                with tracing.use(root):
+                    fut = router.submit(p, 8)
+                out = fut.result(timeout=120)
+                # seal IMMEDIATELY, exactly like do_POST's finally —
+                # the loser may still be decoding on the slow replica
+                recs.append(tracer.finish_request(root))
+                numpy.testing.assert_array_equal(
+                    numpy.concatenate([p, out]), exp)
+        finally:
+            plan.release()
+            router.stop()
+        assert router.metrics.counter("requests_hedged") >= 1
+        verify_integrity(recs)
+        lost = [s for r in recs for s in r["spans"]
+                if s["attrs"].get("outcome") == "hedge-lost"]
+        assert lost, "no hedge-lost attempt recorded"
+
+    def test_requests_last_zero_is_empty(self):
+        from veles_tpu.serving.tracing import SpanTracer
+        tr = SpanTracer(mode="all")
+        for _ in range(3):
+            tr.finish_request(tr.start_request())
+        assert tr.requests(last=0) == []
+        assert len(tr.requests(last=2)) == 2
+        assert len(tr.export_chrome(last=0)["traceEvents"]) == 1  # meta
+
+    def test_injected_503_not_flagged_deadline(self):
+        """An injected transient HTTP 503 (the retryable-blip shape)
+        is an error dump but NOT a deadline shed — only a real
+        DeadlineExceeded sets deadline_blown."""
+        from veles_tpu.restful_api import RESTfulAPI
+        from veles_tpu.serving import FaultPlan
+        from veles_tpu.serving.tracing import SpanTracer
+        plan = FaultPlan(seed=0).arm("http.request", kind="error",
+                                     exc="http_503", times=1)
+        tracer = SpanTracer(mode="all", last=8)
+        api = RESTfulAPI(None, handler=lambda req: {"ok": True},
+                         faults=plan, tracer=tracer).start(port=0)
+        try:
+            req = urllib.request.Request(
+                "http://127.0.0.1:%d/predict" % api.port,
+                data=json.dumps({"input": [[1]]}).encode(),
+                headers={"Content-Type": "application/json",
+                         "X-Request-Id": "blip-1"})
+            try:
+                urllib.request.urlopen(req, timeout=30)
+                assert False, "expected 503"
+            except urllib.error.HTTPError as e:
+                assert e.code == 503
+                assert json.loads(e.read())["request_id"] == "blip-1"
+        finally:
+            api.stop()
+        rec = tracer.find("blip-1")
+        assert rec is not None and rec["error"] == "http 503"
+        assert rec["deadline_blown"] is False
+
+    def test_batcher_injected_dispatch_fault_keeps_trees_sound(self):
+        """A batcher.dispatch fault fails its clients with their
+        queue-wait spans CLOSED — no unclosed spans in the finished
+        trees (the fault fires after the spans close)."""
+        from veles_tpu.serving import FaultPlan, MicroBatcher
+        from veles_tpu.serving.tracing import (SpanTracer,
+                                               verify_integrity)
+        plan = FaultPlan(seed=0).arm("batcher.dispatch", kind="error",
+                                     calls={1})
+        tracer = SpanTracer(mode="all", last=8)
+        mb = MicroBatcher(lambda x: x * 2, max_batch=4,
+                          sample_shape=(2,), faults=plan,
+                          tracer=tracer).start()
+        try:
+            with pytest.raises(Exception, match="injected"):
+                mb.submit(numpy.ones((1, 2), numpy.float32))
+            out = mb.submit(numpy.ones((1, 2), numpy.float32))
+            numpy.testing.assert_array_equal(
+                out, 2 * numpy.ones((1, 2), numpy.float32))
+        finally:
+            mb.stop()
+        recs = tracer.requests()
+        assert len(recs) == 2
+        verify_integrity(recs)
+        assert any(r["error"] and "injected" in r["error"]
+                   for r in recs)

@@ -1,7 +1,7 @@
 """Chaos bench (ISSUE 10): the serving resilience layer under
 deterministic injected faults.
 
-Five scenarios, each driven by a seeded
+Six scenarios, each driven by a seeded
 ``veles_tpu/serving/faults.py::FaultPlan`` so a given run always
 injects at the same dispatches:
 
@@ -30,10 +30,19 @@ injects at the same dispatches:
   its reply is stamped with (pre-swap → old, post-swap → new), and an
   injected bad canary (``engine.swap`` fault) auto-rolls back with no
   client-visible errors.
+- ``traced_flight_recorder`` — requests run TRACED (ISSUE 12) under
+  injected chunk faults: a retried request's trace shows both
+  attempts (the errored one included), every retained span tree
+  verifies (one root, no orphans, no unclosed spans), the faulted
+  request's timeline reconstructs from the flight-recorder ring
+  after the fact, and its waterfall was auto-dumped the moment it
+  failed.
 - ``fault_free_overhead`` — the acceptance leg for "unarmed is
-  free": measures the per-call cost of an UNARMED fault hook and the
-  health checker's per-scan cost, expresses both as a fraction of a
-  measured decode step, and asserts the sum < 2%.
+  free": measures the per-call cost of an UNARMED fault hook, an
+  UNARMED trace site (ISSUE 12) and the health checker's per-scan
+  cost, expresses them as a fraction of a measured decode step, and
+  asserts the sum < 2% (armed tracing's span cost is recorded for
+  PERF.md, not bounded).
 
 A bench.py-style summary JSON line streams after EVERY completed
 scenario (last-line-wins under an outer watchdog kill), and the final
@@ -311,19 +320,159 @@ def scenario_pool_storm(params, n_heads, max_len, prompts, n_new,
         engine.stop()
 
 
+def scenario_traced_flight_recorder(params, n_heads, max_len, prompts,
+                                    n_new, expect, slots=2):
+    """Traced serving under injected faults (ISSUE 12): the flight
+    recorder must reproduce a faulted request's timeline AFTER the
+    fact, auto-dump it the moment it fails, and keep every retained
+    span tree sound (one root, no orphans, no unclosed spans) while
+    parity holds for the survivors.
+
+    Two sub-legs: (a) a 2-replica ROUTER with retries — a request whose
+    first attempt dies on the faulted replica completes on the second,
+    and its trace shows BOTH attempts (the errored one included); (b) a
+    single engine with a recurring chunk fault and no retry — the
+    failed requests' traces land in the 'errors'-mode ring exactly,
+    each auto-dumped as waterfall text."""
+    from veles_tpu.serving import (FaultPlan, LMEngine, Router,
+                                   ServingMetrics, SpanTracer,
+                                   cost_ledger, format_waterfall,
+                                   verify_integrity)
+
+    # ---- (a) routed retry: the errored attempt stays in the timeline
+    plan = FaultPlan(seed=0).arm("engine.chunk", kind="error",
+                                 calls={2})
+    tracer = SpanTracer(mode="all", last=4 * len(prompts) + 16)
+    replicas = _build_replicas(params, n_heads, max_len, 2, slots,
+                               [plan, None], tag="chaos_trace",
+                               prefill_chunk=16, tracer=tracer)
+    router = Router(replicas, retries=2, tracer=tracer)
+    router.start()
+    t0 = time.monotonic()
+    try:
+        futures = _submit_all(router, prompts, n_new)
+        for p, f, exp in zip(prompts, futures, expect):
+            out = f.result(timeout=120)
+            if not numpy.array_equal(numpy.concatenate([p, out]), exp):
+                raise AssertionError(
+                    "traced+faulted output diverged from greedy "
+                    "generate")
+    finally:
+        plan.release()
+        router.stop()
+    recs = tracer.requests()
+    integrity = verify_integrity(recs)      # raises on a broken tree
+    retried = [r for r in recs
+               if sum(1 for s in r["spans"]
+                      if s["name"] == "attempt") > 1]
+    if not retried:
+        raise AssertionError("no request shows a second attempt after "
+                             "the injected chunk fault")
+    errored_attempts = [
+        s for r in retried for s in r["spans"]
+        if s["name"] == "attempt" and "error" in s["attrs"]]
+    if not errored_attempts:
+        raise AssertionError("the retried request's first attempt did "
+                             "not record its error")
+    ledger = cost_ledger(recs)
+    if not ledger:
+        raise AssertionError("traced run produced an empty cost ledger")
+
+    # ---- (b) flight recorder: errors-only retention + auto-dump
+    plan_b = FaultPlan(seed=0).arm("engine.chunk", kind="error",
+                                   every=3)
+    rec_tracer = SpanTracer(mode="errors", last=16)
+    engine = LMEngine(params, n_heads=n_heads, max_len=max_len,
+                      slots=slots, prefill_chunk=16,
+                      name="chaos_recorder",
+                      metrics=ServingMetrics("chaos_recorder"),
+                      faults=plan_b, tracer=rec_tracer).start()
+    try:
+        futures = [(p, engine.submit(p, n_new)) for p in prompts]
+        failed, ok = [], 0
+        for i, (p, f) in enumerate(futures):
+            try:
+                out = f.result(timeout=120)
+            except Exception:   # noqa: BLE001 — the injected fault
+                failed.append((p, f))
+                continue
+            if not numpy.array_equal(numpy.concatenate([p, out]),
+                                     expect[i]):
+                raise AssertionError(
+                    "survivor diverged from greedy generate beside "
+                    "injected faults")
+            ok += 1
+        if not failed:
+            raise AssertionError("the every=3 chunk fault never fired")
+    finally:
+        plan_b.release()
+        engine.stop()
+    # reconstruction AFTER the fact: the failed request's rid pulls its
+    # full timeline out of the ring, and the auto-dump already fired
+    rid = failed[0][1].request.trace.rid
+    rec = rec_tracer.find(rid)
+    if rec is None:
+        raise AssertionError("faulted request %s not in the flight "
+                             "recorder ring" % rid)
+    if not rec["error"] or "InjectedFault" not in rec["error"]:
+        raise AssertionError("recorded error %r does not name the "
+                             "injected fault" % (rec["error"],))
+    fault_spans = [s for s in rec["spans"]
+                   if "error" in s["attrs"]
+                   and s["name"] == "prefill.chunk"]
+    if not fault_spans:
+        raise AssertionError("the faulted dispatch is missing from "
+                             "the reconstructed timeline")
+    waterfall = format_waterfall(rec)
+    if "InjectedFault" not in waterfall:
+        raise AssertionError("waterfall does not show the fault")
+    dump_rids = {d["rid"] for d in rec_tracer.dumps()}
+    if rid not in dump_rids:
+        raise AssertionError("faulted request %s was not auto-dumped"
+                             % rid)
+    retained = rec_tracer.requests()
+    verify_integrity(retained)
+    if len(retained) != len(failed):
+        raise AssertionError(
+            "'errors' mode retained %d records for %d failed requests"
+            % (len(retained), len(failed)))
+    return {
+        "scenario": "traced_flight_recorder",
+        "requests": 2 * len(prompts),
+        "parity_vs_generate": True,
+        "span_integrity": integrity,
+        "retried_request_attempts": max(
+            sum(1 for s in r["spans"] if s["name"] == "attempt")
+            for r in retried),
+        "ledger_rows": len(ledger),
+        "ledger_dispatches": int(sum(r["dispatches"] for r in ledger)),
+        "faulted_requests": len(failed),
+        "recorder_retained": len(retained),
+        "auto_dumps": len(dump_rids),
+        "fault_timeline_reconstructed": True,
+        "wall_s": round(time.monotonic() - t0, 3),
+    }
+
+
 def scenario_overhead(params, n_heads, max_len, prompts, n_new,
                       slots=2, hook_calls=200000):
-    """Fault-free overhead: the UNARMED layer and the health prober
-    must cost <2% of a decode step (the acceptance bound).
+    """Fault-free overhead: the UNARMED fault layer, the UNARMED
+    tracing layer (ISSUE 12) and the health prober must together cost
+    <2% of a decode step (the acceptance bound).
 
-    Two measured facts: (a) the per-call cost of an unarmed fault hook
+    Measured facts: (a) the per-call cost of an unarmed fault hook
     (one attribute-is-None check — timed over ``hook_calls``
     iterations) scaled by the hooks a decode tick crosses; (b) the
-    health checker's per-scan cost on a BUSY fleet (counter reads, no
-    probe) amortized over its interval.  Both are expressed against a
-    decode-step wall measured live on this host."""
+    unarmed TRACE site — literally ``engine._tracer is None`` —
+    scaled the same way; (c) the health checker's per-scan cost on a
+    BUSY fleet (counter reads, no probe) amortized over its interval.
+    All expressed against a decode-step wall measured live on this
+    host.  ARMED tracing cost (span begin/end pair, scaled to the
+    spans a traced tick records) is measured and RECORDED for the
+    PERF.md armed-vs-unarmed row, but not bounded — arming the tracer
+    buys the fence + record cost knowingly."""
     from veles_tpu.serving import HealthChecker, LMEngine, Router, \
-        ServingMetrics
+        ServingMetrics, SpanTracer
     engine = LMEngine(params, n_heads=n_heads, max_len=max_len,
                       slots=slots, name="chaos_ovh",
                       metrics=ServingMetrics("chaos_ovh")).start()
@@ -345,7 +494,30 @@ def scenario_overhead(params, n_heads, max_len, prompts, n_new,
         # them too, conservatively, as one more per tick
         hooks_per_tick = 3
         hook_frac = hooks_per_tick * hook_s / step_s
-        # (b) one health scan over a busy replica (staleness math
+        # (b) the unarmed TRACE sites (ISSUE 12) — the literal check
+        # every site compiles down to; a traced tick crosses the step
+        # site, the per-lane ctx reads and the fence guard — charge 4
+        t0 = time.perf_counter()
+        for _ in range(hook_calls):
+            if engine._tracer is not None:
+                raise AssertionError("tracer must be unarmed here")
+        trace_site_s = (time.perf_counter() - t0) / hook_calls
+        trace_sites_per_tick = 4
+        trace_frac = trace_sites_per_tick * trace_site_s / step_s
+        # ARMED tracing: one begin/end span pair, scaled to a traced
+        # tick's records (batch lanes + bookkeeping) — recorded for
+        # the PERF.md armed row, not part of the unarmed bound
+        pairs = 20000
+        tr = SpanTracer(mode="all", last=4, max_spans=2 * pairs + 16)
+        ctx = tr.start_request(name="overhead", cat="bench")
+        t0 = time.perf_counter()
+        for _ in range(pairs):
+            tr.end(tr.begin(ctx, "decode.step", cat="decode"))
+        span_pair_s = (time.perf_counter() - t0) / pairs
+        tr.finish_request(ctx)
+        armed_spans_per_tick = slots + 2
+        armed_frac = armed_spans_per_tick * span_pair_s / step_s
+        # (c) one health scan over a busy replica (staleness math
         # only: the engine has queued work during the scan)
         fut = engine.submit(prompts[0], max(8, n_new))
         t0 = time.perf_counter()
@@ -358,13 +530,23 @@ def scenario_overhead(params, n_heads, max_len, prompts, n_new,
         # the decode rate — its amortized cost is simply the fraction
         # of wall clock a scan occupies
         health_frac = scan_s / checker.interval_s
-        overhead = hook_frac + health_frac
+        overhead = hook_frac + trace_frac + health_frac
         record = {
             "scenario": "fault_free_overhead",
             "decode_step_ewma_s": round(step_s, 6),
             "unarmed_hook_ns": round(hook_s * 1e9, 1),
             "hooks_per_decode_tick": hooks_per_tick,
             "hook_frac_of_decode_step": round(hook_frac, 6),
+            # ISSUE 12: the tracing layer's three rows — unarmed site
+            # (bounded), armed span pair (recorded; arming also buys
+            # the block_until_ready fence, which is the dispatch
+            # itself, not overhead)
+            "unarmed_trace_site_ns": round(trace_site_s * 1e9, 1),
+            "trace_sites_per_tick": trace_sites_per_tick,
+            "trace_frac_of_decode_step": round(trace_frac, 6),
+            "armed_span_pair_ns": round(span_pair_s * 1e9, 1),
+            "armed_spans_per_tick": armed_spans_per_tick,
+            "armed_trace_frac_of_decode_step": round(armed_frac, 6),
             "health_scan_s": round(scan_s, 6),
             "health_scan_interval_s": checker.interval_s,
             "health_frac_of_decode_step": round(health_frac, 6),
@@ -373,8 +555,9 @@ def scenario_overhead(params, n_heads, max_len, prompts, n_new,
         }
         if overhead >= 0.02:
             raise AssertionError(
-                "unarmed fault layer + health prober cost %.3f%% of a "
-                "decode step (bound: 2%%)" % (100 * overhead))
+                "unarmed fault layer + unarmed tracing + health "
+                "prober cost %.3f%% of a decode step (bound: 2%%)"
+                % (100 * overhead))
         return record
     finally:
         checker.stop()
@@ -495,13 +678,14 @@ def summary_record(results):
     done = [k for k in ("kill_one_replica_under_load",
                         "slow_replica_tail", "pool_exhaustion_storm",
                         "weight_swap_under_load",
+                        "traced_flight_recorder",
                         "fault_free_overhead") if k in results]
     if done:
         return {
             "metric": "chaos_scenarios_passed",
             "value": len(done),
             "unit": "scenarios",
-            "vs_baseline": 5,
+            "vs_baseline": 6,
             "configs": results,
         }, 0
     return {"metric": "chaos_no_scenarios_completed", "value": None,
@@ -542,6 +726,9 @@ def run_bench(smoke=False, n_new=16, requests=12, seed=0):
     results["weight_swap_under_load"] = scenario_weight_swap(
         params, params_new, n_heads, max_len, prompts, n_new, expect,
         expect_new)
+    stream()
+    results["traced_flight_recorder"] = scenario_traced_flight_recorder(
+        params, n_heads, max_len, prompts, n_new, expect)
     stream()
     results["fault_free_overhead"] = scenario_overhead(
         params, n_heads, max_len, prompts[:4], n_new)

@@ -1,0 +1,155 @@
+"""Streamed summary-record schema guard (ISSUE 12 satellite).
+
+Every bench in this repo streams one ``summary_record`` JSON line to
+stdout after each completed leg — ``bench.py``, ``tools/lm_bench.py``,
+``tools/chaos_bench.py``, ``tools/profile_ops.py``,
+``tools/trace_report.py`` — and the driver (plus ``bench_report.py``
+and the TPU-session tooling) parses the LAST line, so a silent schema
+drift in any one tool breaks evidence collection without failing
+anything.  This checker makes the shared contract executable:
+
+- REQUIRED KEYS: every record carries ``metric`` (str), ``value``,
+  ``unit``, ``vs_baseline`` and ``configs`` — exactly the bench.py
+  shape.
+- JSON-CLEAN: the record round-trips through ``json.dumps`` (no numpy
+  scalars, no NaN/Infinity — strict parsers reject them).
+
+Two modes:
+
+- BUILTIN (default, <30s, rides tier-1 via ``tests/test_tools.py``):
+  import each tool and validate the record its ``summary_record``
+  produces for an EMPTY results dict — the worst-case partial stream a
+  watchdog kill can leave — plus ``profile_ops``'s streamed line.
+- FILE (``--file runs.jsonl``): validate every line of a captured
+  stream (a bench's stdout), so a real run's records can be audited
+  after the fact.
+
+Exit 0 when every record conforms; 1 with one problem per line
+otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import io
+import json
+import os
+import sys
+
+TOOLS_DIR = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(TOOLS_DIR)
+sys.path.insert(0, TOOLS_DIR)
+sys.path.insert(0, REPO)
+
+#: the shared record contract every streamed summary line honors
+REQUIRED_KEYS = ("metric", "value", "unit", "vs_baseline", "configs")
+
+
+def check_record(record, where="record"):
+    """Problems with one parsed record (empty list = conforming)."""
+    problems = []
+    if not isinstance(record, dict):
+        return ["%s: not a JSON object (got %s)"
+                % (where, type(record).__name__)]
+    for key in REQUIRED_KEYS:
+        if key not in record:
+            problems.append("%s: missing required key %r" % (where, key))
+    metric = record.get("metric")
+    if "metric" in record and (not isinstance(metric, str) or not metric):
+        problems.append("%s: metric must be a non-empty string (got %r)"
+                        % (where, metric))
+    try:
+        # strict JSON: numpy scalars and NaN/Infinity both die here,
+        # which is exactly what a downstream strict parser would do
+        json.loads(json.dumps(record, allow_nan=False))
+    except (TypeError, ValueError) as e:
+        problems.append("%s: not strict-JSON-serializable: %s"
+                        % (where, e))
+    return problems
+
+
+def check_line(line, where="line"):
+    """Problems with one raw stream line."""
+    line = line.strip()
+    if not line:
+        return []
+    try:
+        record = json.loads(line)
+    except json.JSONDecodeError as e:
+        return ["%s: does not parse as JSON: %s" % (where, e)]
+    return check_record(record, where)
+
+
+def check_stream(text, where="stream"):
+    problems = []
+    for i, line in enumerate(text.splitlines(), start=1):
+        problems.extend(check_line(line, "%s:%d" % (where, i)))
+    return problems
+
+
+def _builtin_records():
+    """(where, record) pairs from every streaming tool's
+    summary-record builder, fed the empty-results worst case (what a
+    watchdog kill right after startup leaves) — importing the tool IS
+    part of the check (an ImportError is a failed record source)."""
+    out = []
+
+    import bench
+    out.append(("bench.summary_record({})", bench.summary_record({})[0]))
+
+    import chaos_bench
+    import lm_bench
+    import trace_report
+    out.append(("lm_bench.summary_record({})",
+                lm_bench.summary_record({})[0]))
+    out.append(("chaos_bench.summary_record({})",
+                chaos_bench.summary_record({})[0]))
+    out.append(("trace_report.summary_record({})",
+                trace_report.summary_record({})[0]))
+
+    # profile_ops streams directly — capture its line
+    import profile_ops
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        profile_ops.stream_summary()
+    line = buf.getvalue().strip().splitlines()[-1]
+    out.append(("profile_ops.stream_summary()", json.loads(line)))
+    return out
+
+
+def check_builtin():
+    """Validate every tool's empty-results record; returns problems."""
+    problems = []
+    try:
+        records = _builtin_records()
+    except Exception as e:   # noqa: BLE001 — an unimportable tool IS
+        return ["collecting builtin records failed: %s: %s"
+                % (type(e).__name__, e)]
+    for where, record in records:
+        problems.extend(check_record(record, where))
+    return problems
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--file", default=None, metavar="JSONL",
+                        help="validate every line of this captured "
+                             "stream instead of the builtin tool check")
+    args = parser.parse_args(argv)
+    if args.file:
+        with open(args.file, "r", encoding="utf-8") as f:
+            problems = check_stream(f.read(), args.file)
+        checked = "stream %s" % args.file
+    else:
+        problems = check_builtin()
+        checked = "builtin summary_record sources"
+    for p in problems:
+        print("PROBLEM: %s" % p, file=sys.stderr)
+    print(json.dumps({"checked": checked,
+                      "problems": len(problems)}))
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

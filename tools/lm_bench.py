@@ -218,12 +218,24 @@ def run_leg(params, n_heads, max_len, prompts, n_new, expect,
                                    replica_device_slices)
     tp = int(engine_kw.pop("tp", 0) or 0)
     replicas = int(engine_kw.pop("replicas", 1) or 1)
+    trace = bool(engine_kw.pop("trace", False))
     n_devices = max(1, replicas) * max(1, tp)
     features = {k: v for k, v in engine_kw.items() if v}
     if tp:
         features["tp"] = tp
     if replicas > 1:
         features["replicas"] = replicas
+    tracer = None
+    if trace:
+        # the TRACED legs (ISSUE 12): one shared tracer across the
+        # fleet, every request retained — after the run the span trees
+        # must VERIFY (one root per request, no orphans, no unclosed
+        # spans) or the leg fails; the ring is sized so closed-loop
+        # admission retries cannot evict real requests
+        from veles_tpu.serving import SpanTracer
+        features["trace"] = True
+        tracer = SpanTracer(mode="all",
+                            last=8 * max(1, len(prompts)) + 64)
     if n_devices > 1 and jax.device_count() < n_devices:
         # recorded, never silent: a truncated matrix must say so
         return {"features": features,
@@ -244,14 +256,15 @@ def run_leg(params, n_heads, max_len, prompts, n_new, expect,
         return LMEngine(params, n_heads=n_heads, max_len=max_len,
                         slots=slots, queue_depth=max(64, len(prompts)),
                         metrics=ServingMetrics(tag, labels=labels),
-                        tp=tp, devices=devices,
+                        tp=tp, devices=devices, tracer=tracer,
                         name=tag if idx is None else "%s_r%d"
                         % (tag, idx), **engine_kw)
 
     if replicas > 1:
         engines = [build(i) for i in range(replicas)]
         server = Router(engines,
-                        metrics=ServingMetrics("lm_bench_router"))
+                        metrics=ServingMetrics("lm_bench_router"),
+                        tracer=tracer)
     else:
         engines = [build()]
         server = engines[0]
@@ -412,6 +425,35 @@ def run_leg(params, n_heads, max_len, prompts, n_new, expect,
             record["replica_tokens_out"] = [
                 s["counters"].get("tokens_out", 0)
                 for s in warm["per_replica"]]
+        if tracer is not None:
+            # span-tree integrity is an ASSERTION, not a report: every
+            # request rooted, no orphans, no unclosed spans — under
+            # whatever fast-path combination this leg ran — and the
+            # Chrome export must be strict-parseable JSON
+            from veles_tpu.serving import cost_ledger, verify_integrity
+            recs = tracer.requests()
+            integrity = verify_integrity(recs)
+            if integrity["requests"] < 2 * len(prompts):
+                raise AssertionError(
+                    "traced leg retained %d request traces for %d "
+                    "requests x 2 passes under %r"
+                    % (integrity["requests"], len(prompts), features))
+            chrome = tracer.export_chrome()
+            json.loads(json.dumps(chrome, allow_nan=False))
+            ledger = cost_ledger(recs)
+            if not ledger:
+                raise AssertionError(
+                    "traced leg produced an empty cost ledger "
+                    "under %r" % (features,))
+            record["trace"] = {
+                "requests": integrity["requests"],
+                "spans": integrity["spans"],
+                "integrity": True,
+                "chrome_events": len(chrome["traceEvents"]),
+                "ledger_rows": len(ledger),
+                "ledger_dispatches": int(sum(r["dispatches"]
+                                             for r in ledger)),
+            }
         return record
     finally:
         server.stop()
@@ -560,6 +602,20 @@ def run_bench(smoke=False, slots=4, chunk=16, cache=256, spec_k=4,
                       "prefill_chunk": chunk},
         "tp2_replicas2": {"tp": 2, "replicas": 2, "paged_kv": True,
                           "prefill_chunk": chunk},
+        # ISSUE 12: the TRACED legs — the full fast-path stack with the
+        # span tracer armed.  Parity still asserted (tracing must not
+        # perturb output), span-tree integrity asserted per request,
+        # and the record carries the cost-ledger shape (rows, deduped
+        # dispatch count).  traced_tp2_all is the acceptance combo
+        # (prefix_cache + prefill_chunk + spec_k + paged_kv + tp
+        # dryrun); hosts without 2 devices bank a 'skipped' record.
+        "traced_all": {"paged_kv": True, "prefix_cache": cache,
+                       "prefill_chunk": chunk, "spec_k": spec_k,
+                       "trace": True},
+        "traced_tp2_all": {"tp": 2, "paged_kv": True,
+                           "prefix_cache": cache,
+                           "prefill_chunk": chunk, "spec_k": spec_k,
+                           "trace": True},
     }
     # workload A: shared system prompt (load_gen's generator — one
     # request per "client", every prompt shares the prefix)

@@ -176,10 +176,15 @@ def run_load(url, payload, clients=8, requests_per_client=20, qps=None,
         #: per-request facts aligned with ``responses`` — LM mode reads
         #: these to pair each reply with its generating (client, index);
         #: ``t`` is the submit offset from the run start (seconds), so
-        #: a weight-swap cutover is placeable on the run's timeline
+        #: a weight-swap cutover is placeable on the run's timeline;
+        #: ``request_id`` is the server's per-reply stamp (ISSUE 12) —
+        #: the join key between client records, server traces
+        #: (/trace.json) and log lines
         "records": [{"status": code, "latency_s": dt, "client": ci,
-                     "req": n, "class": klass, "t": round(t, 6)}
-                    for code, dt, _, ci, n, klass, t in results],
+                     "req": n, "class": klass, "t": round(t, 6),
+                     "request_id": (r or {}).get("request_id")
+                     if isinstance(r, dict) else None}
+                    for code, dt, r, ci, n, klass, t in results],
     }
 
 

@@ -1,0 +1,236 @@
+"""Trace report (ISSUE 12): per-request waterfalls + the per-op cost
+ledger from an exported serving trace.
+
+Input is the Chrome-trace JSON the serving stack exports — ``GET
+/trace.json`` on a ``--serve-trace``-armed server, or
+``SpanTracer.export_chrome()`` written to a file (``.json`` or
+``.json.gz``).  Two views:
+
+- WATERFALL — one request's span timeline as indented ASCII bars
+  (``--request RID``; default: the slowest request, ``--all`` for every
+  request).  The same rendering the flight recorder dumps on
+  error/deadline.
+- COST LEDGER — every device-dispatch span aggregated into (op family
+  x bucket x backend) rows with dispatch count and p50/p95/mean
+  duration: the measured per-op cost table the ROADMAP's
+  cost-model-driven autotuning item needs.  Batched spans (one decode
+  tick, many lanes) are deduplicated by dispatch id, so counts are
+  device programs launched.
+
+A bench.py-style summary JSON line (metric/value/unit/vs_baseline/
+configs) streams to stdout after each completed stage, last-line-wins —
+the ledger rides in ``configs["ledger"]`` and ``--ledger-json FILE``
+writes it standalone for downstream consumers.
+
+Standalone::
+
+    python tools/trace_report.py trace.json [--request RID | --all]
+        [--last N] [--ledger-json FILE] [--json FILE]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from trace_analyze import load_events  # noqa: E402 — the ONE
+#                        gzip-aware Chrome-trace loader in tools/
+from veles_tpu.serving.tracing import (cost_ledger,  # noqa: E402
+                                       format_waterfall,
+                                       verify_integrity)
+
+
+def load_trace(path):
+    """Event list of a Chrome-trace JSON file (.json or .json.gz) —
+    ``trace_analyze.load_events`` plus tolerance for the bare-list
+    trace form (the JSON Array Format chrome://tracing also accepts)."""
+    try:
+        return load_events(path)
+    except (KeyError, TypeError):
+        import gzip
+        opener = gzip.open if path.endswith(".gz") else open
+        with opener(path, "rt", encoding="utf-8") as f:
+            return list(json.load(f))
+
+
+def rebuild_requests(events):
+    """Reconstruct per-request span records from exported events (the
+    inverse of ``SpanTracer.export_chrome``): every complete (ph X)
+    event whose args carry a ``rid`` joins that request, with
+    sid/parent/attrs recovered from args.  Returns records in the
+    tracing-module shape (rid/error/deadline_blown/unclosed/spans), so
+    ``format_waterfall`` / ``cost_ledger`` / ``verify_integrity`` all
+    apply unchanged."""
+    recs = {}
+    flags = {}
+    for ev in events:
+        if ev.get("ph") == "M" and ev.get("name") == "thread_name":
+            args = ev.get("args") or {}
+            name = args.get("name", "")
+            if "rid" in args:
+                # the structured form (rid-with-spaces safe; carries
+                # the real error string)
+                flags[str(args["rid"])] = {
+                    "error": args.get("error") or None,
+                    "deadline": bool(args.get("deadline_blown"))}
+            elif name.startswith("req "):
+                # label-only fallback for hand-built traces
+                rid = name[4:].split(" ", 1)[0]
+                flags[rid] = {"error": "[ERROR]" in name,
+                              "deadline": "[DEADLINE]" in name}
+            continue
+        if ev.get("ph") != "X":
+            continue
+        args = dict(ev.get("args") or {})
+        rid = args.pop("rid", None)
+        if rid is None:
+            continue
+        sid = args.pop("sid", None)
+        parent = args.pop("parent", None)
+        t0 = ev.get("ts", 0.0) / 1e6
+        rec = recs.setdefault(rid, {"rid": rid, "error": None,
+                                    "deadline_blown": False,
+                                    "unclosed": [], "spans": []})
+        rec["spans"].append({
+            "sid": sid, "parent": parent, "name": ev.get("name", "?"),
+            "cat": ev.get("cat", "span"), "t0": t0,
+            "t1": t0 + ev.get("dur", 0.0) / 1e6, "attrs": args})
+    for rid, f in flags.items():
+        if rid in recs:
+            if f["error"]:
+                recs[rid]["error"] = (f["error"] if f["error"] is not
+                                      True else
+                                      "errored (see flight recorder)")
+            recs[rid]["deadline_blown"] = f["deadline"]
+    out = list(recs.values())
+    out.sort(key=lambda r: min((s["t0"] for s in r["spans"]),
+                               default=0.0))
+    return out
+
+
+def request_wall(rec):
+    if not rec["spans"]:
+        return 0.0
+    return (max(s["t1"] for s in rec["spans"])
+            - min(s["t0"] for s in rec["spans"]))
+
+
+def summary_record(results):
+    """(record, exit_code) in the bench.py shape — one selection rule:
+    traced dispatch count once the ledger exists, request count while
+    only parsing finished."""
+    ledger = results.get("ledger")
+    if ledger is not None:
+        return {
+            "metric": "trace_ledger_dispatches",
+            "value": int(sum(r["dispatches"] for r in ledger)),
+            "unit": "dispatches",
+            "vs_baseline": None,
+            "configs": results,
+        }, 0
+    if results.get("requests") is not None:
+        return {
+            "metric": "trace_requests_parsed",
+            "value": results["requests"],
+            "unit": "requests",
+            "vs_baseline": None,
+            "configs": results,
+        }, 0
+    return {"metric": "trace_report_empty", "value": None,
+            "unit": None, "vs_baseline": None, "configs": results}, 1
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("trace", help="Chrome-trace JSON exported by "
+                        "GET /trace.json or SpanTracer.export_chrome "
+                        "(.json or .json.gz)")
+    parser.add_argument("--request", default=None, metavar="RID",
+                        help="waterfall this request id (default: the "
+                             "slowest request)")
+    parser.add_argument("--all", action="store_true",
+                        help="waterfall every request")
+    parser.add_argument("--last", type=int, default=None, metavar="N",
+                        help="only the newest N requests")
+    parser.add_argument("--check", action="store_true",
+                        help="also verify span-tree integrity (every "
+                             "request one root, no orphans, no "
+                             "unclosed spans) — non-zero exit on a "
+                             "violation")
+    parser.add_argument("--ledger-json", default=None, metavar="FILE",
+                        help="write the cost ledger rows as JSON")
+    parser.add_argument("--json", default=None, metavar="FILE",
+                        help="also write the final summary record here")
+    args = parser.parse_args(argv)
+
+    results = {"trace": args.trace, "requests": None}
+    records = rebuild_requests(load_trace(args.trace))
+    if args.last:
+        records = records[-args.last:]
+    results["requests"] = len(records)
+    results["errored"] = sum(1 for r in records if r["error"])
+    results["deadline_blown"] = sum(1 for r in records
+                                    if r["deadline_blown"])
+    print(json.dumps(summary_record(results)[0]), flush=True)
+
+    if args.check:
+        integrity = verify_integrity(records)   # raises on violation
+        results["integrity"] = integrity
+        print("span-tree integrity: %d request(s), %d span(s), clean"
+              % (integrity["requests"], integrity["spans"]),
+              file=sys.stderr)
+
+    # ---- waterfall(s)
+    if records:
+        if args.all:
+            shown = records
+        elif args.request is not None:
+            shown = [r for r in records if r["rid"] == args.request]
+            if not shown:
+                print("request %r not in this trace (have: %s)"
+                      % (args.request,
+                         ", ".join(r["rid"] for r in records[:20])),
+                      file=sys.stderr)
+                return 1
+        else:
+            shown = [max(records, key=request_wall)]
+        for rec in shown:
+            print(format_waterfall(rec), file=sys.stderr)
+            print(file=sys.stderr)
+        results["waterfall_requests"] = [r["rid"] for r in shown]
+
+    # ---- the per-op cost ledger
+    ledger = cost_ledger(records)
+    results["ledger"] = ledger
+    if ledger:
+        cols = ("op", "bucket", "backend", "dispatches", "lanes",
+                "p50_ms", "p95_ms", "mean_ms", "total_ms")
+        widths = [max(len(c), *(len(str(r[c])) for r in ledger))
+                  for c in cols]
+        line = "  ".join(c.ljust(w) for c, w in zip(cols, widths))
+        print(line, file=sys.stderr)
+        for r in ledger:
+            print("  ".join(str(r[c]).ljust(w)
+                            for c, w in zip(cols, widths)),
+                  file=sys.stderr)
+    if args.ledger_json:
+        with open(args.ledger_json, "w", encoding="utf-8") as f:
+            json.dump({"ledger": ledger, "requests": len(records)}, f)
+
+    record, rc = summary_record(results)
+    line = json.dumps(record)
+    print(line)                  # final full record — last line wins
+    if args.json:
+        with open(args.json, "w", encoding="utf-8") as f:
+            f.write(line + "\n")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -314,6 +314,29 @@ def build_argparser():
                              "traffic steered at it before ramping "
                              "the rest of the fleet; 0 = one "
                              "instantaneous signal check (default 2s)")
+    parser.add_argument("--serve-trace", default="off",
+                        metavar="MODE",
+                        help="with --serve: end-to-end request "
+                             "tracing (veles_tpu/serving/tracing.py) "
+                             "— off|errors|all|sample:P.  Spans cover "
+                             "the whole request path (HTTP root, "
+                             "router attempts, queue wait, prefill "
+                             "chunks, decode ticks, spec verify, COW "
+                             "copies), the last N requests stay "
+                             "reconstructable in a flight-recorder "
+                             "ring (errors auto-dump a waterfall), "
+                             "and GET /trace.json exports Chrome-"
+                             "trace/Perfetto JSON "
+                             "(tools/trace_report.py renders "
+                             "waterfalls + the per-op cost ledger).  "
+                             "'errors' retains only errored/deadline-"
+                             "blown requests; 'sample:0.01' traces "
+                             "1%% of traffic (default: off — zero "
+                             "overhead)")
+    parser.add_argument("--serve-trace-last", type=int, default=256,
+                        metavar="N",
+                        help="with --serve-trace: flight-recorder "
+                             "ring size in requests (default 256)")
     parser.add_argument("--serve-no-auto-rollback",
                         action="store_true",
                         help="with --serve-model-dir: do NOT roll a "
@@ -540,6 +563,8 @@ def main(argv=None):
                                args.serve_publish_interval),
                            canary=args.serve_canary,
                            canary_watch_s=args.serve_canary_watch,
+                           trace=args.serve_trace,
+                           trace_last=args.serve_trace_last,
                            auto_rollback=(
                                not args.serve_no_auto_rollback))
         else:

@@ -38,6 +38,8 @@ from __future__ import annotations
 import json
 import threading
 import time
+import urllib.parse
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy
@@ -48,13 +50,18 @@ from veles_tpu.logger import Logger
 class RESTfulAPI(Logger):
     def __init__(self, workflow, normalizer=None, forward=None,
                  handler=None, metrics=None, max_body=16 << 20,
-                 faults=None):
+                 faults=None, tracer=None):
         self.workflow = workflow
         #: optional serving FaultPlan (ISSUE 10): the ``http.request``
         #: site fires per POST — transient InjectedHTTPError replies
         #: (the retryable-infrastructure-blip shape) and latency
         #: spikes; a no-op when None
         self.faults = faults
+        #: optional serving SpanTracer (ISSUE 12): every POST opens an
+        #: ``http.request`` root span keyed by the request id, and
+        #: ``GET /trace.json?last=N`` exports the flight recorder as
+        #: Chrome-trace JSON; a no-op when None
+        self.tracer = tracer
         #: optional HealthChecker owned by serve_lm (stopped with the
         #: server)
         self.health_checker = None
@@ -141,7 +148,8 @@ class RESTfulAPI(Logger):
             self._ensure_forward(), max_batch=max_batch,
             queue_depth=queue_depth, batch_wait_s=batch_wait_s,
             deadline_s=deadline_s, sample_shape=sample_shape,
-            metrics=m, name=name, faults=self.faults)
+            metrics=m, name=name, faults=self.faults,
+            tracer=self.tracer)
         self.metrics = m
         return self
 
@@ -188,9 +196,25 @@ class RESTfulAPI(Logger):
                 self.wfile.write(body)
 
             def do_GET(self):
-                path = self.path.rstrip("/")
+                split = urllib.parse.urlsplit(self.path)
+                path = split.path.rstrip("/")
                 if path == "/metrics.json" and api.metrics is not None:
                     self._reply(200, api.metrics.snapshot())
+                elif path == "/trace.json" and api.tracer is not None:
+                    # the flight recorder as Chrome-trace/Perfetto JSON
+                    # (ISSUE 12): ?last=N trims to the newest N
+                    # requests; load at ui.perfetto.dev
+                    query = urllib.parse.parse_qs(split.query)
+                    last = None
+                    try:
+                        if query.get("last"):
+                            last = int(query["last"][0])
+                    except ValueError:
+                        self._reply(400, {"error": "last must be an "
+                                          "integer"})
+                        return
+                    self._reply(200, api.tracer.export_chrome(
+                        last=last))
                 elif path == "/metrics":
                     from veles_tpu.serving import metrics as metrics_mod
                     # merge this server's instance into the registry
@@ -212,31 +236,75 @@ class RESTfulAPI(Logger):
                                       % self.path})
 
             def do_POST(self):
+                # request-id stamping (ISSUE 12 satellite): echo the
+                # client's X-Request-Id (or mint one) on EVERY reply —
+                # success and structured error — so client logs,
+                # traces, and load_gen records join on one key
                 t0 = time.monotonic()
+                rid = (self.headers.get("X-Request-Id") or "").strip()
+                rid = rid[:64] or uuid.uuid4().hex[:16]
+                ctx = None
+                if api.tracer is not None:
+                    ctx = api.tracer.start_request(
+                        rid=rid, name="http.request", cat="http",
+                        attrs={"path": self.path})
+                #: set by _handle_post's DeadlineExceeded branch — a
+                #: 503 alone is not proof of a deadline (injected
+                #: transient 503s are not sheds)
+                self._shed = False
+                code, payload, headers = 500, {"error": "internal"}, []
+                try:
+                    if api.tracer is not None:
+                        from veles_tpu.serving import tracing
+                        # ctx None = the sampler skipped this request:
+                        # bind the sentinel so the router/engine below
+                        # do not re-roll and root partial trees
+                        with tracing.use(ctx if ctx is not None
+                                         else tracing.SAMPLED_OUT):
+                            code, payload, headers = \
+                                self._handle_post(t0)
+                    else:
+                        code, payload, headers = self._handle_post(t0)
+                finally:
+                    if ctx is not None:
+                        # 5xx replies dump the flight recorder; only a
+                        # real DeadlineExceeded is the deadline-blown
+                        # shape (an injected transient 503 is not)
+                        api.tracer.finish_request(
+                            ctx,
+                            error=("http %d" % code) if code >= 500
+                            else None,
+                            deadline=self._shed,
+                            attrs={"status": code})
+                if isinstance(payload, dict):
+                    payload.setdefault("request_id", rid)
+                self._reply(code, payload,
+                            list(headers) + [("X-Request-Id", rid)])
+
+            def _handle_post(self, t0):
+                """Run one POST; returns (code, json_payload, headers)
+                — the reply itself (request-id stamp, trace-root
+                closure) happens in do_POST."""
                 try:
                     length = int(self.headers.get("Content-Length", 0))
                 except ValueError:
-                    self._reply(400, {"error": "malformed "
-                                      "Content-Length header"})
-                    return
+                    return 400, {"error": "malformed "
+                                 "Content-Length header"}, []
                 if self.path.rstrip("/") != "/predict":
                     self._drain(length)
-                    self._reply(404, {"error": "unknown path %r — POST "
-                                      "/predict" % self.path})
-                    return
+                    return 404, {"error": "unknown path %r — POST "
+                                 "/predict" % self.path}, []
                 if length > api.max_body:
                     self._drain(length)
-                    self._reply(413, {
+                    return 413, {
                         "error": "request body %d bytes exceeds the "
-                                 "%d limit" % (length, api.max_body)})
-                    return
+                                 "%d limit" % (length, api.max_body)}, []
                 try:    # parse: malformed payloads are 400, full stop
                     payload = json.loads(self.rfile.read(length))
                     batch = payload["input"]     # both flows require it
                 except (json.JSONDecodeError, KeyError, TypeError) as e:
-                    self._reply(400, {"error": "%s: %s"
-                                      % (type(e).__name__, e)})
-                    return
+                    return 400, {"error": "%s: %s"
+                                 % (type(e).__name__, e)}, []
                 if api.faults is not None:
                     from veles_tpu.serving.faults import InjectedHTTPError
                     try:
@@ -250,10 +318,9 @@ class RESTfulAPI(Logger):
                         if e.code in (429, 503):
                             headers = [("Retry-After", "%d" % max(
                                 1, int(e.retry_after + 0.999)))]
-                        self._reply(e.code, {
+                        return e.code, {
                             "error": str(e),
-                            "retry_after": e.retry_after}, headers)
-                        return
+                            "retry_after": e.retry_after}, headers
                 try:    # dispatch
                     result = (api._handler(payload)
                               if api._handler is not None
@@ -261,33 +328,30 @@ class RESTfulAPI(Logger):
                 except Overloaded as e:
                     # Retry-After is integer delta-seconds per RFC 9110
                     # (the exact float rides in the JSON body)
-                    self._reply(429, {"error": str(e),
-                                      "retry_after": e.retry_after},
-                                headers=[("Retry-After", "%d" % max(
-                                    1, int(e.retry_after + 0.999)))])
-                    return
+                    return 429, {"error": str(e),
+                                 "retry_after": e.retry_after}, \
+                        [("Retry-After", "%d" % max(
+                            1, int(e.retry_after + 0.999)))]
                 except DeadlineExceeded as e:
-                    self._reply(503, {"error": str(e)},
-                                headers=[("Retry-After", "1")])
-                    return
+                    self._shed = True
+                    return 503, {"error": str(e)}, [("Retry-After",
+                                                     "1")]
                 except (TypeError, ValueError) as e:
                     # input-validation contract: shape/range/length
                     # complaints raised while processing the payload
                     # (batcher shape check, serve_lm prompt bounds, bad
                     # knob types) are the CLIENT's error
-                    self._reply(400, {"error": "%s: %s"
-                                      % (type(e).__name__, e)})
-                    return
+                    return 400, {"error": "%s: %s"
+                                 % (type(e).__name__, e)}, []
                 except Exception as e:   # noqa: BLE001 — server fault
                     if api.metrics is not None:
                         api.metrics.record_error()
                     api.warning("request failed: %s", e)
-                    self._reply(500, {"error": "%s: %s"
-                                      % (type(e).__name__, e)})
-                    return
+                    return 500, {"error": "%s: %s"
+                                 % (type(e).__name__, e)}, []
                 if api.metrics is not None:
                     api.metrics.record_response(time.monotonic() - t0)
-                self._reply(200, result)
+                return 200, result, []
 
             def log_message(self, fmt, *args):
                 api.debug("restful: " + fmt, *args)
@@ -328,7 +392,7 @@ def serve_lm(workflow, host="127.0.0.1", port=8180, max_new=256,
              health=False, health_interval_s=1.0, hedge=0.0,
              retries=0, fault_plan=None, model_dir=None,
              publish_interval_s=5.0, canary=1, canary_watch_s=2.0,
-             auto_rollback=True):
+             auto_rollback=True, trace=None, trace_last=256):
     """Serve a trained transformer-trainer workflow (e.g. char_lm) for
     autoregressive continuation: POST ``{"input": [[tok, ...]],
     "n_new": N, "temperature": T, "top_k": K, "seed": S}`` to
@@ -411,6 +475,23 @@ def serve_lm(workflow, host="127.0.0.1", port=8180, max_new=256,
     so clients can observe the cutover (``tools/load_gen.py --lm``
     aggregates it).  See USAGE.md "Zero-downtime weight updates".
 
+    REQUEST TRACING (ISSUE 12): ``trace='all'|'errors'|'sample:P'``
+    arms a :class:`veles_tpu.serving.SpanTracer` threaded through the
+    whole request path — HTTP root span, router attempt spans, queue
+    wait, every prefill chunk / decode tick / speculative verify / COW
+    copy, with device dispatches fenced so durations are device wall
+    time.  The last ``trace_last`` finished requests stay
+    reconstructable in a flight-recorder ring (errored/deadline-blown
+    requests are auto-dumped as waterfall text), ``GET
+    /trace.json?last=N`` exports Chrome-trace/Perfetto JSON, and
+    ``tools/trace_report.py`` renders waterfalls + the per-op cost
+    ledger.  Default off: every site is one attribute-is-None check
+    (the ``faults.py`` discipline; the chaos bench pins unarmed
+    overhead <2%% of a decode step).  Every JSON reply (success and
+    error) is stamped with a ``request_id`` echoed from the
+    ``X-Request-Id`` header or generated server-side, whether or not
+    tracing is armed.
+
     The direct path decodes one prompt batch at a time via the
     KV-cached ``transformer.generate``, one jitted dispatch per
     request.  Compile count and per-request cost are both BOUNDED
@@ -437,6 +518,8 @@ def serve_lm(workflow, host="127.0.0.1", port=8180, max_new=256,
     # n_new; {8,32,max} alone made an n_new=40 request pay a full
     # max_new=256 decode)
     tiers = sorted({t for t in (8, 32, 128, max_new) if t <= max_new})
+    from veles_tpu.serving.tracing import SpanTracer
+    tracer = SpanTracer.from_spec(trace, last=int(trace_last))
     engine = None
     checker = None
     manager = None
@@ -481,7 +564,7 @@ def serve_lm(workflow, host="127.0.0.1", port=8180, max_new=256,
                 paged_kv=paged_kv, attn_kernel=attn_kernel,
                 tp=tp_n, devices=devices, name=eng_name,
                 metrics=metrics_mod.new("lm", labels=label),
-                faults=fault_plan)
+                faults=fault_plan, tracer=tracer)
 
         if n_rep > 1 or resilient:
             routed = True
@@ -491,7 +574,7 @@ def serve_lm(workflow, host="127.0.0.1", port=8180, max_new=256,
                 metrics=metrics_mod.register(RouterMetrics("lm_router")),
                 policy=router, retries=int(retries),
                 hedge_after_s=float(hedge or 0.0),
-                faults=fault_plan).start()
+                faults=fault_plan, tracer=tracer).start()
             if health:
                 checker = HealthChecker(
                     engine, interval_s=float(health_interval_s),
@@ -569,7 +652,7 @@ def serve_lm(workflow, host="127.0.0.1", port=8180, max_new=256,
 
     api = RESTfulAPI(None, handler=handler,
                      metrics=engine.metrics if engine is not None
-                     else None, faults=fault_plan)
+                     else None, faults=fault_plan, tracer=tracer)
     api.lm_engine = engine
     api.health_checker = checker
     api.model_manager = manager

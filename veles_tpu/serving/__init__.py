@@ -62,6 +62,17 @@ request, this package amortizes dispatch across concurrent clients.
   ``serve_lm(model_dir=, canary=, auto_rollback=)``, CLI
   ``--serve-model-dir`` / ``--serve-canary`` /
   ``--serve-publish-interval``.
+- :mod:`veles_tpu.serving.tracing` — :class:`SpanTracer` (ISSUE
+  12): end-to-end request tracing — an ``http.request`` root span, one
+  span per router placement attempt, queue wait, every prefill chunk /
+  decode tick / speculative verify / COW copy, device dispatches fenced
+  via ``block_until_ready`` only when armed.  Finished requests land in
+  a bounded flight-recorder ring (errored/deadline-blown requests
+  auto-dump a waterfall), export as Chrome-trace/Perfetto JSON (``GET
+  /trace.json?last=N``), and aggregate into the per-op cost ledger
+  (``tools/trace_report.py``).  ``serve_lm(trace=)``, CLI
+  ``--serve-trace off|errors|sample:P|all``; unarmed cost is one
+  attribute-is-None check per site (the ``faults.py`` discipline).
 - :mod:`veles_tpu.serving.metrics` — :class:`ServingMetrics`:
   lock-cheap counters/histograms (queue wait, batch size, latency
   percentiles, shed/429, slot occupancy) with a snapshot API and a
@@ -89,8 +100,13 @@ from veles_tpu.serving.model_manager import (ModelManager,
 from veles_tpu.serving.router import (HealthChecker, NoLiveReplicas,
                                       Router, RouterMetrics,
                                       replica_device_slices)
+from veles_tpu.serving.tracing import (SpanTracer, TraceContext,
+                                       cost_ledger, format_waterfall,
+                                       verify_integrity)
 
 __all__ = ["MicroBatcher", "LMEngine", "RadixPrefixCache",
+           "SpanTracer", "TraceContext", "cost_ledger",
+           "format_waterfall", "verify_integrity",
            "KVPagePool", "Router", "RouterMetrics", "HealthChecker",
            "ModelManager", "ServingMetrics", "FaultPlan",
            "InjectedFault",

@@ -32,6 +32,7 @@ from concurrent.futures import Future
 import numpy
 
 from veles_tpu.logger import Logger
+from veles_tpu.serving import tracing
 from veles_tpu.serving.metrics import ServingMetrics
 
 
@@ -70,13 +71,17 @@ class DeadlineExceeded(RuntimeError):
 
 
 class _Item:
-    __slots__ = ("rows", "future", "t_enq", "deadline")
+    __slots__ = ("rows", "future", "t_enq", "deadline", "trace",
+                 "tspan")
 
     def __init__(self, rows, deadline_s):
         self.rows = rows
         self.future = Future()
         self.t_enq = time.monotonic()
         self.deadline = self.t_enq + deadline_s
+        #: tracing (ISSUE 12): request context + open queue-wait span
+        self.trace = None
+        self.tspan = None
 
 
 def batch_buckets(max_batch):
@@ -102,7 +107,7 @@ class MicroBatcher(Logger):
     def __init__(self, forward, max_batch=64, queue_depth=128,
                  batch_wait_s=0.002, deadline_s=2.0, sample_shape=None,
                  dtype=numpy.float32, metrics=None, name="predict",
-                 faults=None):
+                 faults=None, tracer=None):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         if queue_depth < 1:
@@ -111,6 +116,9 @@ class MicroBatcher(Logger):
         #: optional serving/faults.py FaultPlan (ISSUE 10) — the
         #: batcher.* sites are one is-None check when unarmed
         self._faults = faults
+        #: optional serving/tracing.py SpanTracer (ISSUE 12), same
+        #: unarmed-is-one-check discipline
+        self._tracer = tracer
         self.forward = forward
         self.max_batch = int(max_batch)
         self.buckets = batch_buckets(self.max_batch)
@@ -160,6 +168,22 @@ class MicroBatcher(Logger):
             raise ValueError("submit needs at least one row")
         if self._faults is not None:
             self._faults.fire("batcher.submit")
+        tctx, own_root = None, False
+        if self._tracer is not None:
+            tctx, own_root = tracing.join_or_root(
+                self._tracer, "batch.request", "batch",
+                attrs={"engine": self.name})
+            if tctx is tracing.SAMPLED_OUT:
+                tctx = None
+        try:
+            item = self._admit(rows, tctx, own_root)
+        except Exception as e:
+            if own_root:
+                tctx.tracer.finish_request(tctx, error=e)
+            raise
+        return item.future.result()
+
+    def _admit(self, rows, tctx, own_root):
         with self._cond:
             if self._stop or self._thread is None:
                 raise RuntimeError("micro-batcher is not running")
@@ -180,11 +204,20 @@ class MicroBatcher(Logger):
                 raise Overloaded(retry_after=max(
                     0.01, self._dispatch_ewma))
             item = _Item(rows, self.deadline_s)
+            if tctx is not None:
+                item.trace = tctx
+                item.tspan = tctx.tracer.begin(
+                    tctx, "queue.wait", cat="queue",
+                    attrs={"engine": self.name})
+                if own_root:
+                    item.future.add_done_callback(
+                        lambda f, ctx=tctx:
+                        tracing.finish_from_future(ctx, f))
             self._queue.append(item)
             self.metrics.record_enqueue()
             self.metrics.set_gauge("queue_depth", len(self._queue))
             self._cond.notify()
-        return item.future.result()
+        return item
 
     # ------------------------------------------------------------------ worker
     def _take_batch(self):
@@ -230,6 +263,14 @@ class MicroBatcher(Logger):
         A single oversized request (rows > max_batch) is chunked over
         several max_batch dispatches."""
         now = time.monotonic()
+        for it in items:
+            # close queue-wait spans BEFORE the fault site: an injected
+            # dispatch error fails these clients, and their finished
+            # trees must carry no unclosed spans
+            if it.tspan is not None:
+                it.trace.tracer.end(it.tspan, attrs={
+                    "wait_s": round(now - it.t_enq, 6)})
+                it.tspan = None
         if self._faults is not None:
             # inside the worker's dispatch try: an injected error rides
             # the real fault-isolation path (fails the batch's clients,
@@ -250,6 +291,14 @@ class MicroBatcher(Logger):
             out = numpy.asarray(self.forward(chunk))
             self._dispatch_ewma = (0.8 * self._dispatch_ewma
                                    + 0.2 * (time.monotonic() - t0))
+            if self._tracer is not None:
+                # numpy.asarray above already forced the result — no
+                # extra fence needed on this path
+                self._tracer.add_many(
+                    [it.trace for it in items], "batch.dispatch",
+                    "batch", t0, time.monotonic(),
+                    attrs={"rows": real, "bucket": bucket,
+                           "backend": "xla"})
             outs.append(out[:real])
             # histogram the REAL coalesced rows, not the bucket padding —
             # the coalescing evidence must not be inflated by zero rows
@@ -271,6 +320,9 @@ class MicroBatcher(Logger):
             items, expired = self._take_batch()
             for it in expired:
                 self.metrics.record_shed()
+                if it.tspan is not None:
+                    it.trace.tracer.end(it.tspan, error="shed")
+                    it.tspan = None
                 it.future.set_exception(DeadlineExceeded(
                     "request shed after %.3fs in queue (deadline %.3fs)"
                     % (time.monotonic() - it.t_enq, self.deadline_s)))

@@ -144,6 +144,7 @@ from concurrent.futures import Future
 import numpy
 
 from veles_tpu.logger import Logger
+from veles_tpu.serving import tracing
 from veles_tpu.serving.batcher import (DeadlineExceeded, Overloaded,
                                        PoolExhausted)
 from veles_tpu.serving.kv_pool import KVPagePool
@@ -152,7 +153,7 @@ from veles_tpu.serving.metrics import ServingMetrics
 
 class _Request:
     __slots__ = ("prompt", "true_len", "n_new", "future", "t_enq",
-                 "deadline", "cancelled", "pages")
+                 "deadline", "cancelled", "pages", "trace", "tspan")
 
     def __init__(self, prompt, n_new, deadline_s, pages=0):
         self.prompt = prompt          # (s,) int32, unpadded
@@ -165,6 +166,11 @@ class _Request:
         self.cancelled = False
         #: paged mode: worst-case page demand (admission reservation)
         self.pages = pages
+        #: tracing (ISSUE 12): the request's TraceContext (or None) and
+        #: its open queue-wait span handle — how the worker thread
+        #: attributes its dispatch spans to the right request
+        self.trace = None
+        self.tspan = None
 
 
 class _Slot:
@@ -410,7 +416,8 @@ class LMEngine(Logger):
                  metrics=None, name="lm", prefill_chunk=0,
                  prefix_cache=0, spec_k=0, spec_ngram=3,
                  queue_tokens=0, paged_kv=0, attn_kernel=None,
-                 tp=0, devices=None, faults=None, version=0):
+                 tp=0, devices=None, faults=None, version=0,
+                 tracer=None):
         import jax
         import jax.numpy as jnp
         if slots < 1:
@@ -419,6 +426,9 @@ class LMEngine(Logger):
         #: optional serving/faults.py FaultPlan — every engine.* site
         #: is one is-None check when unarmed (ISSUE 10)
         self._faults = faults
+        #: optional serving/tracing.py SpanTracer (ISSUE 12) — same
+        #: unarmed discipline: every site is one is-None check
+        self._tracer = tracer
         self.params = params
         self.n_heads = int(n_heads)
         self.max_len = int(max_len)
@@ -562,6 +572,11 @@ class LMEngine(Logger):
                     self._kernel_fallback_reason)
         self.metrics.set_gauge("attn_kernel_active",
                                int(self._kernel_active))
+        #: the cost ledger's backend axis (ISSUE 12): which attention /
+        #: program path this engine's device spans actually ran
+        self._backend = ("pallas" if self._kernel_active
+                         else "xla-tp%d" % self.tp if self.tp >= 2
+                         else "xla")
         self._caches = None
         self._kv_pools = None
         self._pool = None
@@ -637,6 +652,32 @@ class LMEngine(Logger):
         attached — one attribute-is-None check on the hot path."""
         if self._faults is not None:
             self._faults.fire(site)
+
+    # ------------------------------------------------------------- tracing
+    def _tfence(self, state, traced=True):
+        """Dispatch fencing (ISSUE 12): jit returns before the device
+        finishes, so a traced span must block on the outputs to time
+        device wall, not enqueue.  ONLY called when tracing is armed
+        AND the dispatch serves at least one SAMPLED request
+        (``traced``) — ``sample:P`` traffic pays the sync only on its
+        sampled fraction, and the unarmed path never syncs."""
+        if self._tracer is not None and traced:
+            import jax
+            jax.block_until_ready(state)
+
+    def _trace_admitted(self, req):
+        """Close the request's queue-wait span at slot assignment."""
+        if req.tspan is not None:
+            req.trace.tracer.end(req.tspan, attrs={
+                "wait_s": round(time.monotonic() - req.t_enq, 6)})
+            req.tspan = None
+
+    def _trace_queue_end(self, req, error):
+        """Close the queue-wait span on a non-admission exit (shed,
+        cancel) so the finished tree carries no unclosed spans."""
+        if req.tspan is not None:
+            req.trace.tracer.end(req.tspan, error=error)
+            req.tspan = None
 
     def _place_params(self, params):
         """Place one param tree per the engine's layout: megatron
@@ -1104,6 +1145,7 @@ class LMEngine(Logger):
             self._pending_swap = None
         if active:
             self._requeue_active(active)
+        t0a = time.monotonic()
         try:
             self._fault("engine.swap")
             self.params = swap["params"]
@@ -1113,11 +1155,23 @@ class LMEngine(Logger):
             self.metrics.inc("weight_swap_failures")
             self.warning("weight swap refused at apply: %s (old "
                          "weights keep serving)", e)
+            if self._tracer is not None:
+                self._tracer.event(
+                    "swap.refused", cat="swap", t0=t0a,
+                    attrs={"engine": self.name, "error": str(e)})
         else:
             self._set_version(swap["version"])
             self.metrics.inc("weight_swaps")
             self.metrics.set_gauge("swap_quiesce_s",
                                    time.monotonic() - swap["t0"])
+            if self._tracer is not None:
+                self._tracer.event(
+                    "swap.apply", cat="swap", t0=t0a,
+                    attrs={"engine": self.name,
+                           "version": swap["version"],
+                           "drain": swap["drain"],
+                           "quiesce_s": round(
+                               time.monotonic() - swap["t0"], 4)})
         swap["done"].set()
 
     def _requeue_active(self, active):
@@ -1140,7 +1194,16 @@ class LMEngine(Logger):
             # a client-visible error
             lane.request.deadline = max(lane.request.deadline,
                                         fresh_deadline)
-            reqs.append(lane.request)
+            req = lane.request
+            if req.trace is not None:
+                req.trace.tracer.instant(
+                    req.trace, "swap.requeue", cat="engine")
+                # back in the queue: a fresh queue-wait span, ended by
+                # the re-admission like any other
+                req.tspan = req.trace.tracer.begin(
+                    req.trace, "queue.wait", cat="queue",
+                    attrs={"engine": self.name, "requeued": True})
+            reqs.append(req)
         with self._cond:
             for req in reversed(reqs):
                 self._queue.appendleft(req)
@@ -1179,6 +1242,26 @@ class LMEngine(Logger):
                     % (len(prompt), n_new, demand,
                        self._pool.num_pages))
         self._fault("engine.submit")
+        # tracing (ISSUE 12): join the caller's request context (HTTP /
+        # router) or root one here (direct engine use, benches) —
+        # whoever STARTED the trace finishes it, so own_root marks
+        # ours; a sampled-out decision anywhere above sticks
+        tctx, own_root = None, False
+        if self._tracer is not None:
+            tctx, own_root = tracing.join_or_root(
+                self._tracer, "engine.request", "engine",
+                attrs={"engine": self.name})
+            if tctx is tracing.SAMPLED_OUT:
+                tctx = None
+        try:
+            return self._submit_admit(prompt, n_new, demand, tctx,
+                                      own_root)
+        except Exception as e:
+            if own_root:
+                tctx.tracer.finish_request(tctx, error=e)
+            raise
+
+    def _submit_admit(self, prompt, n_new, demand, tctx, own_root):
         with self._cond:
             if self._stop or self._thread is None:
                 raise RuntimeError("LM engine is not running")
@@ -1208,6 +1291,15 @@ class LMEngine(Logger):
                 raise PoolExhausted(demand, 2 * self._pool.num_pages)
             req = _Request(prompt, int(n_new), self.deadline_s,
                            pages=demand)
+            if tctx is not None:
+                req.trace = tctx
+                req.tspan = tctx.tracer.begin(
+                    tctx, "queue.wait", cat="queue",
+                    attrs={"engine": self.name})
+                if own_root:
+                    req.future.add_done_callback(
+                        lambda f, ctx=tctx:
+                        tracing.finish_from_future(ctx, f))
             # admission journal (ISSUE 10): the entry lives until the
             # request's future settles (result, exception or cancel) —
             # checkpoint() snapshots exactly the unresolved set.  The
@@ -1274,6 +1366,7 @@ class LMEngine(Logger):
                 self._queued_pages -= req.pages
             except ValueError:
                 return           # admitted (or done) — worker handles it
+        self._trace_queue_end(req, "cancelled")
         req.future.cancel()
 
     # --------------------------------------------------- crash-safe recovery
@@ -1477,10 +1570,12 @@ class LMEngine(Logger):
             if req is None:
                 return
             if req.cancelled:            # raced _cancel's dequeue
+                self._trace_queue_end(req, "cancelled")
                 req.future.cancel()
                 continue
             if time.monotonic() > req.deadline:
                 self.metrics.record_shed()
+                self._trace_queue_end(req, "shed")
                 req.future.set_exception(DeadlineExceeded(
                     "prompt shed after %.3fs in queue" % (
                         time.monotonic() - req.t_enq)))
@@ -1513,6 +1608,8 @@ class LMEngine(Logger):
             if bucket > req.true_len:
                 prompt = numpy.pad(prompt,
                                    (0, bucket - req.true_len))
+            self._trace_admitted(req)
+            t0p = time.monotonic()
             try:
                 self._fault("engine.prefill")
                 tok, rows = self._prefill_jit(
@@ -1520,11 +1617,17 @@ class LMEngine(Logger):
                     jnp.asarray(req.true_len, jnp.int32))
                 self._caches = self._install_jit(
                     self._caches, rows, jnp.asarray(slot, jnp.int32))
+                self._tfence(self._caches, req.trace is not None)
             except Exception as e:   # noqa: BLE001 — fails THIS request
                 # a prefill fault (bad bucket compile, device error)
                 # must fail its own request, not wedge the engine
                 self.metrics.record_error()
                 self.warning("prefill failed: %s", e)
+                if req.trace is not None:
+                    req.trace.tracer.add(
+                        req.trace, "prefill", "prefill", t0p,
+                        time.monotonic(),
+                        attrs={"bucket": bucket, "error": str(e)})
                 self._free.append(slot)
                 if not req.future.cancelled():
                     req.future.set_exception(e)
@@ -1532,6 +1635,12 @@ class LMEngine(Logger):
             self.metrics.record_queue_wait(
                 time.monotonic() - req.t_enq)
             self.metrics.inc("prefill_tokens", req.true_len)
+            if req.trace is not None:
+                req.trace.tracer.add(
+                    req.trace, "prefill", "prefill", t0p,
+                    time.monotonic(),
+                    attrs={"bucket": bucket,
+                           "backend": self._backend})
             lane = _Slot(req)
             self._lanes[slot] = lane
             self._emit_first(slot, lane, int(tok))
@@ -1544,6 +1653,7 @@ class LMEngine(Logger):
         import jax.numpy as jnp
         C = self.prefill_chunk
         n_full = (req.true_len - 1) // C
+        self._trace_admitted(req)
         lane = _Slot(req)
         matched = 0
         if self._trie is not None:
@@ -1571,6 +1681,10 @@ class LMEngine(Logger):
             self.metrics.inc("kv_row_copies", matched * C)
             self.metrics.set_gauge("prefix_cache_chunks",
                                    self._trie.size)
+            if matched and req.trace is not None:
+                req.trace.tracer.instant(
+                    req.trace, "prefix.hit", cat="prefill",
+                    attrs={"chunks": matched, "tokens": matched * C})
         for i in range(matched, n_full):
             lane.pending.append((req.prompt[i * C:(i + 1) * C], i * C,
                                  False))
@@ -1634,6 +1748,12 @@ class LMEngine(Logger):
             tail = numpy.pad(tail, (0, C - len(tail)))
         lane.pending.append((tail, n_full * C, True))
         self.metrics.record_queue_wait(time.monotonic() - req.t_enq)
+        self._trace_admitted(req)
+        if nodes and req.trace is not None:
+            req.trace.tracer.instant(
+                req.trace, "prefix.hit", cat="prefill",
+                attrs={"chunks": len(nodes),
+                       "tokens": len(nodes) * C, "paged": True})
         self._lanes[slot] = lane
         self._pos[slot] = lane.pending[0][1]
         self._update_pool_gauges()
@@ -1678,16 +1798,25 @@ class LMEngine(Logger):
             if fresh is None:
                 raise Overloaded()
             q = fresh[0]
+            t0c = time.monotonic()
             try:
                 self._fault("engine.cow")
                 self._kv_pools = self._page_copy_jit(
                     self._kv_pools, jnp.asarray(p, jnp.int32),
                     jnp.asarray(q, jnp.int32))
+                self._tfence(self._kv_pools,
+                             lane.request.trace is not None)
             except Exception:
                 # nobody owns q yet (not in lane.pages) — hand it back
                 # or a faulting device shrinks the pool for good
                 self._pool.release(q)
                 raise
+            if lane.request.trace is not None:
+                lane.request.trace.tracer.add(
+                    lane.request.trace, "cow.copy", "kv", t0c,
+                    time.monotonic(),
+                    attrs={"page": p, "bucket": self.prefill_chunk,
+                           "backend": self._backend})
             self._pool.pin(q)
             self._pool.unpin(p)
             self._pool.release(p)
@@ -1797,6 +1926,10 @@ class LMEngine(Logger):
                 self.metrics.inc("prefix_hit_chunks")
                 self.metrics.inc("prefix_hit_tokens", len(tokens))
                 self.metrics.inc("kv_row_copies", len(tokens))
+                if req.trace is not None:
+                    req.trace.tracer.instant(
+                        req.trace, "prefix.hit", cat="prefill",
+                        attrs={"late": True, "start": start})
                 self._pos[slot] = lane.pending[0][1]
                 return
         last_idx = (req.true_len - 1 - start) if is_tail else 0
@@ -1821,9 +1954,18 @@ class LMEngine(Logger):
                 lane.cursor = node
                 self.metrics.set_gauge("prefix_cache_chunks",
                                        self._trie.size)
+            self._tfence(self._caches, req.trace is not None)
         except Exception as e:   # noqa: BLE001 — fails THIS request
             self.metrics.record_error()
             self.warning("chunk prefill failed: %s", e)
+            if req.trace is not None:
+                # the FAILED dispatch is part of the timeline — the
+                # flight recorder must show where the request died (no
+                # backend attr: failed spans stay out of the ledger)
+                req.trace.tracer.add(
+                    req.trace, "prefill.chunk", "prefill", t0,
+                    time.monotonic(),
+                    attrs={"start": start, "error": str(e)})
             self._teardown_slot(slot, lane, e)
             return
         self.metrics.inc("prefill_dispatches")
@@ -1832,6 +1974,13 @@ class LMEngine(Logger):
                          (req.true_len - start) if is_tail
                          else len(tokens))
         self.metrics.record_decode_step(time.monotonic() - t0)
+        if req.trace is not None:
+            req.trace.tracer.add(
+                req.trace, "prefill.chunk", "prefill", t0,
+                time.monotonic(),
+                attrs={"start": start, "tail": is_tail,
+                       "bucket": self.prefill_chunk,
+                       "backend": self._backend})
         if is_tail:
             self._emit_first(slot, lane, int(tok))
         else:
@@ -1866,6 +2015,11 @@ class LMEngine(Logger):
                 self.metrics.inc("prefix_hit_chunks")
                 self.metrics.inc("prefix_hit_tokens", len(tokens))
                 self.metrics.inc("kv_pages_referenced")
+                if req.trace is not None:
+                    req.trace.tracer.instant(
+                        req.trace, "prefix.hit", cat="prefill",
+                        attrs={"late": True, "start": start,
+                               "paged": True})
                 self._update_pool_gauges()
                 self._pos[slot] = lane.pending[0][1]
                 return
@@ -1895,9 +2049,16 @@ class LMEngine(Logger):
                 self.metrics.set_gauge("prefix_cache_chunks",
                                        self._trie.size)
                 self._update_pool_gauges()
+            self._tfence(self._kv_pools, req.trace is not None)
         except Exception as e:   # noqa: BLE001 — fails THIS request
             self.metrics.record_error()
             self.warning("paged chunk prefill failed: %s", e)
+            if req.trace is not None:
+                req.trace.tracer.add(
+                    req.trace, "prefill.chunk", "prefill", t0,
+                    time.monotonic(),
+                    attrs={"start": start, "paged": True,
+                           "error": str(e)})
             self._teardown_slot(slot, lane, e)
             return
         self.metrics.inc("prefill_dispatches")
@@ -1906,6 +2067,13 @@ class LMEngine(Logger):
                          (req.true_len - start) if is_tail
                          else len(tokens))
         self.metrics.record_decode_step(time.monotonic() - t0)
+        if req.trace is not None:
+            req.trace.tracer.add(
+                req.trace, "prefill.chunk", "prefill", t0,
+                time.monotonic(),
+                attrs={"start": start, "tail": is_tail,
+                       "bucket": self.prefill_chunk, "paged": True,
+                       "backend": self._backend})
         if is_tail:
             self._emit_first(slot, lane, int(tok))
         else:
@@ -1994,6 +2162,12 @@ class LMEngine(Logger):
             active = self._cow_guard_active(active, 1)
             if not active:
                 return
+        w = None
+        tctxs = ()
+        if self._tracer is not None:
+            # only the SAMPLED lanes carry a context — an all-None
+            # batch records nothing and (sample:P) skips the fence
+            tctxs = [self._lanes[s].request.trace for s in active]
         t0 = time.monotonic()
         try:
             self._fault("engine.step")
@@ -2008,13 +2182,27 @@ class LMEngine(Logger):
                     self.params, self._caches,
                     jnp.asarray(self._last), jnp.asarray(self._pos))
             toks = numpy.asarray(toks)
+            self._tfence(self._kv_pools if self._paged
+                         else self._caches,
+                         any(c is not None for c in tctxs))
         except Exception as e:   # noqa: BLE001 — fails the lanes
+            if self._tracer is not None:
+                self._tracer.add_many(
+                    tctxs, "decode.step", "decode", t0,
+                    time.monotonic(),
+                    attrs={"batch": len(active), "error": str(e)})
             self._fail_active(active, e)
             return
         self.metrics.record_dispatch(len(active))
         self.metrics.record_decode_step(time.monotonic() - t0)
         self.metrics.inc("decode_dispatches")
         self._note_attn_dispatch()
+        if self._tracer is not None:
+            self._tracer.add_many(
+                tctxs, "decode.step", "decode", t0, time.monotonic(),
+                attrs={"batch": len(active),
+                       "bucket": w if w is not None else self.slots,
+                       "backend": self._backend})
         for slot in active:
             lane = self._lanes[slot]
             lane.emitted.append(int(toks[slot]))
@@ -2061,6 +2249,10 @@ class LMEngine(Logger):
                 drafts[slot] = padded
                 real_lens[slot] = len(draft)
                 self.metrics.inc("draft_tokens", len(draft))
+        w = None
+        tctxs = ()
+        if self._tracer is not None:
+            tctxs = [self._lanes[s].request.trace for s in active]
         t0 = time.monotonic()
         try:
             self._fault("engine.verify")
@@ -2075,13 +2267,27 @@ class LMEngine(Logger):
                     self.params, self._caches, jnp.asarray(toks_in),
                     jnp.asarray(self._pos))
             out = numpy.asarray(out)
+            self._tfence(self._kv_pools if self._paged
+                         else self._caches,
+                         any(c is not None for c in tctxs))
         except Exception as e:   # noqa: BLE001 — fails the lanes
+            if self._tracer is not None:
+                self._tracer.add_many(
+                    tctxs, "decode.verify", "decode", t0,
+                    time.monotonic(),
+                    attrs={"batch": len(active), "error": str(e)})
             self._fail_active(active, e)
             return
         self.metrics.record_dispatch(len(active))
         self.metrics.record_decode_step(time.monotonic() - t0)
         self.metrics.inc("decode_dispatches")
         self._note_attn_dispatch()
+        if self._tracer is not None:
+            self._tracer.add_many(
+                tctxs, "decode.verify", "decode", t0, time.monotonic(),
+                attrs={"batch": len(active), "k": k,
+                       "bucket": w if w is not None else self.slots,
+                       "backend": self._backend})
         for slot in active:
             lane = self._lanes[slot]
             draft = drafts[slot]
@@ -2175,6 +2381,7 @@ class LMEngine(Logger):
                                        "swap applied")
             swap["done"].set()
         for req in pending:
+            self._trace_queue_end(req, "engine stopped")
             req.future.set_exception(RuntimeError("LM engine stopped"))
         for slot, lane in enumerate(self._lanes):
             if lane is not None:

@@ -105,6 +105,7 @@ from concurrent.futures import Future
 import numpy
 
 from veles_tpu.logger import Logger
+from veles_tpu.serving import tracing
 from veles_tpu.serving.batcher import Overloaded
 from veles_tpu.serving.metrics import ServingMetrics
 
@@ -165,12 +166,14 @@ class _Attempt:
     one; hedging adds a second, and the first to settle wins."""
 
     __slots__ = ("job", "replica", "engine_future", "requeue",
-                 "is_hedge", "abandoned")
+                 "is_hedge", "abandoned", "span")
 
     def __init__(self, job, is_hedge=False):
         self.job = job
         self.replica = None
         self.engine_future = None
+        #: tracing (ISSUE 12): this attempt's open span handle
+        self.span = None
         #: set by unregister() right before it withdraws the engine-side
         #: request: tells the completion callback that a cancellation or
         #: short result is drain fallout to REPLACE, not a client event
@@ -188,12 +191,17 @@ class _Job:
 
     __slots__ = ("prompt", "n_new", "future", "t0", "replica", "live",
                  "requeues", "retries", "hedged", "last_exc", "version",
-                 "delivered")
+                 "delivered", "trace", "own_trace")
 
     def __init__(self, prompt, n_new):
         self.prompt = prompt
         self.n_new = int(n_new)
         self.future = Future()
+        #: tracing (ISSUE 12): the request's TraceContext (or None),
+        #: and whether the ROUTER rooted it (finished in _forget, once
+        #: every attempt — hedge losers included — has settled)
+        self.trace = None
+        self.own_trace = False
         self.future.job = self          # router-level cancellation handle
         self.t0 = time.monotonic()
         #: replica of the newest placement (the WINNING attempt's after
@@ -228,7 +236,8 @@ class Router(Logger):
     def __init__(self, replicas, metrics=None, name="lm_router",
                  policy="metrics", retries=0, retry_backoff_s=0.05,
                  retry_backoff_cap_s=2.0, hedge_after_s=0.0,
-                 drain_timeout_s=5.0, seed=0, faults=None):
+                 drain_timeout_s=5.0, seed=0, faults=None,
+                 tracer=None):
         replicas = list(replicas)
         if not replicas:
             raise ValueError("router needs at least one replica")
@@ -247,6 +256,9 @@ class Router(Logger):
         if isinstance(self.metrics, RouterMetrics):
             self.metrics._router = self
         self._faults = faults
+        #: optional serving/tracing.py SpanTracer (ISSUE 12) — one
+        #: attribute-is-None check per site when unarmed
+        self._tracer = tracer
         self._live = [True] * len(replicas)
         self._routed = [0] * len(replicas)
         self._pending = [set() for _ in replicas]
@@ -315,6 +327,7 @@ class Router(Logger):
                 self._settle_exc(job,
                                  job.last_exc
                                  or RuntimeError("router stopped"))
+                self._forget(job)
         for e in self.replicas:
             e.stop()
 
@@ -396,13 +409,28 @@ class Router(Logger):
         PoolExhausted when every live replica refuses admission (with
         ``retry_after`` = the MINIMUM over the refusing replicas)."""
         job = _Job(prompt, int(n_new))
+        # tracing (ISSUE 12): join the caller's context (HTTP) or root
+        # one here (direct router use) — the attempt spans _place opens
+        # nest under it, so retries/hedges/drains read as one timeline.
+        # A router-rooted trace finishes in _forget, NOT at future
+        # resolution: a hedge loser's attempt may still be settling
+        # when the winner unblocks the client, and its span must close
+        # before the tree is sealed.  A sampled-out decision (ours or
+        # upstream's) leaves job.trace None; _place propagates it so
+        # the engines never re-roll the coin.
+        if self._tracer is not None:
+            ctx, job.own_trace = tracing.join_or_root(
+                self._tracer, "request", "router")
+            job.trace = None if ctx is tracing.SAMPLED_OUT else ctx
         with self._lock:
             self._jobs.add(job)
         try:
             self._place(job)
-        except Exception:
+        except Exception as e:
             with self._lock:
                 self._jobs.discard(job)
+            if job.own_trace:
+                job.trace.tracer.finish_request(job.trace, error=e)
             raise
         return job.future
 
@@ -425,9 +453,27 @@ class Router(Logger):
                 if not self._live[i]:
                     continue
             att = _Attempt(job, is_hedge=hedge)
+            trc = job.trace
+            if trc is not None:
+                att.span = trc.tracer.begin(
+                    trc, "attempt", cat="router",
+                    attrs={"replica": i, "hedge": hedge,
+                           "retry": job.retries,
+                           "requeue": job.requeues})
             try:
                 self._fault("router.place")
-                f = engine.submit(job.prompt, job.n_new)
+                if att.span is not None:
+                    # the engine's spans nest under THIS attempt
+                    with tracing.use(trc.at(att.span[1])):
+                        f = engine.submit(job.prompt, job.n_new)
+                elif self._tracer is not None:
+                    # sampled out (or a late zombie re-place of a
+                    # sealed trace): tell the engine the decision is
+                    # made — it must not root a stray partial trace
+                    with tracing.use(tracing.SAMPLED_OUT):
+                        f = engine.submit(job.prompt, job.n_new)
+                else:
+                    f = engine.submit(job.prompt, job.n_new)
             except Overloaded as exc:
                 # queue/pool pressure on this replica: the next-best
                 # may still have room (ValueError — a client error —
@@ -437,11 +483,19 @@ class Router(Logger):
                 # as the soonest-freeing replica frees, not the
                 # last-tried one (ISSUE 10 satellite).
                 last_exc = exc
+                if att.span is not None:
+                    trc.tracer.end(att.span, error=exc)
                 ra = getattr(exc, "retry_after", None)
                 if ra is not None:
                     min_retry = ra if min_retry is None \
                         else min(min_retry, ra)
                 continue
+            except Exception as exc:
+                # a client error (ValueError) propagates to the caller
+                # — close the attempt span on the way out
+                if att.span is not None:
+                    trc.tracer.end(att.span, error=exc)
+                raise
             att.replica = i
             att.engine_future = f
             with self._lock:
@@ -461,6 +515,8 @@ class Router(Logger):
                     job.replica = i
             if stale:
                 engine._cancel(f.request)
+                if att.span is not None:
+                    trc.tracer.end(att.span, attrs={"stale": True})
                 if done:
                     return True      # settled — nothing left to place
                 continue
@@ -491,6 +547,17 @@ class Router(Logger):
         and a requeue fires only for drain fallout (_Attempt.requeue)."""
         job = att.job
         i = att.replica
+        if att.span is not None:
+            if engine_future.cancelled():
+                outcome = "cancelled"
+            elif engine_future.exception() is not None:
+                outcome = "error"
+            else:
+                outcome = "ok"
+            job.trace.tracer.end(
+                att.span, attrs={"outcome": outcome},
+                error=(engine_future.exception()
+                       if outcome == "error" else None))
         with self._lock:
             # membership in job.live is the CLAIM: a drain timeout that
             # force-replaced this attempt already removed it (and owns
@@ -575,6 +642,22 @@ class Router(Logger):
             # by set_result reads the WINNING attempt's stamps
             job.replica = att.replica
             job.version = getattr(att.engine_future, "version", None)
+            if job.trace is not None:
+                # close the losing siblings' open spans BEFORE the
+                # client unblocks: an HTTP-owned root seals the trace
+                # the moment the handler returns, and a still-open
+                # hedge-loser attempt would be flagged unclosed —
+                # breaking the asserted span-tree integrity.  (The
+                # losers' engine-side work is cancelled below, after
+                # set_result, exactly as before.)
+                for loser in job.live:
+                    job.trace.tracer.end(
+                        loser.span, attrs={"outcome": "hedge-lost"})
+                    lreq = getattr(loser.engine_future, "request",
+                                   None)
+                    if lreq is not None and lreq.tspan is not None:
+                        job.trace.tracer.end(lreq.tspan,
+                                             error="hedge-lost")
         try:
             job.future.set_result(result)
         except Exception:   # noqa: BLE001 — cancelled/settled meanwhile
@@ -592,8 +675,13 @@ class Router(Logger):
 
     def _forget(self, job):
         with self._lock:
-            if not job.live:
+            settled = not job.live
+            if settled:
                 self._jobs.discard(job)
+        if settled and job.own_trace and job.future.done():
+            # every attempt settled AND the client future resolved:
+            # the span tree is complete — seal it (idempotent)
+            tracing.finish_from_future(job.trace, job.future)
 
     def _replace(self, job):
         """Re-place a drain-interrupted job on the surviving replicas —
@@ -606,6 +694,10 @@ class Router(Logger):
             return
         job.requeues += 1
         self.metrics.inc("requeued_requests")
+        if job.trace is not None:
+            job.trace.tracer.instant(
+                job.trace, "drain.requeue", cat="router",
+                attrs={"requeue": job.requeues})
         if job.requeues > len(self.replicas) + 1:
             self._settle_exc(job, RuntimeError(
                 "request could not be re-placed after %d drain retries"
@@ -625,6 +717,11 @@ class Router(Logger):
         self.metrics.inc("requests_retried")
         delay = min(self.retry_backoff_cap_s,
                     self.retry_backoff_s * (2 ** (job.retries - 1)))
+        if job.trace is not None:
+            job.trace.tracer.instant(
+                job.trace, "retry.backoff", cat="router",
+                attrs={"retry": job.retries,
+                       "base_delay_s": round(delay, 4)})
         with self._lock:
             # seeded jitter (deterministic for a fixed retry order):
             # desynchronizes a burst of same-fault retries so they do
@@ -767,6 +864,11 @@ class Router(Logger):
             live_now = sum(1 for ok in self._live if ok)
         self.metrics.set_gauge("replicas_live", live_now)
         self.metrics.inc("replica_drains")
+        if self._tracer is not None:
+            self._tracer.event(
+                "router.drain", cat="router",
+                attrs={"replica": i, "reason": str(reason),
+                       "withdrawn": len(attempts)})
         self.warning("draining replica %d (%s): re-placing %d pending "
                      "request(s) on %d live replica(s)",
                      i, reason, len(attempts), live_now)
@@ -806,6 +908,8 @@ class Router(Logger):
             self._forget(job)
             return
         self.metrics.inc("drain_forced_replacements")
+        if att.span is not None:
+            job.trace.tracer.end(att.span, error="drain-abandoned")
         self.warning("replica %d never resolved a drained request in "
                      "%.1fs: force re-placing it", att.replica,
                      self.drain_timeout_s)
@@ -932,6 +1036,12 @@ class Router(Logger):
                 if not ok:
                     return fail(why, bad=i if bad else None)
         record["completed"] = True
+        if self._tracer is not None:
+            self._tracer.event(
+                "router.deploy", cat="deploy",
+                attrs={"version": version,
+                       "swapped": len(record["swapped"]),
+                       "canary": len(canaries)})
         self.info("deploy v%d complete: %d replica(s) swapped "
                   "(canary %s)", version, len(record["swapped"]),
                   canaries)
