@@ -62,6 +62,11 @@ def jit_guard():
             }
             if engine._verify_jit is not None:
                 progs["verify"] = (engine._verify_jit, widths)
+            if engine._megastep_jit is not None:
+                # ISSUE 13: the fused program's asserted compile bound
+                # — ONE megastep program per (live-width ladder entry
+                # × K) family, K fixed per engine
+                progs["megastep"] = (engine._megastep_jit, widths)
             for name, (fn, bound) in progs.items():
                 size = fn._cache_size()
                 assert size <= bound, (
@@ -79,6 +84,8 @@ def jit_guard():
             progs["chunk_extract"] = (engine._chunk_extract_jit, 1)
         if engine._verify_jit is not None:
             progs["verify"] = (engine._verify_jit, 1)
+        if engine._megastep_jit is not None:
+            progs["megastep"] = (engine._megastep_jit, 1)
         for name, (fn, bound) in progs.items():
             size = fn._cache_size()
             assert size <= bound, (
@@ -853,6 +860,216 @@ class TestRadixCache:
         trie.insert(trie.root, (1,) * 4, "a")    # pinned by insert
         assert trie.insert(trie.root, (2,) * 4, "b") is None
         assert trie.size == 1
+
+
+#: ISSUE 13 parity matrix: K ∈ {1, 4, 8} × the fast-path features.
+#: Tier-1 keeps ONE representative per family (K=1 no-op, contiguous
+#: plain, the full paged+spec stack at K=8, tp=2, interpret kernels);
+#: redundant K × feature geometries ride the slow suite — the PR 3/8
+#: watchdog-headroom discipline.
+MEGASTEP_SETS = [
+    (1, {"paged_kv": True, "prefill_chunk": 8, "spec_k": 3}),
+    (4, {}),
+    (8, {"paged_kv": True, "prefill_chunk": 8, "prefix_cache": 32,
+         "spec_k": 3}),
+    (4, {"tp": 2, "paged_kv": True, "prefill_chunk": 8, "spec_k": 3}),
+    (4, {"paged_kv": True, "prefill_chunk": 8,
+         "attn_kernel": "force"}),
+    pytest.param(4, {"prefill_chunk": 8}, marks=pytest.mark.slow),
+    pytest.param(4, {"spec_k": 3}, marks=pytest.mark.slow),
+    pytest.param(8, {}, marks=pytest.mark.slow),
+    pytest.param(4, {"paged_kv": True, "prefill_chunk": 8},
+                 marks=pytest.mark.slow),
+    pytest.param(8, {"paged_kv": True, "prefill_chunk": 8},
+                 marks=pytest.mark.slow),
+    pytest.param(4, {"paged_kv": True, "prefill_chunk": 8,
+                     "prefix_cache": 32, "spec_k": 3},
+                 marks=pytest.mark.slow),
+    pytest.param(8, {"tp": 2, "paged_kv": True, "prefill_chunk": 8},
+                 marks=pytest.mark.slow),
+]
+
+
+class TestMegastep:
+    """ISSUE 13: the fused K-tokens-per-dispatch decode megastep —
+    greedy parity across the K × feature matrix, the
+    one-program-per-(ladder × K) compile bound, boundary semantics for
+    deadlines, fault isolation inside a fused dispatch, and the
+    truthful cost-ledger accounting."""
+
+    @pytest.mark.parametrize("K,features", MEGASTEP_SETS,
+                             ids=lambda v: str(v) if isinstance(v, int)
+                             else "+".join(sorted(v)) or "plain")
+    def test_bit_identical_across_matrix(self, K, features, jit_guard,
+                                         serving_mesh):
+        """4 prompts through 2 slots (forced reuse) at megastep K:
+        output equals the direct greedy generate bit for bit, and the
+        jit cache holds the (ladder × K) bound.  K=1 must not build a
+        fused program at all — the tick path IS the K=1 semantics."""
+        from veles_tpu.serving import LMEngine
+        if features.get("tp"):
+            serving_mesh(features["tp"])
+        params = _params()
+        prompts = [[1, 2, 3], [2, 4, 6, 8, 10], [7, 7],
+                   [5, 1, 5, 1, 5, 1, 5, 1, 5]]
+        n_new = 7
+        expected = [_greedy(params, p, n_new, 96) for p in prompts]
+        engine = LMEngine(params, n_heads=2, max_len=96, slots=2,
+                          megastep=K, name="ms_par",
+                          **features).start()
+        try:
+            if K <= 1:
+                assert engine._megastep_jit is None
+            else:
+                assert engine._megastep_jit is not None
+            futures = [engine.submit(p, n_new) for p in prompts]
+            for p, f, exp in zip(prompts, futures, expected):
+                got = numpy.concatenate([p, f.result(timeout=300)])
+                numpy.testing.assert_array_equal(got, exp)
+            if features.get("prefill_chunk"):
+                buckets = 1
+            else:
+                from veles_tpu.serving import prompt_bucket
+                buckets = len({prompt_bucket(n, 96)
+                               for n in [1] + [len(p) for p in prompts]})
+            jit_guard(engine, prefill_buckets=buckets)
+            if K >= 2:
+                c = engine.metrics.snapshot()["counters"]
+                assert c["megastep_dispatches"] >= 1
+                assert c["decode_dispatches"] == \
+                    c["megastep_dispatches"]
+        finally:
+            engine.stop()
+
+    def test_validation_and_noop(self):
+        from veles_tpu.serving import LMEngine
+        params = _params()
+        with pytest.raises(ValueError, match="megastep"):
+            LMEngine(params, n_heads=2, max_len=96, slots=1,
+                     megastep=-1, name="ms_bad")
+        off = LMEngine(params, n_heads=2, max_len=96, slots=1,
+                       name="ms_off")
+        assert off.megastep == 0 and off._megastep_jit is None
+        one = LMEngine(params, n_heads=2, max_len=96, slots=1,
+                       megastep=1, name="ms_one")
+        assert one._megastep_jit is None    # K=1 IS the tick path
+
+    def test_deadline_mid_megastep_sheds_at_next_boundary(self):
+        """BOUNDARY SEMANTICS (documented): a queued request whose
+        deadline expires while a megastep is in flight sheds at the
+        NEXT boundary — never mid-program, never wedged — while a
+        request already decoding keeps its tokens (the deadline only
+        ever governed queue wait, so a request that finished its
+        tokens is never 503d)."""
+        import time as time_mod
+        from veles_tpu.serving import LMEngine
+        from veles_tpu.serving.batcher import DeadlineExceeded
+        params = _params(max_len=96)
+        engine = LMEngine(params, n_heads=2, max_len=96, slots=1,
+                          megastep=4, deadline_s=0.35,
+                          name="ms_dead").start()
+        real = engine._megastep_jit
+
+        def slow(*a):
+            time_mod.sleep(0.25)
+            return real(*a)
+
+        engine._megastep_jit = slow
+        try:
+            fa = engine.submit([1, 2, 3], 8)   # admitted instantly
+            time_mod.sleep(0.05)
+            fb = engine.submit([4, 5, 6], 4)   # queued behind fa
+            # fa spends ~0.5s decoding (2 slow megasteps) — well past
+            # deadline_s, but it FINISHES: tokens delivered, no 503
+            assert len(fa.result(timeout=60)) == 8
+            with pytest.raises(DeadlineExceeded, match="boundary"):
+                fb.result(timeout=60)
+            assert engine.metrics.snapshot()["shed"] == 1
+        finally:
+            engine._megastep_jit = real
+            engine.stop()
+
+    def test_fault_inside_megastep_fails_exactly_active_lanes(self):
+        """CHAOS: an engine.step fault injected into the fused
+        dispatch fails the lanes that were IN that megastep — and only
+        them; the queued request decodes exactly greedy afterwards,
+        and every span tree (including the failed megastep span on the
+        failed request's timeline) verifies."""
+        from veles_tpu.serving import FaultPlan, LMEngine, SpanTracer
+        from veles_tpu.serving.faults import InjectedFault
+        from veles_tpu.serving.tracing import verify_integrity
+        params = _params(max_len=96)
+        plan = FaultPlan().arm("engine.step", calls={1})
+        tracer = SpanTracer(mode="all", last=16)
+        engine = LMEngine(params, n_heads=2, max_len=96, slots=1,
+                          megastep=4, faults=plan, tracer=tracer,
+                          name="ms_chaos").start()
+        try:
+            fa = engine.submit([1, 2, 3], 6)
+            fb = engine.submit([2, 4, 6, 8], 6)
+            with pytest.raises(InjectedFault):
+                fa.result(timeout=60)
+            got = numpy.concatenate(
+                [[2, 4, 6, 8], fb.result(timeout=120)])
+            numpy.testing.assert_array_equal(
+                got, _greedy(params, [2, 4, 6, 8], 6, 96))
+            recs = tracer.requests()
+            assert len(recs) == 2
+            errs = [r for r in recs if r["error"]]
+            assert len(errs) == 1
+            verify_integrity(recs)
+            assert any(s["name"] == "decode.megastep"
+                       and "error" in s["attrs"]
+                       for s in errs[0]["spans"])
+        finally:
+            engine.stop()
+
+    def test_counters_and_ledger_truthful(self):
+        """The megastep_* counter family and the ISSUE 12 cost ledger:
+        one decode.megastep ledger row family whose deduped dispatch
+        count equals the engine's megastep_dispatches — the folded
+        per-token work is never double-counted — with per-lane tokens
+        riding each request's span copy, and the waste accounting
+        closed (tokens + wasted == lane iterations on the plain
+        path)."""
+        from veles_tpu.serving import LMEngine, SpanTracer
+        from veles_tpu.serving.tracing import (cost_ledger,
+                                               verify_integrity)
+        params = _params(max_len=128)
+        tracer = SpanTracer(mode="all", last=64)
+        engine = LMEngine(params, n_heads=2, max_len=128, slots=2,
+                          megastep=4, paged_kv=True, prefill_chunk=8,
+                          tracer=tracer, name="ms_led").start()
+        try:
+            prompts = [[1, 2, 3], [2, 4, 6, 8]]
+            futures = [engine.submit(p, 9) for p in prompts]
+            for p, f in zip(prompts, futures):
+                got = numpy.concatenate([p, f.result(timeout=120)])
+                numpy.testing.assert_array_equal(
+                    got, _greedy(params, p, 9, 128))
+            c = engine.metrics.snapshot()["counters"]
+            assert c["megastep_dispatches"] >= 1
+            assert c["megastep_tokens"] == 2 * 8   # n_new minus TTFT
+            assert c["megastep_tokens"] \
+                + c["megastep_wasted_iterations"] \
+                == c["megastep_lane_iterations"]
+            assert c["decode_dispatches"] == c["megastep_dispatches"]
+            recs = tracer.requests()
+            verify_integrity(recs)
+            rows = [r for r in cost_ledger(recs)
+                    if r["op"] == "decode.megastep"]
+            assert rows, "no decode.megastep ledger rows"
+            assert sum(r["dispatches"] for r in rows) \
+                == c["megastep_dispatches"]
+            assert sum(r["lanes"] for r in rows) \
+                >= sum(r["dispatches"] for r in rows)
+            span = next(s for r in recs for s in r["spans"]
+                        if s["name"] == "decode.megastep")
+            assert span["attrs"]["K"] == 4
+            assert "lane_tokens" in span["attrs"]
+            assert "xK4" in str(span["attrs"]["bucket"])
+        finally:
+            engine.stop()
 
 
 class TestAdmissionTokenBudget:

@@ -103,6 +103,19 @@ def _builtin_records():
     import trace_report
     out.append(("lm_bench.summary_record({})",
                 lm_bench.summary_record({})[0]))
+    # the megastep record path (ISSUE 13): a headline carrying the
+    # fused-decode column must select the lm_megastep_* metric and
+    # still conform to the shared schema
+    ms_record = lm_bench.summary_record({
+        "headline": {
+            "dispatches_per_token_megastep_single_lane": 0.062}})[0]
+    out.append(("lm_bench.summary_record(megastep headline)",
+                ms_record))
+    if ms_record.get("metric") != "lm_megastep_dispatches_per_token":
+        out.append(("lm_bench.summary_record(megastep headline)",
+                    {"metric": "",
+                     "note": "megastep headline did not select the "
+                             "lm_megastep_dispatches_per_token metric"}))
     out.append(("chaos_bench.summary_record({})",
                 chaos_bench.summary_record({})[0]))
     out.append(("trace_report.summary_record({})",

@@ -32,6 +32,15 @@ single-device host these legs bank ``skipped`` records; ``--devices
 N`` forces an N-device CPU dryrun host (the MULTICHIP suite's
 forced-host-device-count gear).
 
+The MEGASTEP legs (ISSUE 13) run the fused K-tokens-per-dispatch
+decode program on every workload — ``megastep`` (plain greedy, K=16)
+and ``megastep_all`` (K=8 stacked with the prefix cache, chunked
+prefill and in-graph speculation) — streaming the dispatches/token
+column per leg and ASSERTING < 0.1 on the single-lane greedy legs
+(vs the 0.547 best single-lane record the megastep replaces), plus
+``megastep_waste_frac`` (lane-iterations run frozen past a lane's
+early exit) so the K tradeoff is measured, not guessed.
+
 Every leg ALSO asserts its outputs bit-identical to the direct greedy
 ``ops/transformer.py::generate`` — a fast path that changed tokens
 would be a bug, not a speedup, so the bench refuses to report it.
@@ -182,7 +191,7 @@ def _emulate_device_latency(engines, seconds):
 
     for engine in engines:
         for name in ("_step_jit", "_verify_jit", "_chunk_jit",
-                     "_prefill_jit"):
+                     "_prefill_jit", "_megastep_jit"):
             fn = getattr(engine, name, None)
             if fn is not None:
                 setattr(engine, name, wrap(fn))
@@ -358,6 +367,30 @@ def run_leg(params, n_heads, max_len, prompts, n_new, expect,
                     "prefix hits must be page references"
                     % (cc.get("kv_row_copies", 0)
                        + c.get("kv_row_copies", 0), features))
+        megastep_cols = {}
+        if features.get("megastep"):
+            lane_iters = c.get("megastep_lane_iterations", 0)
+            megastep_cols = {
+                "megastep_dispatches": c.get("megastep_dispatches", 0),
+                "megastep_tokens": c.get("megastep_tokens", 0),
+                # tokens wasted to early-exit masking: the fraction of
+                # lane-iterations the fused program ran frozen — the
+                # measured cost side of the K tradeoff
+                "megastep_waste_frac": (
+                    round(c.get("megastep_wasted_iterations", 0)
+                          / lane_iters, 4) if lane_iters else None),
+            }
+            if slots == 1 and n_new >= 32 \
+                    and int(features["megastep"]) >= 8:
+                # THE acceptance criterion (ISSUE 13): single-lane
+                # greedy at K >= 8 must measure < 0.1 dispatches per
+                # token — asserted, not reported on faith
+                dpt = (dispatches / tokens) if tokens else None
+                if dpt is None or dpt >= 0.1:
+                    raise AssertionError(
+                        "megastep leg measured %s dispatches/token "
+                        "(acceptance bound < 0.1) under %r"
+                        % (dpt, features))
         tps = tokens / wall if wall else 0.0
         peak, peak_src = peak_flops_estimate()
         mfu = (tps * flops_per_token / peak
@@ -408,6 +441,7 @@ def run_leg(params, n_heads, max_len, prompts, n_new, expect,
             "slots_busy_peak": warm["slots_busy_peak"],
             "parity_vs_generate": True,     # asserted above, both passes
         }
+        record.update(megastep_cols)
         if replicas > 1:
             # router evidence: server-side placement counts (includes
             # requeues), the queue-depth high-water spread across the
@@ -602,6 +636,18 @@ def run_bench(smoke=False, slots=4, chunk=16, cache=256, spec_k=4,
                       "prefill_chunk": chunk},
         "tp2_replicas2": {"tp": 2, "replicas": 2, "paged_kv": True,
                           "prefill_chunk": chunk},
+        # ISSUE 13: the fused decode megastep — K decode iterations
+        # per device dispatch (lax.scan; spec_k folds its propose/
+        # verify in-graph on the megastep_all leg).  The single-lane
+        # greedy acceptance criterion rides run_leg: < 0.1
+        # dispatches/token (vs 0.547 best single-lane before), plus
+        # the megastep_waste_frac column so the K tradeoff (early-exit
+        # masking wastes tail iterations) is measured, not guessed.
+        "megastep": {"megastep": 16, "paged_kv": True,
+                     "prefill_chunk": chunk},
+        "megastep_all": {"megastep": 8, "paged_kv": True,
+                         "prefix_cache": cache, "prefill_chunk": chunk,
+                         "spec_k": spec_k},
         # ISSUE 12: the TRACED legs — the full fast-path stack with the
         # span tracer armed.  Parity still asserted (tracing must not
         # perturb output), span-tree integrity asserted per request,
@@ -687,6 +733,15 @@ def run_bench(smoke=False, slots=4, chunk=16, cache=256, spec_k=4,
             lane1["baseline"]["dispatches_per_token"],
         "dispatches_per_token_speculative_single_lane":
             lane1["spec"]["dispatches_per_token"],
+        # ISSUE 13: the fused-megastep acceptance pair (run_leg already
+        # ASSERTED < 0.1 on these legs) and the measured waste of
+        # early-exit masking
+        "dispatches_per_token_megastep_single_lane":
+            lane1["megastep"]["dispatches_per_token"],
+        "dispatches_per_token_megastep_all_single_lane":
+            lane1["megastep_all"]["dispatches_per_token"],
+        "megastep_waste_frac_single_lane":
+            lane1["megastep"]["megastep_waste_frac"],
         "prefill_tokens_baseline": sp_base["prefill_tokens"],
         "prefill_tokens_prefix_cache": sp_cache["prefill_tokens"],
         "prefix_hit_tokens": sp_cache["prefix_hit_tokens"],
@@ -776,6 +831,20 @@ def summary_record(results):
     utilization, so a killed run still banks the kernel-vs-XLA
     reading."""
     mfu = _latest_mfu(results)
+    headline = results.get("headline") or {}
+    if headline.get("dispatches_per_token_megastep_single_lane") \
+            is not None:
+        # ISSUE 13 headline: the fused-decode dispatches/token against
+        # the 0.547 single-lane record the megastep replaces
+        return {
+            "metric": "lm_megastep_dispatches_per_token",
+            "mfu": mfu,
+            "value":
+                headline["dispatches_per_token_megastep_single_lane"],
+            "unit": "dispatches/token",
+            "vs_baseline": 0.547,
+            "configs": results,
+        }, 0
     fixed = results.get("fixed_kv_memory") or {}
     if fixed.get("slots_ratio_vs_contiguous") is not None:
         return {

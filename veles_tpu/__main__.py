@@ -212,6 +212,18 @@ def build_argparser():
                              "(slots * max_len / chunk pages, + the "
                              "reserved scratch page); 0 = "
                              "contiguous KV")
+    parser.add_argument("--serve-megastep", type=int, default=0,
+                        metavar="K",
+                        help="with --serve-slots: fused multi-step "
+                             "decode — advance every live lane K "
+                             "tokens per device dispatch via one "
+                             "jitted lax.scan program (with "
+                             "--serve-spec-k the draft proposal and "
+                             "verification fold in-graph too), moving "
+                             "admission/deadline/completion/swap "
+                             "handling to megastep boundaries; output "
+                             "stays bit-identical to greedy.  0/1 = "
+                             "one dispatch per token (default)")
     parser.add_argument("--serve-attn-kernel", default="off",
                         choices=("off", "auto", "force"),
                         metavar="MODE",
@@ -551,6 +563,7 @@ def main(argv=None):
                            attn_kernel=(0 if args.serve_attn_kernel
                                         == "off"
                                         else args.serve_attn_kernel),
+                           megastep=args.serve_megastep,
                            tp=args.serve_tp,
                            replicas=args.serve_replicas,
                            router=args.serve_router,

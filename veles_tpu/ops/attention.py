@@ -479,7 +479,7 @@ def paged_view(pool, ptab):
                                      g.shape[-1]))
 
 
-def paged_write(pool, ptab, pos, rows):
+def paged_write(pool, ptab, pos, rows, write_mask=None):
     """Scatter ``c`` new K (or V) rows into the pool at the lanes'
     LINEAR positions [pos, pos+c) — the paged sibling of the contiguous
     ``dynamic_update_slice`` write.
@@ -490,12 +490,22 @@ def paged_write(pool, ptab, pos, rows):
     write may straddle two pages; the scatter handles that uniformly.
     Duplicate targets (every free lane parks on the scratch page) are
     resolved arbitrarily — by construction only garbage rows collide,
-    and nothing live ever attends them."""
+    and nothing live ever attends them.
+
+    ``write_mask`` (traced bool, one per lane) REDIRECTS a masked-out
+    lane's whole write onto the reserved scratch page (pool row 0 —
+    ``serving/kv_pool.py::KVPagePool.SCRATCH``): the decode megastep
+    (ISSUE 13) keeps early-exit lanes inside the batched program, and
+    their dead iterations must not be able to touch ANY allocated page
+    — not their own (possibly trie-shared) pages, not a clamped table
+    edge — no matter what garbage position the frozen carry holds."""
     page = pool.shape[2]
     c = rows.shape[-2]
     linear = jnp.asarray(pos)[..., None] + jnp.arange(c)   # (..., c)
     page_ids = jnp.take_along_axis(ptab, linear // page, axis=-1)
     offsets = linear % page
+    if write_mask is not None:
+        page_ids = jnp.where(write_mask[..., None], page_ids, 0)
     # advanced indices split by the head slice: index dims move to the
     # front (numpy rules), so the update is (..., c, kv, dh)
     return pool.at[page_ids, :, offsets, :].set(
@@ -504,7 +514,7 @@ def paged_write(pool, ptab, pos, rows):
 
 def mha_paged_chunk_step(params, x, k_pool, v_pool, ptab, pos, n_heads,
                          rope=False, window=None, sinks=0,
-                         attn_kernel=None):
+                         attn_kernel=None, write_mask=None):
     """``c`` positions per lane against the PAGED KV pool in one pass —
     :func:`mha_chunk_step` with the storage indirected through a page
     table, batched over lanes (each at its own traced ``pos``).
@@ -537,7 +547,15 @@ def mha_paged_chunk_step(params, x, k_pool, v_pool, ptab, pos, n_heads,
     contract; ``paged_flash_prefill`` streams the history and installs
     the chunk's rows in its epilogue).  None/False = the XLA path.
     Kernel outputs match XLA to fp32 roundoff (online softmax), which
-    preserves the greedy argmax the serving contract pins."""
+    preserves the greedy argmax the serving contract pins.
+
+    ``write_mask`` (traced (b,) bool; ISSUE 13) diverts masked lanes'
+    K/V writes to the scratch page (see :func:`paged_write`) — their
+    attention still runs (the megastep program's shape never changes)
+    but its output is garbage the host discards; the pool is untouched
+    for them.  Not supported with ``attn_kernel='prefill'`` (the fused
+    install has no mask slot; the megastep never uses that leg —
+    prefill chunks stay per-lane host dispatches)."""
     b, c, d = x.shape
     dh = d // n_heads
     kv = kv_heads_of(params, n_heads, d)
@@ -555,18 +573,21 @@ def mha_paged_chunk_step(params, x, k_pool, v_pool, ptab, pos, n_heads,
     if attn_kernel:
         from veles_tpu.ops import pallas_kernels as PK
         if attn_kernel == "prefill":
+            if write_mask is not None:
+                raise ValueError("write_mask is not supported with "
+                                 "attn_kernel='prefill' (fused install)")
             o, k_pool, v_pool = PK.paged_flash_prefill(
                 q, k_new, v_new, k_pool, v_pool, ptab, pos,
                 window=window, sinks=sinks)
         else:
-            k_pool = paged_write(k_pool, ptab, pos, k_new)
-            v_pool = paged_write(v_pool, ptab, pos, v_new)
+            k_pool = paged_write(k_pool, ptab, pos, k_new, write_mask)
+            v_pool = paged_write(v_pool, ptab, pos, v_new, write_mask)
             o = PK.paged_flash_decode(q, k_pool, v_pool, ptab, pos,
                                       window=window, sinks=sinks)
         o = o.transpose(0, 2, 1, 3).reshape(b, c, d)
         return matmul(o, params["wo"]), k_pool, v_pool
-    k_pool = paged_write(k_pool, ptab, pos, k_new)
-    v_pool = paged_write(v_pool, ptab, pos, v_new)
+    k_pool = paged_write(k_pool, ptab, pos, k_new, write_mask)
+    v_pool = paged_write(v_pool, ptab, pos, v_new, write_mask)
     kx = paged_view(k_pool, ptab)               # (b, kv, L, dh)
     vx = paged_view(v_pool, ptab)
     scores = matmul(q, jnp.swapaxes(_repeat_kv(kx, n_heads),

@@ -319,19 +319,23 @@ def chunk_apply(params, tokens, caches, pos, n_heads, rope=False,
 
 def block_paged_chunk_step(blk, h, k_pool, v_pool, ptab, pos, n_heads,
                            rope=False, window=None, sinks=0,
-                           attn_kernel=None):
+                           attn_kernel=None, write_mask=None):
     """One block over ``c`` positions per lane against the PAGED KV
     pool — :func:`block_chunk_step` with storage indirected through a
     per-lane page table (``attention.mha_paged_chunk_step`` core), and
     batched over lanes so decode/verify advance every lane in ONE
     dispatch without vmapping the shared pool.  ``attn_kernel``
     (static: None | 'decode' | 'prefill') routes attention through the
-    Pallas serving kernels (ISSUE 7)."""
+    Pallas serving kernels (ISSUE 7); ``write_mask`` (traced (b,)
+    bool; ISSUE 13) diverts masked lanes' K/V writes to the scratch
+    page — the megastep's early-exit lanes stay in the program without
+    being able to touch an allocated page."""
     from veles_tpu.ops.attention import mha_paged_chunk_step
     hn = _layernorm(h, blk["ln1"]["g"], blk["ln1"]["b"])
     attn, k_pool, v_pool = mha_paged_chunk_step(
         blk["attn"], hn, k_pool, v_pool, ptab, pos, n_heads, rope=rope,
-        window=window, sinks=sinks, attn_kernel=attn_kernel)
+        window=window, sinks=sinks, attn_kernel=attn_kernel,
+        write_mask=write_mask)
     h = h + attn
     hn = _layernorm(h, blk["ln2"]["g"], blk["ln2"]["b"])
     return h + _block_ffn(blk, hn), k_pool, v_pool
@@ -355,7 +359,7 @@ def paged_chunk_embed(params, tokens, pos):
 
 def paged_chunk_apply(params, tokens, pools, ptab, pos, n_heads,
                       rope=False, window=None, sinks=0,
-                      attn_kernel=None):
+                      attn_kernel=None, write_mask=None):
     """Run ``c`` consecutive tokens PER LANE through the whole stack
     against the paged KV pools in one pass — :func:`chunk_apply` with
     (pools, page table) in place of per-lane contiguous caches.
@@ -370,16 +374,70 @@ def paged_chunk_apply(params, tokens, pools, ptab, pos, n_heads,
     contiguous path's bit for bit.  ``attn_kernel`` (static: None |
     'decode' | 'prefill') swaps every block's attention for the Pallas
     serving kernel path (ISSUE 7) — same K/V writes, no materialized
-    ``paged_view`` gather."""
+    ``paged_view`` gather.  ``write_mask`` (traced (b,) bool; ISSUE
+    13) redirects masked lanes' K/V writes to the scratch page — see
+    :func:`~veles_tpu.ops.attention.paged_write`."""
     h = paged_chunk_embed(params, tokens, pos)
     new_pools = []
     for blk, (kp, vp) in zip(params["blocks"], pools):
         h, kp, vp = block_paged_chunk_step(blk, h, kp, vp, ptab, pos,
                                            n_heads, rope=rope,
                                            window=window, sinks=sinks,
-                                           attn_kernel=attn_kernel)
+                                           attn_kernel=attn_kernel,
+                                           write_mask=write_mask)
         new_pools.append((kp, vp))
     return h, new_pools
+
+
+def propose_draft_in_graph(hist, hlen, k, max_ngram=3):
+    """Prompt-lookup draft proposal as a TRACED function — the in-graph
+    sibling of ``serving/lm_engine.py::propose_draft``, so the decode
+    megastep (ISSUE 13) can run propose → verify → accept entirely on
+    device instead of paying a host round-trip per speculative step.
+
+    hist: (L,) int32 token history (prompt + emitted so far; positions
+    >= ``hlen`` are garbage); hlen: traced scalar.  Tries the final
+    g-gram for g = ``max_ngram`` down to 1 (largest g wins, matching
+    the host version's preference), takes the MOST RECENT earlier
+    occurrence that ends strictly before the final position, and
+    returns (draft (k,) int32, found bool) — the k tokens following
+    the match (zeros when nothing recurs; tokens past ``hlen`` in the
+    continuation window may be garbage).
+
+    Draft quality affects SPEED only: the verifier accepts a draft
+    token iff it equals its own greedy argmax, so a garbage draft can
+    never change output — which is why this function needs no exact
+    numerical parity with the host proposer, only the same contract."""
+    import jax
+    import jax.numpy as jnp
+    hist = jnp.asarray(hist, jnp.int32)
+    hlen = jnp.asarray(hlen, jnp.int32)
+    n = hist.shape[0]
+    idx = jnp.arange(n)
+    best_start = jnp.asarray(0, jnp.int32)
+    best_g = jnp.asarray(0, jnp.int32)
+    found = jnp.asarray(False)
+    for g in range(max_ngram, 0, -1):       # static unroll, g descends
+        # the final g-gram (dynamic_slice clamps a negative start; the
+        # validity mask below zeroes those degenerate cases out)
+        tail = jax.lax.dynamic_slice_in_dim(
+            hist, jnp.maximum(hlen - g, 0), g)
+        eq = jnp.ones((n,), bool)
+        for t in range(g):
+            # hist[j + t] at index j; jnp.roll wraps, but wrapped
+            # windows fail the validity mask (j + g <= hlen - 1 < n)
+            eq &= jnp.roll(hist, -t) == tail[t]
+        valid = (idx + g <= hlen - 1) & (hlen >= g + 1)
+        hit = eq & valid
+        any_hit = hit.any()
+        recent = jnp.where(hit, idx, -1).max().astype(jnp.int32)
+        take = any_hit & ~found
+        best_start = jnp.where(take, recent, best_start)
+        best_g = jnp.where(take, jnp.asarray(g, jnp.int32), best_g)
+        found = found | any_hit
+    cont = jax.lax.dynamic_slice_in_dim(
+        hist, jnp.clip(best_start + best_g, 0, n - k), k)
+    return jnp.where(found, cont, jnp.zeros(k, jnp.int32)), found
 
 
 def lm_param_specs(params, axis="tp"):

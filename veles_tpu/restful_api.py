@@ -388,7 +388,7 @@ def serve_lm(workflow, host="127.0.0.1", port=8180, max_new=256,
              slots=0, queue_depth=64, deadline_s=30.0,
              prefix_cache=0, prefill_chunk=0, spec_k=0,
              queue_tokens=0, paged_kv=0, attn_kernel=None,
-             tp=0, replicas=1, router="metrics",
+             megastep=0, tp=0, replicas=1, router="metrics",
              health=False, health_interval_s=1.0, hedge=0.0,
              retries=0, fault_plan=None, model_dir=None,
              publish_interval_s=5.0, canary=1, canary_watch_s=2.0,
@@ -426,8 +426,15 @@ def serve_lm(workflow, host="127.0.0.1", port=8180, max_new=256,
     unsupported geometry — logged once, counted on ``/metrics`` as
     ``attn_kernel_fallbacks``); ``'force'`` insists off-TPU (interpret
     mode, test gear); ``None`` follows
-    ``attention.set_attention_backend('flash_serve')``.  All preserve
-    bit-identical greedy output; see ``veles_tpu/serving/lm_engine.py``.
+    ``attention.set_attention_backend('flash_serve')``.
+    ``megastep=K`` (ISSUE 13) fuses K decode iterations — propose →
+    verify → accept legs when ``spec_k`` is on — into one jitted
+    ``lax.scan`` dispatch per engine tick: admission, deadline
+    shedding, completion detection, weight-swap application and
+    tracing all move to MEGASTEP BOUNDARIES (a deadline expiring
+    mid-megastep sheds at the next boundary; see USAGE.md "Megastep
+    decode").  All preserve bit-identical greedy output; see
+    ``veles_tpu/serving/lm_engine.py``.
 
     SHARDED SERVING (ISSUE 8): ``tp=N`` runs each engine's decode
     tensor-parallel over an N-device mesh (weights head-sharded,
@@ -562,6 +569,7 @@ def serve_lm(workflow, host="127.0.0.1", port=8180, max_new=256,
                 prefix_cache=prefix_cache, prefill_chunk=prefill_chunk,
                 spec_k=spec_k, queue_tokens=queue_tokens,
                 paged_kv=paged_kv, attn_kernel=attn_kernel,
+                megastep=megastep,
                 tp=tp_n, devices=devices, name=eng_name,
                 metrics=metrics_mod.new("lm", labels=label),
                 faults=fault_plan, tracer=tracer)

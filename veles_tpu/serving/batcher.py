@@ -255,6 +255,19 @@ class MicroBatcher(Logger):
                 if n >= self.max_batch or remaining <= 0 or self._stop:
                     break
                 self._cond.wait(remaining)
+            # boundary sweep (the ISSUE 13 discipline, applied here
+            # too): shed EVERY expired queued item at the batch
+            # boundary, not only those this batch's pops happen to
+            # reach — a deep-queue item must not sit past its deadline
+            # just because the head keeps the worker busy
+            if self._queue:
+                now = time.monotonic()
+                if any(now > it.deadline for it in self._queue):
+                    keep = collections.deque()
+                    for it in self._queue:
+                        (expired if now > it.deadline
+                         else keep).append(it)
+                    self._queue = keep
             self.metrics.set_gauge("queue_depth", len(self._queue))
         return items, expired
 

@@ -120,6 +120,46 @@ Every result is stamped with the ``weights_version`` that produced it,
 so mixed-fleet replies are attributable during a rolling deploy
 (``serving/router.py::Router.deploy``).
 
+The DECODE MEGASTEP (ISSUE 13, ``megastep=K``) fuses K decode
+iterations into ONE jitted ``lax.scan`` program, so the host pays one
+dispatch (and one lock-guarded tick of admission/tracing/bookkeeping)
+per K generated tokens instead of per token — the whole-loop-on-device
+move PR 2's ``window_scan_fn`` made for training epochs, applied to
+the serving inner loop:
+
+- the scan body is exactly today's batched step (or, with ``spec_k``,
+  a propose → verify → accept leg whose n-gram draft proposal runs
+  IN-GRAPH over a carried token-history buffer —
+  ``ops/transformer.py::propose_draft_in_graph`` — so speculation
+  composes with the megastep instead of forcing a host round-trip per
+  draft);
+- greedy argmax selection, ``paged_write`` KV appends through the
+  traced page tables, and per-lane position/frontier advance all stay
+  inside the program;
+- a lane that exhausts its ``n_new`` mid-program is MASKED, not
+  returned: its carry freezes (position/last token stop advancing),
+  its emitted slots read -1, and — paged — its K/V writes are
+  redirected to the scratch page (``paged_write(write_mask=)``), so a
+  dead iteration can never touch an allocated page.  The wasted
+  iterations are metered (``megastep_wasted_iterations``) so the K
+  tradeoff is measured, not guessed;
+- the HOST operates at MEGASTEP BOUNDARIES: admission, deadline
+  shedding (one queue sweep per boundary — ``_boundary_shed``),
+  completion detection (the per-lane emitted-token buffers are scanned
+  for each lane's exact ``n_new``), swap application
+  (``_maybe_apply_swap``) and fault sites all run once per megastep,
+  and tracing records ONE ``decode.megastep`` span per dispatch
+  (carrying K and each lane's tokens emitted) so the ISSUE 12 cost
+  ledger counts the fused program once, never the folded per-token
+  work.
+
+``megastep=1`` (and 0, the default) keeps today's per-tick path
+bit-for-bit; any K is bit-identical to it anyway (the scan body IS the
+step program), which the parity matrix pins across the full
+{paged_kv, prefix_cache, prefill_chunk, spec_k, attn_kernel, tp}
+feature set.  With the Pallas ``paged_flash_decode`` kernel active the
+whole K-step loop never leaves the device.
+
 Decoding is GREEDY (temperature 0) — bit-identical to
 ``ops/transformer.py::generate`` for the same prompt WHATEVER fast-path
 combination is enabled, which is the serving contract (sampled
@@ -131,7 +171,9 @@ install program, plus (fast path) one chunk-prefill program, one
 chunk-install/extract pair, and one verify program per (engine) ``k``;
 paged mode compiles one chunk, one step, one verify and one page-copy
 program TOTAL (the page-table indirection is traced data, never a
-shape).
+shape).  The megastep adds ONE fused program per (live-width ladder
+entry × K) family — K is fixed per engine, so that is one program
+contiguous / one per ladder entry paged, the jit-guard-asserted bound.
 """
 
 from __future__ import annotations
@@ -409,6 +451,13 @@ class LMEngine(Logger):
     flood 429s early instead of building an unbounded prefill backlog
     (the head request always admits, so a single oversized prompt can
     not wedge an empty queue).
+
+    ``megastep=K`` (ISSUE 13) fuses K decode iterations — or K
+    propose→verify→accept legs under ``spec_k`` — into ONE jitted
+    ``lax.scan`` dispatch, moving all host bookkeeping (admission,
+    deadline shedding, completion, swaps, tracing) to megastep
+    boundaries; 0/1 keeps the per-tick path.  See the module
+    docstring.
     """
 
     def __init__(self, params, n_heads, max_len, slots=4, rope=False,
@@ -417,7 +466,7 @@ class LMEngine(Logger):
                  prefix_cache=0, spec_k=0, spec_ngram=3,
                  queue_tokens=0, paged_kv=0, attn_kernel=None,
                  tp=0, devices=None, faults=None, version=0,
-                 tracer=None):
+                 tracer=None, megastep=0):
         import jax
         import jax.numpy as jnp
         if slots < 1:
@@ -495,6 +544,13 @@ class LMEngine(Logger):
                              % (self.spec_k + 1, self.prefill_chunk))
         if self.spec_ngram < 1:
             raise ValueError("spec_ngram must be >= 1")
+        #: decode megastep (ISSUE 13): K >= 2 fuses K decode (or
+        #: propose/verify) iterations into one lax.scan dispatch;
+        #: 0/1 = the per-tick path, bit-identical and unchanged
+        self.megastep = int(megastep or 0)
+        if self.megastep < 0:
+            raise ValueError("megastep must be >= 0 (got %d)"
+                             % self.megastep)
         if self._paged and self.max_len % self.prefill_chunk:
             # the paged lane view must tile max_len exactly: a partial
             # tail page would either truncate placeable rows or attend
@@ -782,12 +838,13 @@ class LMEngine(Logger):
         kv_tree = repl = None
         if self._mesh is not None:
             kv_tree, repl = self._out_shard_trees()
+        step_all = jax.vmap(step_one, in_axes=(None, 0, 0, 0))
         self._prefill_jit = self._jit(
             prefill_one,
             (repl, kv_tree) if self._mesh is not None else None)
         self._install_jit = self._jit(install, kv_tree)
         self._step_jit = self._jit(
-            jax.vmap(step_one, in_axes=(None, 0, 0, 0)),
+            step_all,
             (kv_tree, repl) if self._mesh is not None else None)
 
         self._chunk_jit = None
@@ -850,6 +907,7 @@ class LMEngine(Logger):
             self._chunk_install_jit = self._jit(chunk_install, kv_tree)
 
         self._verify_jit = None
+        verify_all = None
         if self.spec_k:
             def verify_one(params, cache_rows, toks, pos):
                 # toks (k+1,) = [last committed, draft…] fed at
@@ -865,9 +923,19 @@ class LMEngine(Logger):
                 out = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                 return [(kc[0], vc[0]) for kc, vc in rows], out
 
+            verify_all = jax.vmap(verify_one, in_axes=(None, 0, 0, 0))
             self._verify_jit = self._jit(
-                jax.vmap(verify_one, in_axes=(None, 0, 0, 0)),
+                verify_all,
                 (kv_tree, repl) if self._mesh is not None else None)
+
+        # ---- decode megastep (ISSUE 13): K fused iterations of the
+        # step (or propose→verify→accept) per dispatch — the scan body
+        # IS the vmapped program above, so any K is bit-identical to K
+        # repeated ticks; early-exit lanes freeze their carry (their
+        # writes land at their own frozen in-bounds rows, harmless —
+        # the lane is finished and its slot recycles at the boundary)
+        self._wire_megastep_jit(kv_tree, repl, step_all=step_all,
+                                verify_all=verify_all)
 
     def _build_paged_jits(self):
         """The PAGED program set — every shape is fixed by (slots,
@@ -952,6 +1020,184 @@ class LMEngine(Logger):
 
             self._verify_jit = self._jit(verify_all, pair)
 
+        # decode megastep (ISSUE 13): the fused K-iteration program —
+        # the page-table slice stays a traced-data argument, so the
+        # compile bound is one program per (live-width ladder entry × K)
+        # family, K fixed per engine
+        self._wire_megastep_jit(kv_tree, repl)
+
+    # --------------------------------------------------------- megastep
+    def _wire_megastep_jit(self, kv_tree, repl, step_all=None,
+                           verify_all=None):
+        """Build and jit the fused megastep program (or leave it None
+        below K=2) — THE one wiring both layout builders share, so the
+        output arity and the tp-mesh out_shardings pin (storage, last,
+        pos, emitted[, accs]) can never drift between them."""
+        self._megastep_jit = None
+        if self.megastep < 2:
+            return
+        mega = self._make_megastep_body(step_all=step_all,
+                                        verify_all=verify_all)
+        n_out = 5 if self.spec_k else 4
+        out_sh = ((kv_tree,) + (repl,) * (n_out - 1)
+                  if self._mesh is not None else None)
+        self._megastep_jit = self._jit(mega, out_sh)
+
+    def _make_megastep_body(self, step_all=None, verify_all=None):
+        """Build the fused K-iteration decode program (ISSUE 13) for
+        this engine's layout and speculation mode — the scan body IS
+        the per-tick batched step (or propose → verify → accept leg),
+        so any K is bit-identical to K repeated host ticks by
+        construction.
+
+        Signature of the returned function: ``(params, storage[,
+        ptabs], last, pos, left[, hist, hlen]) -> (storage, last, pos,
+        emitted[, accs])`` where ``storage`` is the contiguous caches
+        or the paged pools, ``emitted`` is (K, slots) int32 — or
+        (K, slots, spec_k+1) speculative — with -1 marking positions a
+        frozen (early-exited or never-active) lane did not emit, and
+        ``accs`` (K, slots) carries each iteration's draft-acceptance
+        count (-1 when frozen) for the host's metering.
+
+        EARLY-EXIT MASKING: a lane whose ``left`` hits 0 freezes — its
+        last token, position and history stop advancing, its emitted
+        slots read -1, and (paged) its K/V writes are redirected to
+        the scratch page via ``write_mask`` so a dead iteration can
+        never touch an allocated (possibly trie-shared) page.  On the
+        contiguous layout frozen writes land at the lane's own frozen
+        in-bounds row (the position clamp below keeps the speculative
+        write window inside [0, max_len)), which is harmless: the lane
+        is finished and its slot recycles at the boundary, exactly the
+        existing free-/prefilling-slot garbage-write discipline.
+
+        SPECULATIVE leg: the draft comes from
+        ``ops/transformer.py::propose_draft_in_graph`` over a carried
+        (slots, max_len) token-history buffer — accepted tokens are by
+        construction the verifier's own argmax (``emit = out[:acc+1]``,
+        since a draft token only counts as accepted when it EQUALS the
+        argmax), so greedy output is exact whatever the draft, and
+        spec_k composes with the megastep at zero host round-trips."""
+        import jax
+        import jax.numpy as jnp
+        K, k = self.megastep, self.spec_k
+        paged = self._paged
+        n_heads = self.n_heads
+        rope, window, sinks = self.rope, self.window, self.sinks
+        kern = self._kernel_active
+        L = self.max_len
+        if paged:
+            from veles_tpu.ops.transformer import (head_logits,
+                                                   paged_chunk_apply)
+        # frozen-lane feed clamp: an active lane's legitimate feed
+        # positions never reach it (admission reserves n_new + spec_k
+        # headroom), and a finished lane's garbage verify window
+        # [pos, pos+k] must stay inside [0, max_len)
+        cap = jnp.asarray(L - 1 - k, jnp.int32)
+
+        if k:
+            from veles_tpu.ops.transformer import propose_draft_in_graph
+            ngram = self.spec_ngram
+            propose_all = jax.vmap(
+                lambda h, hl: propose_draft_in_graph(h, hl, k, ngram))
+            cols = jnp.arange(k + 1)[None, :]
+
+            def spec_iter(params, storage, ptabs, carry):
+                last, pos, left, hist, hlen = carry
+                active = left > 0
+                draft, _found = propose_all(hist, hlen)
+                toks = jnp.concatenate([last[:, None], draft], axis=1)
+                if paged:
+                    h, storage = paged_chunk_apply(
+                        params, toks, storage, ptabs, pos, n_heads,
+                        rope=rope, window=window, sinks=sinks,
+                        attn_kernel="decode" if kern else None,
+                        write_mask=active)
+                    out = jnp.argmax(head_logits(params, h),
+                                     axis=-1).astype(jnp.int32)
+                else:
+                    storage, out = verify_all(params, storage, toks,
+                                              pos)
+                # leading draft/argmax matches; accepted tokens ARE
+                # out[:acc], so the emit window is simply out[:take]
+                matches = (draft == out[:, :k]).astype(jnp.int32)
+                acc = jnp.cumprod(matches, axis=1).sum(axis=1)
+                take = jnp.minimum(acc + 1, left)
+                emit = jnp.where(
+                    active[:, None] & (cols < take[:, None]), out, -1)
+                # history append: the full (k+1) window lands at hlen
+                # (start clamped so the update can never shift); rows
+                # past `take` are overwritten by the next append or
+                # never read — draft quality is speed-only
+                hist = jax.vmap(
+                    lambda h_, hl, row, act: jnp.where(
+                        act, jax.lax.dynamic_update_slice(
+                            h_, row,
+                            (jnp.minimum(hl, L - (k + 1)),)), h_))(
+                    hist, hlen, out, active)
+                hlen = jnp.where(active,
+                                 jnp.minimum(hlen + take, L), hlen)
+                last = jnp.where(active, jnp.take_along_axis(
+                    out, acc[:, None], axis=1)[:, 0], last)
+                pos = jnp.where(active,
+                                jnp.minimum(pos + acc + 1, cap), pos)
+                left = left - jnp.where(active, take, 0)
+                return storage, (last, pos, left, hist, hlen), \
+                    (emit, jnp.where(active, acc, -1))
+
+            def mega_spec(params, storage, ptabs, last, pos, left,
+                          hist, hlen):
+                def body(carry, _):
+                    storage, rest = carry
+                    storage, rest, out = spec_iter(params, storage,
+                                                   ptabs, rest)
+                    return (storage, rest), out
+
+                (storage, rest), (emitted, accs) = jax.lax.scan(
+                    body, (storage, (last, pos, left, hist, hlen)),
+                    None, length=K)
+                return storage, rest[0], rest[1], emitted, accs
+
+            if paged:
+                return mega_spec
+            return lambda params, storage, last, pos, left, hist, \
+                hlen: mega_spec(params, storage, None, last, pos,
+                                left, hist, hlen)
+
+        def plain_iter(params, storage, ptabs, carry):
+            last, pos, left = carry
+            active = left > 0
+            if paged:
+                h, storage = paged_chunk_apply(
+                    params, last[:, None], storage, ptabs, pos,
+                    n_heads, rope=rope, window=window, sinks=sinks,
+                    attn_kernel="decode" if kern else None,
+                    write_mask=active)
+                toks = jnp.argmax(head_logits(params, h)[:, 0, :],
+                                  axis=-1).astype(jnp.int32)
+            else:
+                storage, toks = step_all(params, storage, last, pos)
+            emit = jnp.where(active, toks, -1)
+            last = jnp.where(active, toks, last)
+            pos = jnp.where(active, pos + 1, pos)
+            left = left - jnp.where(active, 1, 0)
+            return storage, (last, pos, left), emit
+
+        def mega_plain(params, storage, ptabs, last, pos, left):
+            def body(carry, _):
+                storage, rest = carry
+                storage, rest, emit = plain_iter(params, storage,
+                                                 ptabs, rest)
+                return (storage, rest), emit
+
+            (storage, rest), emitted = jax.lax.scan(
+                body, (storage, (last, pos, left)), None, length=K)
+            return storage, rest[0], rest[1], emitted
+
+        if paged:
+            return mega_plain
+        return lambda params, storage, last, pos, left: mega_plain(
+            params, storage, None, last, pos, left)
+
     # --------------------------------------------------------------- lifecycle
     def start(self):
         import jax.numpy as jnp
@@ -967,11 +1213,22 @@ class LMEngine(Logger):
                 jnp.zeros(self.prefill_chunk, jnp.int32), zero, zero)
             self._kv_pools = self._page_copy_jit(self._kv_pools, zero,
                                                  zero)
-            # step/verify compile one program per live-width ladder
-            # entry (ISSUE 7) — warm EVERY entry now, or the first
-            # request to cross each width boundary pays its compile
-            # inside the serving loop
+            # step/verify (or the fused megastep, which REPLACES them
+            # on the decode loop) compile one program per live-width
+            # ladder entry (ISSUE 7) — warm EVERY entry now, or the
+            # first request to cross each width boundary pays its
+            # compile inside the serving loop
+            zeros = jnp.zeros(self.slots, jnp.int32)
             for w in self._width_ladder:
+                if self._megastep_jit is not None:
+                    args = [self.params, self._kv_pools, ptabs[:, :w],
+                            zeros, zeros, zeros]
+                    if self.spec_k:
+                        args += [jnp.zeros((self.slots, self.max_len),
+                                           jnp.int32), zeros]
+                    out = self._megastep_jit(*args)
+                    self._kv_pools = out[0]
+                    continue
                 if self._verify_jit is not None:
                     self._kv_pools, _ = self._verify_jit(
                         self.params, self._kv_pools, ptabs[:, :w],
@@ -1001,15 +1258,24 @@ class LMEngine(Logger):
                 self._caches = self._chunk_install_jit(self._caches,
                                                        crows, zero,
                                                        zero)
-            if self._verify_jit is not None:
-                self._caches, _ = self._verify_jit(
+            if self._megastep_jit is not None:
+                zeros = jnp.zeros(self.slots, jnp.int32)
+                args = [self.params, self._caches, zeros, zeros, zeros]
+                if self.spec_k:
+                    args += [jnp.zeros((self.slots, self.max_len),
+                                       jnp.int32), zeros]
+                self._caches = self._megastep_jit(*args)[0]
+            else:
+                if self._verify_jit is not None:
+                    self._caches, _ = self._verify_jit(
+                        self.params, self._caches,
+                        jnp.zeros((self.slots, self.spec_k + 1),
+                                  jnp.int32),
+                        jnp.zeros(self.slots, jnp.int32))
+                self._caches, _ = self._step_jit(
                     self.params, self._caches,
-                    jnp.zeros((self.slots, self.spec_k + 1), jnp.int32),
-                    jnp.zeros(self.slots, jnp.int32))
-            self._caches, _ = self._step_jit(
-                self.params, self._caches,
-                jnp.zeros(self.slots, jnp.int32),
-                jnp.ones(self.slots, jnp.int32))
+                    jnp.zeros(self.slots, jnp.int32),
+                    jnp.ones(self.slots, jnp.int32))
         self._stop = False
         self._thread = threading.Thread(target=self._worker, daemon=True,
                                         name="lm-engine-%s" % self.name)
@@ -1787,9 +2053,19 @@ class LMEngine(Logger):
         bit-identical.  Structurally rare (shared pages are full prompt
         chunks; appends land past the prompt), kept as the safety net
         that makes sharing unconditionally sound.  Raises on pool
-        exhaustion — the caller fails THIS lane, never wedges."""
+        exhaustion — the caller fails THIS lane, never wedges.
+
+        ``hi`` is clamped to the lane's reservation: a megastep quotes
+        its WORST-CASE span (K iterations all advancing), but a lane's
+        real writes never pass its reserved pages (the program freezes
+        an exhausted lane and masks its writes to scratch), so pages
+        past the reservation need no copy — and indexing them would be
+        out of range."""
         import jax.numpy as jnp
         P = self.prefill_chunk
+        hi = min(hi, len(lane.pages) * P)
+        if hi <= lo:
+            return
         for j in range(lo // P, (hi - 1) // P + 1):
             p = lane.pages[j]
             if not self._pool.shared(p):
@@ -2314,6 +2590,169 @@ class LMEngine(Logger):
             if lane.remaining == 0 or lane.request.cancelled:
                 self._finish(slot)
 
+    def _step_megastep(self, active):
+        """ONE fused dispatch advances every active lane by up to K
+        tokens (up to K·(spec_k+1) speculative): the ``lax.scan``
+        program from :meth:`_make_megastep_body`.  The host's only
+        per-token work is reading the returned emitted-token buffer at
+        the BOUNDARY — admission, completion, deadline shedding, swap
+        application and tracing all happen once per megastep, not per
+        token, which is the whole point (ISSUE 13)."""
+        import jax.numpy as jnp
+        K, k = self.megastep, self.spec_k
+        # worst-case per-lane span this dispatch can write (the cow
+        # guard and the live-width slice must cover every real write;
+        # _cow_guard clamps to each lane's reservation, _live_width to
+        # max_pages)
+        span = K * (k + 1) + k if k else K
+        if self._paged:
+            active = self._cow_guard_active(active, span)
+            if not active:
+                return
+        left = numpy.zeros(self.slots, numpy.int32)
+        for slot in active:
+            left[slot] = self._lanes[slot].remaining
+        extra = ()
+        if k:
+            # the in-graph proposer's token history: prompt + emitted
+            # so far per lane, rebuilt from host truth each boundary
+            hist = numpy.zeros((self.slots, self.max_len), numpy.int32)
+            hlen = numpy.zeros(self.slots, numpy.int32)
+            for slot in active:
+                lane = self._lanes[slot]
+                row = numpy.concatenate(
+                    [lane.request.prompt,
+                     numpy.asarray(lane.emitted, numpy.int32)])
+                hist[slot, :len(row)] = row
+                hlen[slot] = len(row)
+            extra = (jnp.asarray(hist), jnp.asarray(hlen))
+        w = None
+        tctxs = ()
+        if self._tracer is not None:
+            tctxs = [self._lanes[s].request.trace for s in active]
+        t0 = time.monotonic()
+        try:
+            self._fault("engine.step")
+            if self._paged:
+                w = self._live_width(span)
+                out = self._megastep_jit(
+                    self.params, self._kv_pools,
+                    jnp.asarray(self._page_tables[:, :w]),
+                    jnp.asarray(self._last), jnp.asarray(self._pos),
+                    jnp.asarray(left), *extra)
+                self._kv_pools = out[0]
+            else:
+                out = self._megastep_jit(
+                    self.params, self._caches, jnp.asarray(self._last),
+                    jnp.asarray(self._pos), jnp.asarray(left), *extra)
+                self._caches = out[0]
+            last, pos, emitted = (numpy.asarray(out[1]),
+                                  numpy.asarray(out[2]),
+                                  numpy.asarray(out[3]))
+            accs = numpy.asarray(out[4]) if k else None
+            self._tfence(self._kv_pools if self._paged
+                         else self._caches,
+                         any(c is not None for c in tctxs))
+        except Exception as e:   # noqa: BLE001 — fails the lanes
+            if self._tracer is not None:
+                self._tracer.add_many(
+                    tctxs, "decode.megastep", "decode", t0,
+                    time.monotonic(),
+                    attrs={"batch": len(active), "K": K,
+                           "error": str(e)})
+            self._fail_active(active, e)
+            return
+        t1 = time.monotonic()
+        # sync the host frontiers from the program's final carry
+        # (frozen lanes returned their entry values, so this is a
+        # wholesale assignment)
+        self._pos = numpy.array(pos, numpy.int32)
+        self._last = numpy.array(last, numpy.int32)
+        lane_tokens = {}
+        wasted = 0
+        for slot in active:
+            lane = self._lanes[slot]
+            rows = (emitted[:, slot, :] if k
+                    else emitted[:, slot][:, None])        # (K, c)
+            toks = rows[rows >= 0]       # iteration-major real tokens
+            wasted += int((rows[:, 0] < 0).sum())
+            lane.emitted.extend(int(t) for t in toks)
+            lane.remaining -= len(toks)
+            lane_tokens[slot] = int(len(toks))
+            self.metrics.inc("tokens_out", len(toks))
+        if accs is not None:
+            # in-graph drafts are always k wide (padded), so the
+            # megastep meters k proposed per live iteration — the
+            # acceptance-rate column reads conservatively vs the host
+            # proposer's real-length metering (documented in USAGE.md)
+            live_iters = int((accs >= 0).sum())
+            self.metrics.inc("draft_tokens", k * live_iters)
+            self.metrics.inc("draft_accepted",
+                             int(numpy.clip(accs, 0, k).sum()))
+        total = sum(lane_tokens.values())
+        self.metrics.record_dispatch(len(active))
+        self.metrics.record_decode_step(t1 - t0)
+        self.metrics.inc("decode_dispatches")
+        self.metrics.record_megastep(K, len(active), total, wasted)
+        self._note_attn_dispatch()
+        if self._tracer is not None:
+            # ONE decode.megastep span per dispatch, shared did so the
+            # cost ledger counts the fused program once — never the
+            # folded per-token work; per-lane tokens ride each copy's
+            # own attrs (ISSUE 12 stays truthful)
+            self._tracer.add_many(
+                tctxs, "decode.megastep", "decode", t0, t1,
+                attrs={"batch": len(active), "K": K, "tokens": total,
+                       "bucket": "%sxK%d" % (w if w is not None
+                                             else self.slots, K),
+                       "backend": self._backend},
+                each_attrs=[{"lane_tokens": lane_tokens[s]}
+                            for s in active])
+        for slot in active:
+            lane = self._lanes[slot]
+            if lane.remaining == 0 or lane.request.cancelled:
+                self._finish(slot)
+
+    def _boundary_shed(self):
+        """Deadline shedding at the MEGASTEP BOUNDARY (ISSUE 13
+        satellite): one sweep of the whole queue per boundary, instead
+        of the admission loop's per-pop head checks paying a lock round
+        per tick.  A deadline expiring MID-megastep sheds at the NEXT
+        boundary — the documented semantics: the fused program is never
+        interrupted, a request already admitted keeps decoding (its
+        deadline only ever governed queue wait), and a request whose
+        tokens completed inside the megastep resolves its future before
+        this sweep can ever see it.  Queue-token/page gauges re-read
+        once per sweep, at the boundary, not per pop."""
+        now = time.monotonic()
+        shed = []
+        with self._cond:
+            if not self._queue:
+                return
+            if all(now <= req.deadline or req.cancelled
+                   for req in self._queue):
+                return
+            keep = collections.deque()
+            for req in self._queue:
+                if not req.cancelled and now > req.deadline:
+                    shed.append(req)
+                    self._queued_tokens -= req.true_len
+                    self._queued_pages -= req.pages
+                else:
+                    keep.append(req)
+            self._queue = keep
+            self.metrics.set_gauge("queue_depth", len(self._queue))
+            self.metrics.set_gauge("queue_tokens", self._queued_tokens)
+            if self._paged:
+                self.metrics.set_gauge("queue_pages",
+                                       self._queued_pages)
+        for req in shed:
+            self.metrics.record_shed()
+            self._trace_queue_end(req, "shed")
+            req.future.set_exception(DeadlineExceeded(
+                "prompt shed after %.3fs in queue (boundary sweep)"
+                % (time.monotonic() - req.t_enq)))
+
     def _worker(self):
         rr = 0
         while True:
@@ -2332,6 +2771,11 @@ class LMEngine(Logger):
                         [i for i, ln in enumerate(self._lanes)
                          if ln is not None], e)
             self._maybe_apply_swap()
+            # the boundary sweep (one pass per loop turn = per
+            # megastep when fused decode is on): sheds EVERY expired
+            # queued request now, not just those the admission loop
+            # happens to pop
+            self._boundary_shed()
             self._admit()
             busy = [i for i, lane in enumerate(self._lanes)
                     if lane is not None]
@@ -2363,7 +2807,9 @@ class LMEngine(Logger):
                       if lane is not None and not lane.pending]
             if not active:
                 continue
-            if self._verify_jit is not None:
+            if self._megastep_jit is not None:
+                self._step_megastep(active)
+            elif self._verify_jit is not None:
                 self._step_speculative(active)
             else:
                 self._step_plain(active)

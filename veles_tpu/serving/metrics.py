@@ -182,6 +182,24 @@ class ServingMetrics:
             self.decode_step.observe(seconds)
             self._ewma("decode_step", seconds)
 
+    def record_megastep(self, k, lanes, tokens, wasted_iterations):
+        """One fused K-iteration decode dispatch (ISSUE 13):
+        ``lanes`` lanes entered active, ``tokens`` real tokens came
+        out, ``wasted_iterations`` lane-iterations ran frozen past an
+        early exit.  Feeds the ``megastep_*`` counter family —
+        ``megastep_dispatches`` / ``megastep_tokens`` are what the
+        bench's dispatches/token column reads on megastep legs, and
+        ``megastep_wasted_iterations`` / ``megastep_lane_iterations``
+        give the ``megastep_waste_frac`` the K tradeoff is measured
+        by."""
+        with self._lock:
+            for name, n in (("megastep_dispatches", 1),
+                            ("megastep_tokens", tokens),
+                            ("megastep_lane_iterations", k * lanes),
+                            ("megastep_wasted_iterations",
+                             wasted_iterations)):
+                self.counters[name] = self.counters.get(name, 0) + n
+
     def _ewma(self, name, value, alpha=0.2):
         prev = self.ewmas.get(name)
         self.ewmas[name] = value if prev is None \

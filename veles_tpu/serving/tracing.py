@@ -8,7 +8,11 @@ threaded through the whole request path: ``restful_api.py`` opens an
 span per placement ATTEMPT (retries, hedges and drains included),
 ``serving/batcher.py`` and ``serving/lm_engine.py`` record queue wait,
 admission, every prefill chunk, every decode/verify dispatch, COW page
-copies and weight-swap applies.  Spans carry the request id, replica,
+copies and weight-swap applies.  A fused decode megastep (ISSUE 13)
+records ONE ``decode.megastep`` span per K-token dispatch — shared
+dispatch id, per-lane tokens-emitted on each request's copy — so the
+cost ledger counts the fused program once, never the folded per-token
+work.  Spans carry the request id, replica,
 weights_version and fast-path attributes (bucket, live width, backend),
 so a single request's timeline reads end to end across threads and
 engines.
@@ -318,19 +322,25 @@ class SpanTracer(Logger):
             rec["spans"][self._sid] = _Span(
                 self._sid, ctx.parent, name, cat, t, t, attrs)
 
-    def add_many(self, ctxs, name, cat, t0, t1, attrs=None):
+    def add_many(self, ctxs, name, cat, t0, t1, attrs=None,
+                 each_attrs=None):
         """Record one COMPLETED span per context — the batched-dispatch
         path (one decode tick advances many lanes): each participating
         request's timeline gets the span, all copies share one
         dispatch id (``did``) so the cost ledger counts the device
         dispatch once.  ``t0``/``t1`` are raw clock readings
         (``time.monotonic()`` — the caller already timed the fenced
-        dispatch).  Returns the did (None when nothing recorded)."""
+        dispatch).  ``each_attrs`` (same length as ``ctxs``) merges
+        per-participant attributes into that context's copy ON TOP of
+        the shared ``attrs`` — the decode megastep (ISSUE 13) stamps
+        each lane's own tokens-emitted count on a span the ledger
+        still counts once.  Returns the did (None when nothing
+        recorded)."""
         did = None
         t0 -= self._origin
         t1 -= self._origin
         with self._lock:
-            for ctx in ctxs:
+            for i, ctx in enumerate(ctxs):
                 if ctx is None:
                     continue
                 rec = self._live.get(ctx.rid)
@@ -342,10 +352,13 @@ class SpanTracer(Logger):
                 if did is None:
                     self._did += 1
                     did = self._did
+                span_attrs = dict(attrs or (), did=did)
+                if each_attrs is not None and each_attrs[i]:
+                    span_attrs.update(each_attrs[i])
                 self._sid += 1
                 rec["spans"][self._sid] = _Span(
                     self._sid, ctx.parent, name, cat, t0, t1,
-                    dict(attrs or (), did=did))
+                    span_attrs)
         return did
 
     def add(self, ctx, name, cat, t0, t1, attrs=None):
