@@ -45,6 +45,105 @@ sys.path.insert(0, REPO)
 #: the shared record contract every streamed summary line honors
 REQUIRED_KEYS = ("metric", "value", "unit", "vs_baseline", "configs")
 
+#: the ``GET /timeseries.json`` payload contract (ISSUE 14) — what
+#: tools/slo_report.py and the TPU-session tooling join on
+TIMESERIES_KEYS = ("name", "sampled_at", "interval_s", "window_s",
+                   "samples", "series")
+#: the ``GET /slo.json`` payload contract
+SLO_KEYS = ("name", "sampled_at", "windows_s", "worst_state",
+            "worst_state_name", "pages_total", "objectives")
+#: every objective row in /slo.json ("held" = the state was carried
+#: by the min_events gate rather than computed from fresh evidence)
+SLO_OBJECTIVE_KEYS = ("source", "objective", "kind", "target",
+                      "state", "state_name", "held", "burn_rates")
+
+
+def check_payload(payload, required, where):
+    """Problems with one endpoint payload: required keys + strict
+    JSON (the shared shape rule, applied to the ISSUE 14 endpoints)."""
+    problems = []
+    if not isinstance(payload, dict):
+        return ["%s: not a JSON object (got %s)"
+                % (where, type(payload).__name__)]
+    for key in required:
+        if key not in payload:
+            problems.append("%s: missing required key %r"
+                            % (where, key))
+    try:
+        json.loads(json.dumps(payload, allow_nan=False))
+    except (TypeError, ValueError) as e:
+        problems.append("%s: not strict-JSON-serializable: %s"
+                        % (where, e))
+    return problems
+
+
+def check_timeseries_payload(payload, where="timeseries.json"):
+    """The /timeseries.json shape: top-level keys, and every series
+    row carries a known kind with that kind's windowed fields."""
+    problems = check_payload(payload, TIMESERIES_KEYS, where)
+    for name, row in (payload.get("series") or {}).items():
+        w = "%s series %r" % (where, name)
+        kind = row.get("kind")
+        if kind == "counter":
+            need = ("last", "delta", "rate_per_s", "span_s")
+        elif kind == "gauge":
+            need = ("last", "min", "max", "mean")
+        elif kind == "hist":
+            need = ("count_delta", "rate_per_s", "p50", "p95",
+                    "bounds")
+        else:
+            problems.append("%s: unknown kind %r" % (w, kind))
+            continue
+        for key in need:
+            if key not in row:
+                problems.append("%s: %s row missing %r"
+                                % (w, kind, key))
+    return problems
+
+
+def check_slo_payload(payload, where="slo.json"):
+    problems = check_payload(payload, SLO_KEYS, where)
+    for row in (payload.get("objectives") or []):
+        w = "%s objective %r" % (where, row.get("objective"))
+        for key in SLO_OBJECTIVE_KEYS:
+            if key not in row:
+                problems.append("%s: missing %r" % (w, key))
+        for b in row.get("burn_rates", []):
+            for key in ("window_s", "burn", "error_ratio", "events"):
+                if key not in b:
+                    problems.append("%s: burn row missing %r"
+                                    % (w, key))
+    return problems
+
+
+def _builtin_payload_problems():
+    """Exercise the ISSUE 14 payload shapes against LIVE producers: a
+    tiny in-process TimeSeriesStore + SLOMonitor (no jax, <1s), so a
+    schema drift in either endpoint fails tier-1 loudly."""
+    from veles_tpu.serving.metrics import ServingMetrics
+    from veles_tpu.serving.slo import SLOMonitor
+    from veles_tpu.serving.timeseries import TimeSeriesStore
+    m = ServingMetrics("schema_probe")
+    store = TimeSeriesStore(interval_s=0.05, capacity=16)
+    store.add_source(m)
+    problems = []
+    for i in range(3):
+        m.record_enqueue()
+        m.record_response(0.004 * (i + 1))
+        m.record_ttft(0.01)
+        m.record_decode_step(0.002)
+        m.set_gauge("queue_depth", i)
+        store.sample_once()
+    problems.extend(check_timeseries_payload(
+        store.snapshot(window_s=60.0),
+        "TimeSeriesStore.snapshot()"))
+    monitor = SLOMonitor(store, SLOMonitor.default_objectives(),
+                         windows_s=(5.0, 30.0), min_events=1)
+    monitor.sample_once()
+    problems.extend(check_slo_payload(monitor.snapshot(),
+                                      "SLOMonitor.snapshot()"))
+    return problems
+
 
 def check_record(record, where="record"):
     """Problems with one parsed record (empty list = conforming)."""
@@ -121,6 +220,20 @@ def _builtin_records():
     out.append(("trace_report.summary_record({})",
                 trace_report.summary_record({})[0]))
 
+    import slo_report
+    out.append(("slo_report.summary_record({})",
+                slo_report.summary_record({})[0]))
+    # the verdict-bearing shape must select the paging-objective
+    # metric (the acceptance signal downstream tooling keys on)
+    slo_rec = slo_report.summary_record(
+        {"verdicts": [{"state_name": "page"}]})[0]
+    out.append(("slo_report.summary_record(verdicts)", slo_rec))
+    if slo_rec.get("metric") != "slo_objectives_paging":
+        out.append(("slo_report.summary_record(verdicts)",
+                    {"metric": "",
+                     "note": "verdict results did not select the "
+                             "slo_objectives_paging metric"}))
+
     # profile_ops streams directly — capture its line
     import profile_ops
     buf = io.StringIO()
@@ -141,6 +254,11 @@ def check_builtin():
                 % (type(e).__name__, e)]
     for where, record in records:
         problems.extend(check_record(record, where))
+    try:
+        problems.extend(_builtin_payload_problems())
+    except Exception as e:   # noqa: BLE001 — a broken producer IS
+        problems.append("collecting builtin payloads failed: %s: %s"
+                        % (type(e).__name__, e))
     return problems
 
 

@@ -72,55 +72,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 from load_gen import lm_prompts  # noqa: E402
 
-
-#: advertised peak FLOPs by TPU device kind (bf16 matmul peak — the
-#: MFU denominator convention; fp32 serving reads lower, which only
-#: makes the reported MFU conservative).  Overridable via
-#: VELES_PEAK_FLOPS for new silicon or calibrated CPU baselines.
-TPU_PEAK_FLOPS = (
-    ("v5 lite", 197e12), ("v5e", 197e12), ("v5p", 459e12),
-    ("v4", 275e12), ("v6", 918e12),
-)
-#: nominal single-core CPU matmul ceiling — keeps the MFU column
-#: well-defined (and honestly tiny) on CPU runs; real MFU claims come
-#: from TPU sessions (docs/PERF.md)
-CPU_NOMINAL_FLOPS = 1e11
-
-
-def peak_flops_estimate():
-    """(peak_flops, source_label) for the MFU denominator: the env
-    override wins, then the TPU device-kind table, then the CPU
-    nominal.  The label travels in every record so a reader can tell a
-    calibrated number from a nominal one."""
-    import jax
-    env = os.environ.get("VELES_PEAK_FLOPS")
-    if env:
-        return float(env), "env:VELES_PEAK_FLOPS"
-    from veles_tpu.ops.pallas_kernels import on_tpu
-    if on_tpu():
-        kind = jax.devices()[0].device_kind.lower()
-        for name, peak in TPU_PEAK_FLOPS:
-            if name in kind:
-                return peak, "tpu:%s" % name
-        return 197e12, "tpu:unknown-kind-default"
-    return CPU_NOMINAL_FLOPS, "cpu:nominal"
-
-
-def decode_flops_per_token(vocab, d_model, n_layers, ctx,
-                           n_heads=4, kv_heads=None, d_ff=None):
-    """Model FLOPs one KV-cached greedy token costs (forward only):
-    the qkvo projections, FFN and head matmuls plus the two attention
-    matmuls against ``ctx`` resident rows — the numerator of the MFU
-    column (matmul FLOPs only; layernorms/softmax are noise at these
-    widths)."""
-    kv = kv_heads or n_heads
-    d_kv = d_model // n_heads * kv
-    d_ff = d_ff or 4 * d_model
-    proj = 2 * d_model * (2 * d_model + 2 * d_kv)      # wq, wo, wk, wv
-    ffn = 4 * d_model * d_ff
-    attn = 4 * ctx * d_model                           # q·K + p·V
-    head = 2 * d_model * vocab
-    return n_layers * (proj + ffn + attn) + head
+# THE FLOPs/MFU model moved to veles_tpu/serving/timeseries.py
+# (ISSUE 14): the live mfu_live gauge and the bench's per-leg MFU
+# column must read the same numerator/denominator — re-exported here
+# so every existing consumer keeps its import path
+from veles_tpu.serving.timeseries import (  # noqa: E402,F401
+    CPU_NOMINAL_FLOPS, TPU_PEAK_FLOPS, decode_flops_per_token,
+    peak_flops_estimate)
 
 
 def build_params(vocab=32, d_model=64, n_heads=4, n_layers=2,

@@ -1,0 +1,303 @@
+"""SLO report (ISSUE 14): burn-rate timelines and objective verdicts
+from a captured ``/timeseries.json`` (+ optional ``/slo.json``).
+
+Input is what the serving stack already exports — ``curl
+:PORT/timeseries.json?window=600 > ts.json`` and ``curl
+:PORT/slo.json > slo.json`` on a ``--serve-telemetry``/``--serve-slo``
+server.  Two views:
+
+- TIMELINE — per (source, objective) the error-budget burn rate
+  RECOMPUTED at every captured sample over a sliding window, rendered
+  as an ASCII strip (`` .:-=#`` scaled to the page threshold, ``!``
+  beyond it) — how the burn evolved, not just where it ended.
+  Availability/shed objectives replay the counter rings;
+  latency objectives replay the histogram rings' cumulative bucket
+  counts (the export carries them per point).
+- VERDICT — the monitor's own state per objective from ``/slo.json``
+  (ok/warn/page, burn per window, events), printed as a table.
+
+A bench.py-style summary JSON line (metric/value/unit/vs_baseline/
+configs) streams after each completed stage, last-line-wins, so the
+driver and ``tools/check_stream_records.py`` treat this tool exactly
+like every other bench.
+
+Standalone::
+
+    python tools/slo_report.py ts.json [--slo slo.json]
+        [--window S] [--json FILE]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+#: burn-intensity glyphs: index = min(burn / page_burn, 1) * (len-1);
+#: '!' marks >= page_burn
+GLYPHS = " .:-=#"
+
+
+def load_json(path):
+    with open(path, "r", encoding="utf-8") as f:
+        return json.load(f)
+
+
+def _counter_deltas(points):
+    """[(t, delta)] between consecutive cumulative points, clamped
+    at zero (engine restarts)."""
+    return [(b[0], max(0, b[1] - a[1]))
+            for a, b in zip(points, points[1:])]
+
+
+def _sources(ts):
+    """Source keys present in a captured snapshot (series names are
+    '<source>.<kind>.<field>')."""
+    out = []
+    for name in ts.get("series", {}):
+        key = name.split(".counter.")[0].split(".gauge.")[0] \
+                  .split(".hist.")[0].split(".ewma.")[0]
+        if key not in out:
+            out.append(key)
+    return out
+
+
+def _series(ts, name):
+    s = ts.get("series", {}).get(name)
+    return s.get("series", []) if s else []
+
+
+def _window_sum(deltas, t, window_s):
+    return sum(d for (td, d) in deltas if t - window_s < td <= t)
+
+
+def burn_timeline(ts, source, objective, window_s):
+    """[(t, burn)] for ``objective`` (a dict in the slo.json objective
+    shape: name/kind/target[/series/threshold_s]) over ``source``'s
+    captured rings, one point per sample, each over a trailing
+    ``window_s``."""
+    kind = objective["kind"]
+    budget = 1.0 - float(objective["target"])
+    if kind in ("availability", "shed_rate"):
+        if kind == "availability":
+            bad_names = ["%s.counter.errors" % source]
+        else:
+            bad_names = ["%s.counter.shed" % source,
+                         "%s.counter.rejected" % source]
+        ok = _counter_deltas(_series(
+            ts, "%s.counter.responses" % source))
+        bads = [_counter_deltas(_series(ts, n)) for n in bad_names]
+        times = [t for (t, _) in ok] or [
+            t for b in bads for (t, _) in b]
+        out = []
+        for t in times:
+            bad = sum(_window_sum(b, t, window_s) for b in bads)
+            good = _window_sum(ok, t, window_s)
+            total = bad + good
+            ratio = bad / total if total else 0.0
+            out.append((t, ratio / budget))
+        return out
+    # latency: replay the histogram ring's cumulative buckets
+    name = "%s.hist.%s" % (source, objective.get("series", "ttft"))
+    s = ts.get("series", {}).get(name)
+    if not s:
+        return []
+    bounds, pts = s.get("bounds", []), s.get("series", [])
+    thr = float(objective.get("threshold_s", 0.0))
+    # the LAST bound <= threshold is the 'good' cut — the same
+    # conservative rounding TimeSeriesStore.count_in_window applies
+    # (a threshold between bounds rounds DOWN; below every bound,
+    # nothing counts as good).  NB "+Inf" PARSES to float inf — the
+    # overflow bound never qualifies as a finite cut.
+    cut = None
+    for i, b in enumerate(bounds):
+        try:
+            bf = float(b)
+        except ValueError:
+            bf = float("inf")
+        if bf != float("inf") and bf <= thr:
+            cut = i
+        else:
+            break
+    deltas = []
+    for a, b in zip(pts, pts[1:]):
+        if len(a) < 4 or len(b) < 4:
+            continue
+        total = max(0, b[1] - a[1])
+        good = 0
+        if cut is not None and cut < len(a[3]) and cut < len(b[3]):
+            good = max(0, b[3][cut] - a[3][cut])
+        deltas.append((b[0], max(0, total - good), total))
+    out = []
+    for t, _, _ in deltas:
+        bad = sum(d[1] for d in deltas if t - window_s < d[0] <= t)
+        total = sum(d[2] for d in deltas if t - window_s < d[0] <= t)
+        ratio = bad / total if total else 0.0
+        out.append((t, ratio / budget))
+    return out
+
+
+def render_timeline(timeline, page_burn=2.0, width=64):
+    """One burn timeline as an ASCII strip (resampled to ``width``
+    columns; ``!`` marks samples at or past the page threshold)."""
+    if not timeline:
+        return "(no samples)"
+    n = len(timeline)
+    cols = []
+    for c in range(min(width, n)):
+        lo = c * n // min(width, n)
+        hi = max(lo + 1, (c + 1) * n // min(width, n))
+        burn = max(b for (_, b) in timeline[lo:hi])
+        if burn >= page_burn:
+            cols.append("!")
+        else:
+            frac = min(1.0, burn / page_burn if page_burn else 0.0)
+            cols.append(GLYPHS[int(frac * (len(GLYPHS) - 1))])
+    peak = max(b for (_, b) in timeline)
+    return "[%s] peak %.2fx over %.1fs" % (
+        "".join(cols), peak, timeline[-1][0] - timeline[0][0])
+
+
+def default_objectives():
+    """The stock objective dicts (mirrors
+    ``SLOMonitor.default_objectives`` without importing jax-adjacent
+    serving modules at tool load)."""
+    return [
+        {"name": "availability", "kind": "availability",
+         "target": 0.999},
+        {"name": "ttft", "kind": "latency", "series": "ttft",
+         "threshold_s": 1.0, "target": 0.95},
+        {"name": "decode_step", "kind": "latency",
+         "series": "decode_step", "threshold_s": 0.25,
+         "target": 0.99},
+        {"name": "shed", "kind": "shed_rate", "target": 0.99},
+    ]
+
+
+def summary_record(results):
+    """(record, exit_code) in the bench.py shape — one selection rule:
+    paging-objective count once verdicts exist, series count while
+    only the timeseries parsed."""
+    verdicts = results.get("verdicts")
+    if verdicts is not None:
+        paging = sum(1 for v in verdicts if v.get("state_name")
+                     == "page")
+        return {
+            "metric": "slo_objectives_paging",
+            "value": paging,
+            "unit": "objectives",
+            "vs_baseline": 0,
+            "configs": results,
+        }, 0
+    if results.get("series") is not None:
+        return {
+            "metric": "timeseries_series_parsed",
+            "value": results["series"],
+            "unit": "series",
+            "vs_baseline": None,
+            "configs": results,
+        }, 0
+    return {"metric": "slo_report_empty", "value": None,
+            "unit": None, "vs_baseline": None, "configs": results}, 1
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("timeseries", help="captured "
+                        "/timeseries.json payload")
+    parser.add_argument("--slo", default=None, metavar="FILE",
+                        help="captured /slo.json payload: adds the "
+                             "monitor's own verdicts and uses its "
+                             "objectives/windows for the timelines")
+    parser.add_argument("--window", type=float, default=None,
+                        metavar="S",
+                        help="burn-rate window for the timelines "
+                             "(default: the slo.json short window, "
+                             "else 60)")
+    parser.add_argument("--json", default=None, metavar="FILE",
+                        help="also write the final summary record "
+                             "here")
+    args = parser.parse_args(argv)
+
+    ts = load_json(args.timeseries)
+    results = {"timeseries": args.timeseries,
+               "sampled_at": ts.get("sampled_at"),
+               "series": len(ts.get("series", {})),
+               "samples": ts.get("samples")}
+    print(json.dumps(summary_record(results)[0]), flush=True)
+
+    slo = load_json(args.slo) if args.slo else None
+    page_burn = (slo or {}).get("page_burn", 2.0)
+    objectives = default_objectives()
+    if slo and slo.get("objectives"):
+        seen, objectives = set(), []
+        for row in slo["objectives"]:
+            if row["objective"] in seen:
+                continue
+            seen.add(row["objective"])
+            obj = {"name": row["objective"], "kind": row["kind"],
+                   "target": row["target"]}
+            if "threshold_s" in row:
+                obj["threshold_s"] = row["threshold_s"]
+                # the monitor round-trips the series name; fall back
+                # to a name match only for older captures
+                obj["series"] = row.get(
+                    "series",
+                    row["objective"] if row["objective"] in
+                    ("ttft", "decode_step") else "ttft")
+            objectives.append(obj)
+    window_s = args.window or (slo or {}).get(
+        "windows_s", [60.0])[0]
+
+    # ---- burn timelines, one strip per (source, objective)
+    timelines = 0
+    for source in _sources(ts):
+        for obj in objectives:
+            tl = burn_timeline(ts, source, obj, window_s)
+            if not tl:
+                continue
+            timelines += 1
+            print("%-24s %-14s %s"
+                  % (source, obj["name"],
+                     render_timeline(tl, page_burn)),
+                  file=sys.stderr)
+    results["timelines"] = timelines
+    results["window_s"] = window_s
+
+    # ---- verdicts from the monitor's own snapshot
+    if slo is not None:
+        verdicts = []
+        print("\n%-6s %-24s %-14s %-8s %s"
+              % ("STATE", "source", "objective", "target", "burns"),
+              file=sys.stderr)
+        for row in slo.get("objectives", []):
+            burns = " ".join(
+                "%gs=%.2fx" % (b["window_s"], b["burn"])
+                for b in row.get("burn_rates", []))
+            print("%-6s %-24s %-14s %-8g %s"
+                  % (row["state_name"].upper(), row["source"],
+                     row["objective"], row["target"], burns),
+                  file=sys.stderr)
+            verdicts.append({"source": row["source"],
+                             "objective": row["objective"],
+                             "state_name": row["state_name"],
+                             "burn_rates": row.get("burn_rates", [])})
+        results["verdicts"] = verdicts
+        results["worst_state"] = slo.get("worst_state_name")
+
+    record, rc = summary_record(results)
+    line = json.dumps(record)
+    print(line)                  # final full record — last line wins
+    if args.json:
+        with open(args.json, "w", encoding="utf-8") as f:
+            f.write(line + "\n")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

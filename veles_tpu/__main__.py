@@ -349,6 +349,33 @@ def build_argparser():
                         metavar="N",
                         help="with --serve-trace: flight-recorder "
                              "ring size in requests (default 256)")
+    parser.add_argument("--serve-telemetry", type=float, default=0.0,
+                        nargs="?", const=1.0, metavar="SECONDS",
+                        help="with --serve-slots: continuous "
+                             "telemetry (veles_tpu/serving/"
+                             "timeseries.py) — sample every serving "
+                             "metrics family into bounded time-series "
+                             "rings every SECONDS (bare flag = 1s): "
+                             "counters as windowed rates, gauges, "
+                             "histogram-delta p50/p95, plus runtime "
+                             "gauges (live jit compile_programs, "
+                             "process RSS, device memory, live MFU, "
+                             "megastep waste fraction).  Served at "
+                             "GET /timeseries.json?window=S; the "
+                             "serving hot path has zero telemetry "
+                             "sites (default: off)")
+    parser.add_argument("--serve-slo", default=None, metavar="FILE",
+                        help="with --serve-slots: declarative SLO "
+                             "objectives (veles_tpu/serving/slo.py) "
+                             "from a JSON file ('default' = the stock "
+                             "availability/TTFT/decode-step/shed set) "
+                             "— evaluated as multi-window error-"
+                             "budget burn rates over the telemetry "
+                             "store (implied on at 1s), ok/warn/page "
+                             "state machine at GET /slo.json; with "
+                             "--serve-health a page-level burn on one "
+                             "replica feeds the health checker's "
+                             "quarantine path")
     parser.add_argument("--serve-no-auto-rollback",
                         action="store_true",
                         help="with --serve-model-dir: do NOT roll a "
@@ -578,6 +605,9 @@ def main(argv=None):
                            canary_watch_s=args.serve_canary_watch,
                            trace=args.serve_trace,
                            trace_last=args.serve_trace_last,
+                           telemetry=args.serve_telemetry,
+                           slo=(True if args.serve_slo == "default"
+                                else args.serve_slo),
                            auto_rollback=(
                                not args.serve_no_auto_rollback))
         else:

@@ -50,8 +50,15 @@ from veles_tpu.logger import Logger
 class RESTfulAPI(Logger):
     def __init__(self, workflow, normalizer=None, forward=None,
                  handler=None, metrics=None, max_body=16 << 20,
-                 faults=None, tracer=None):
+                 faults=None, tracer=None, telemetry=None, slo=None):
         self.workflow = workflow
+        #: optional TimeSeriesStore (ISSUE 14): continuous telemetry
+        #: over the serving metrics — ``GET /timeseries.json?window=S``
+        #: (owned by serve_lm; stopped with the server)
+        self.telemetry = telemetry
+        #: optional SLOMonitor (ISSUE 14): burn-rate objectives over
+        #: the store — ``GET /slo.json``
+        self.slo = slo
         #: optional serving FaultPlan (ISSUE 10): the ``http.request``
         #: site fires per POST — transient InjectedHTTPError replies
         #: (the retryable-infrastructure-blip shape) and latency
@@ -200,6 +207,55 @@ class RESTfulAPI(Logger):
                 path = split.path.rstrip("/")
                 if path == "/metrics.json" and api.metrics is not None:
                     self._reply(200, api.metrics.snapshot())
+                elif path == "/timeseries.json" \
+                        and api.telemetry is not None:
+                    # continuous telemetry (ISSUE 14): every metrics
+                    # family's windowed rates/gauges/percentiles plus
+                    # raw ring points — ?window=S trims the window
+                    query = urllib.parse.parse_qs(split.query)
+                    window = 60.0
+                    try:
+                        if query.get("window"):
+                            window = float(query["window"][0])
+                            # not (window > 0) also catches NaN —
+                            # 'nan <= 0' is False, and a NaN window
+                            # would serialize as a non-strict literal
+                            if not (window > 0) \
+                                    or window == float("inf"):
+                                raise ValueError
+                    except ValueError:
+                        self._reply(400, {"error": "window must be a "
+                                          "positive number of "
+                                          "seconds"})
+                        return
+                    self._reply(200, api.telemetry.snapshot(
+                        window_s=window))
+                elif path == "/slo.json" and api.slo is not None:
+                    # burn-rate objectives (ISSUE 14)
+                    self._reply(200, api.slo.snapshot())
+                elif path == "/ledger.json" and api.tracer is not None:
+                    # the LIVE per-op cost ledger (ISSUE 14): the same
+                    # dedup-by-dispatch-id rows tools/trace_report.py
+                    # aggregates, maintained incrementally in-process
+                    from veles_tpu.serving.metrics import \
+                        monotonic_offset
+                    rows = api.tracer.live_ledger()
+                    self._reply(200, {
+                        "sampled_at": round(monotonic_offset(), 6),
+                        "dispatches_total": sum(r["dispatches"]
+                                                for r in rows),
+                        "rows": rows})
+                elif path == "/status":
+                    # the human panel (ISSUE 14): plain text, curl-able
+                    body = render_status(
+                        metrics=api.metrics, telemetry=api.telemetry,
+                        slo=api.slo, tracer=api.tracer).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
                 elif path == "/trace.json" and api.tracer is not None:
                     # the flight recorder as Chrome-trace/Perfetto JSON
                     # (ISSUE 12): ?last=N trims to the newest N
@@ -369,6 +425,10 @@ class RESTfulAPI(Logger):
             self._server.shutdown()
             self._server.server_close()
             self._server = None
+        if self.telemetry is not None:
+            # the sampler reads engine metrics: stop it before the
+            # engines so a mid-shutdown tick never races a teardown
+            self.telemetry.stop()
         if self.batcher is not None:
             self.batcher.stop()
         if self.model_manager is not None:
@@ -384,6 +444,85 @@ class RESTfulAPI(Logger):
             self.lm_engine.stop()
 
 
+def render_status(metrics=None, telemetry=None, slo=None, tracer=None,
+                  window_s=60.0):
+    """The ``GET /status`` text panel (ISSUE 14): the operator's
+    one-glance view — live gauges, windowed rates and tail latency
+    from the telemetry store, every SLO objective's state and burn,
+    and the top live-ledger rows.  Plain text by design: readable in
+    a terminal over curl, no client tooling required."""
+    from veles_tpu.serving.metrics import monotonic_offset
+    lines = ["veles_tpu serving status",
+             "sampled_at %.3fs (monotonic offset)"
+             % monotonic_offset(), ""]
+    if metrics is not None:
+        snap = metrics.snapshot()
+        lines.append("[engine %s]" % snap["name"])
+        lines.append(
+            "  requests %d  responses %d  errors %d  429 %d  shed %d"
+            % (snap["requests"], snap["responses"], snap["errors"],
+               snap["rejected"], snap["shed"]))
+        g = snap["gauges"]
+        lines.append(
+            "  queue_depth %g  slots %g/%g  kv_pages_free %g/%g  "
+            "compile_programs %g"
+            % (g.get("queue_depth", 0), g.get("slots_busy", 0),
+               g.get("slots_total", 0), g.get("kv_pages_free", 0),
+               g.get("kv_pages_total", 0),
+               g.get("compile_programs", 0)))
+        lines.append(
+            "  ewma ttft %.4fs  decode_step %.4fs  mfu_live %s"
+            % (snap["ewma"].get("ttft", 0.0),
+               snap["ewma"].get("decode_step", 0.0),
+               g.get("mfu_live", "-")))
+        lines.append("")
+    if telemetry is not None:
+        lines.append("[telemetry — last %gs of %d samples @ %gs]"
+                     % (window_s, telemetry.samples,
+                        telemetry.interval_s))
+        for key in telemetry.sources():
+            rq = telemetry.window("%s.counter.responses" % key,
+                                  window_s)
+            er = telemetry.window("%s.counter.errors" % key, window_s)
+            tt = telemetry.window("%s.hist.ttft" % key, window_s)
+            ds = telemetry.window("%s.hist.decode_step" % key,
+                                  window_s)
+            lines.append(
+                "  %-24s %7.2f resp/s  %5.2f err/s  "
+                "ttft p95 %ss  step p95 %ss"
+                % (key,
+                   rq["rate_per_s"] if rq else 0.0,
+                   er["rate_per_s"] if er else 0.0,
+                   tt["p95"] if tt else "-",
+                   ds["p95"] if ds else "-"))
+        lines.append("")
+    if slo is not None:
+        snap = slo.snapshot()
+        lines.append("[slo — worst state: %s, %d page(s) total]"
+                     % (snap["worst_state_name"],
+                        snap["pages_total"]))
+        for row in snap["objectives"]:
+            burns = " ".join("%gs=%.2fx" % (b["window_s"], b["burn"])
+                             for b in row["burn_rates"])
+            lines.append("  %-5s %-24s %-12s target %g  burn %s"
+                         % (row["state_name"].upper(), row["source"],
+                            row["objective"], row["target"], burns))
+        lines.append("")
+    if tracer is not None:
+        rows = tracer.live_ledger()
+        lines.append("[cost ledger — %d dispatch(es), top rows]"
+                     % sum(r["dispatches"] for r in rows))
+        for r in rows[:8]:
+            lines.append(
+                "  %-18s bucket %-6s %-8s n=%-7d p50 %8.3fms  "
+                "p95 %8.3fms  total %10.1fms"
+                % (r["op"], r["bucket"], r["backend"],
+                   r["dispatches"], r["p50_ms"], r["p95_ms"],
+                   r["total_ms"]))
+        lines.append("")
+    return "\n".join(lines) + "\n"
+
+
 def serve_lm(workflow, host="127.0.0.1", port=8180, max_new=256,
              slots=0, queue_depth=64, deadline_s=30.0,
              prefix_cache=0, prefill_chunk=0, spec_k=0,
@@ -392,7 +531,8 @@ def serve_lm(workflow, host="127.0.0.1", port=8180, max_new=256,
              health=False, health_interval_s=1.0, hedge=0.0,
              retries=0, fault_plan=None, model_dir=None,
              publish_interval_s=5.0, canary=1, canary_watch_s=2.0,
-             auto_rollback=True, trace=None, trace_last=256):
+             auto_rollback=True, trace=None, trace_last=256,
+             telemetry=0.0, slo=None):
     """Serve a trained transformer-trainer workflow (e.g. char_lm) for
     autoregressive continuation: POST ``{"input": [[tok, ...]],
     "n_new": N, "temperature": T, "top_k": K, "seed": S}`` to
@@ -499,6 +639,33 @@ def serve_lm(workflow, host="127.0.0.1", port=8180, max_new=256,
     ``X-Request-Id`` header or generated server-side, whether or not
     tracing is armed.
 
+    CONTINUOUS TELEMETRY + SLOs (ISSUE 14, engine path only):
+    ``telemetry=S`` starts a
+    :class:`veles_tpu.serving.TimeSeriesStore` sampling every engine
+    (and router) metrics family into bounded rings every ``S``
+    seconds (``True`` = 1 s) — counters become windowed rates, gauges
+    keep min/max/mean, histogram deltas resolve windowed p50/p95 —
+    plus per-engine runtime gauges (live jit ``compile_programs`` +
+    ``compiles_total``, process RSS, device memory where reported,
+    ``mfu_live`` from the lm_bench FLOPs model, megastep waste
+    fraction), served at ``GET /timeseries.json?window=S``.
+    ``slo=`` (a JSON objective file path, a parsed spec dict, or
+    ``True`` for the stock objectives) arms a
+    :class:`veles_tpu.serving.SLOMonitor` riding the store's tick:
+    multi-window error-budget burn rates per objective per replica,
+    ok→warn→page state machine at ``GET /slo.json``, and — when
+    ``health=True`` — a page-level burn on ONE replica feeds the
+    HealthChecker (``note_slo_page``) toward the same quarantine path
+    a failed probe takes.  ``slo`` implies ``telemetry`` (default
+    1 s).  A traced server additionally serves the LIVE per-op cost
+    ledger at ``GET /ledger.json`` (same dedup rules as
+    ``tools/trace_report.py``, no export round trip), and every
+    server serves the human-readable ``GET /status`` text panel.
+    The hot path has zero telemetry sites: the store samples on its
+    own thread (the pull model) — overhead is bounded by the chaos
+    bench's ``fault_free_overhead`` leg (<1%% of a decode step
+    together with the incremental ledger).
+
     The direct path decodes one prompt batch at a time via the
     KV-cached ``transformer.generate``, one jitted dispatch per
     request.  Compile count and per-request cost are both BOUNDED
@@ -574,6 +741,8 @@ def serve_lm(workflow, host="127.0.0.1", port=8180, max_new=256,
                 metrics=metrics_mod.new("lm", labels=label),
                 faults=fault_plan, tracer=tracer)
 
+        if slo and not telemetry:
+            telemetry = 1.0         # objectives need the store
         if n_rep > 1 or resilient:
             routed = True
             engine = Router(
@@ -596,6 +765,35 @@ def serve_lm(workflow, host="127.0.0.1", port=8180, max_new=256,
                     auto_rollback=bool(auto_rollback)).start()
         else:
             engine = build_engine().start()
+
+    store = None
+    monitor = None
+    if engine is not None and telemetry:
+        from veles_tpu.serving import timeseries as ts_mod
+        from veles_tpu.serving.metrics import _registry_key
+        interval = 1.0 if telemetry is True else float(telemetry)
+        store = ts_mod.telemetry_for(engine, interval_s=interval)
+        if slo:
+            from veles_tpu.serving.slo import SLOMonitor
+            replica_engines = getattr(engine, "replicas", [engine])
+            source_replicas = {
+                _registry_key(e.metrics): i
+                for i, e in enumerate(replica_engines)}
+            # SLO gauges/counters land in the router's (or the solo
+            # engine's) own family, so /metrics carries slo_state too
+            kw = dict(checker=checker,
+                      source_replicas=source_replicas,
+                      metrics=engine.metrics)
+            if slo is True:
+                monitor = SLOMonitor(
+                    store, SLOMonitor.default_objectives(), **kw)
+            else:
+                monitor = SLOMonitor.from_spec(slo, store, **kw)
+            # the monitor rides the store's tick: one evaluation per
+            # sampling window, deterministic under sample_once()
+            store.add_listener(monitor.sample_once)
+        ts_mod.set_default(store)
+        store.start()
 
     def handler(request):
         prompt = numpy.asarray(request["input"], numpy.int32)
@@ -660,7 +858,8 @@ def serve_lm(workflow, host="127.0.0.1", port=8180, max_new=256,
 
     api = RESTfulAPI(None, handler=handler,
                      metrics=engine.metrics if engine is not None
-                     else None, faults=fault_plan, tracer=tracer)
+                     else None, faults=fault_plan, tracer=tracer,
+                     telemetry=store, slo=monitor)
     api.lm_engine = engine
     api.health_checker = checker
     api.model_manager = manager

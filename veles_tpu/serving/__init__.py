@@ -77,6 +77,27 @@ request, this package amortizes dispatch across concurrent clients.
   lock-cheap counters/histograms (queue wait, batch size, latency
   percentiles, shed/429, slot occupancy) with a snapshot API and a
   Prometheus renderer (served by ``web_status.py`` at ``/metrics``).
+- :mod:`veles_tpu.serving.timeseries` — :class:`TimeSeriesStore`
+  (ISSUE 14): continuous telemetry — every metrics family sampled on
+  a background cadence into bounded rings (counters → windowed rates,
+  gauges → min/max/mean, histogram deltas → windowed p50/p95), plus
+  runtime/device gauges (live jit ``compile_programs``, process RSS,
+  ``jax`` device memory, live MFU from the lm_bench FLOPs model,
+  megastep waste fraction) written by :func:`runtime_probe` each
+  tick.  ``GET /timeseries.json?window=S``; the serving hot path has
+  zero telemetry sites (pull model).  The tracer additionally keeps
+  the per-op cost ledger INCREMENTALLY (``SpanTracer.live_ledger``,
+  ``GET /ledger.json``) — same dedup-by-dispatch-id rows as
+  ``tools/trace_report.py``, no export round-trip.
+- :mod:`veles_tpu.serving.slo` — :class:`SLOMonitor` (ISSUE 14):
+  declarative objectives (availability, TTFT/decode-step latency,
+  shed rate) evaluated as multi-window error-budget BURN RATES over
+  the store, ok→warn→page state machine per (source, objective)
+  (``slo_state`` gauges, ``slo_pages_total``), ``GET /slo.json``, and
+  a router hook: a page-level burn on one replica feeds the PR 10
+  :class:`HealthChecker` (``note_slo_page``) as a first-class health
+  signal.  ``serve_lm(telemetry=, slo=)``, CLI ``--serve-telemetry``
+  / ``--serve-slo FILE``; human panel at ``GET /status``.
 
 The engines are OPTIONAL: ``restful_api.py`` keeps the direct
 one-dispatch-per-request path for single-user/debug use and routes
@@ -100,6 +121,12 @@ from veles_tpu.serving.model_manager import (ModelManager,
 from veles_tpu.serving.router import (HealthChecker, NoLiveReplicas,
                                       Router, RouterMetrics,
                                       replica_device_slices)
+from veles_tpu.serving.slo import Objective, SLOMonitor
+from veles_tpu.serving.timeseries import (TimeSeriesStore,
+                                          decode_flops_per_token,
+                                          peak_flops_estimate,
+                                          runtime_probe,
+                                          telemetry_for)
 from veles_tpu.serving.tracing import (SpanTracer, TraceContext,
                                        cost_ledger, format_waterfall,
                                        verify_integrity)
@@ -107,6 +134,9 @@ from veles_tpu.serving.tracing import (SpanTracer, TraceContext,
 __all__ = ["MicroBatcher", "LMEngine", "RadixPrefixCache",
            "SpanTracer", "TraceContext", "cost_ledger",
            "format_waterfall", "verify_integrity",
+           "TimeSeriesStore", "SLOMonitor", "Objective",
+           "telemetry_for", "runtime_probe",
+           "decode_flops_per_token", "peak_flops_estimate",
            "KVPagePool", "Router", "RouterMetrics", "HealthChecker",
            "ModelManager", "ServingMetrics", "FaultPlan",
            "InjectedFault",

@@ -21,10 +21,24 @@ from __future__ import annotations
 import bisect
 import collections
 import threading
+import time
 
 #: default histogram bucket bounds (seconds) for queue-wait / latency
 TIME_BOUNDS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
                0.5, 1.0, 2.5, 5.0, 10.0)
+
+#: ONE monotonic origin per process: every snapshot (and every
+#: telemetry/SLO/ledger endpoint, ISSUE 14) stamps ``sampled_at`` as
+#: seconds since this instant, so two scrapes of ANY endpoint share a
+#: join key and rate math over them is arithmetic, not guesswork
+_ORIGIN = time.monotonic()
+
+
+def monotonic_offset():
+    """Seconds since the process's metrics origin — the ``sampled_at``
+    stamp every observability endpoint shares (monotonic: immune to
+    wall-clock steps; comparable only within one process)."""
+    return time.monotonic() - _ORIGIN
 #: default bucket bounds for dispatch batch sizes (powers of two)
 SIZE_BOUNDS = (1, 2, 4, 8, 16, 32, 64, 128, 256)
 
@@ -296,6 +310,7 @@ class ServingMetrics:
                            self._labeled_gauges.items()})
             return {
                 "name": self.name,
+                "sampled_at": round(monotonic_offset(), 6),
                 "labels": dict(self.labels),
                 "ewma": dict(self.ewmas),
                 "requests": self.requests,

@@ -1281,6 +1281,13 @@ class HealthChecker(Logger):
         self._reopen_at = [0.0] * n
         self._last_progress = [now] * n
         self._last_counts = [None] * n
+        #: consecutive SLO page signals per replica (ISSUE 14) — kept
+        #: SEPARATE from the probe loop's _fails: a slow-but-responsive
+        #: replica keeps answering synthetic probes (which reset
+        #: _fails), so page signals must accumulate on their own
+        #: counter; the SLO monitor clears it via note_slo_ok when the
+        #: burn stops
+        self._slo_fails = [0] * n
         self._warmed = False
         self._stop = threading.Event()
         self._thread = None
@@ -1396,6 +1403,46 @@ class HealthChecker(Logger):
             else:
                 self._fails[i] = 0
 
+    def note_slo_page(self, i, reason="slo page", now=None):
+        """An EXTERNAL page-level signal against replica ``i`` — the
+        ISSUE 14 hook: the SLO monitor reports a replica whose error
+        budget is burning at page rate; ``fail_threshold`` consecutive
+        paging scans open the circuit through the same quarantine/
+        cooldown/half-open path a failed probe takes (exactly-once
+        drain semantics preserved, the half-open probe re-admits a
+        recovered replica).  Counted on a DEDICATED counter: a
+        slow-but-responsive replica still answers the checker's
+        synthetic probes, and those successes must not reset the page
+        streak (``note_slo_ok`` does, when the burn actually stops).
+        Ignored for a replica already OPEN/HALF_OPEN or
+        operator-drained (the checker never fights a manual
+        drain)."""
+        now = time.monotonic() if now is None else now
+        if not 0 <= i < len(self.router.replicas):
+            raise ValueError("no replica %r" % (i,))
+        if self._state[i] != self.HEALTHY:
+            return
+        with self.router._lock:
+            router_live = self.router._live[i]
+        if not router_live:
+            return
+        self.metrics.inc("slo_page_signals")
+        self._slo_fails[i] += 1
+        self.warning("replica %d: external SLO page signal (%s) — "
+                     "%d/%d toward quarantine", i, reason,
+                     self._slo_fails[i], self.fail_threshold)
+        if self._slo_fails[i] >= self.fail_threshold:
+            self._slo_fails[i] = 0
+            self._quarantine(i, now)
+
+    def note_slo_ok(self, i):
+        """Clear replica ``i``'s SLO page streak — the monitor calls
+        this for every mapped source NOT paging on a scan, so two
+        pages separated by a healthy stretch never sum to a
+        quarantine."""
+        if 0 <= i < len(self._slo_fails):
+            self._slo_fails[i] = 0
+
     def _probe(self, engine):
         """Synthetic 1-token decode against ``engine`` — bounded, and
         withdrawn on timeout so probes never pile up in a wedged
@@ -1437,6 +1484,7 @@ class HealthChecker(Logger):
             self._set_state(i, self.HEALTHY)
             self._cooldown[i] = self.cooldown_s
             self._fails[i] = 0
+            self._slo_fails[i] = 0
             self._last_counts[i] = None
             self._last_progress[i] = now
             self.info("replica %d passed the half-open probe: "

@@ -200,6 +200,16 @@ class SpanTracer(Logger):
         #: engine-scope spans with no request (weight-swap applies,
         #: router drains/deploys) — exported on their own track
         self._events = collections.deque(maxlen=512)
+        #: the LIVE per-op cost ledger (ISSUE 14): maintained
+        #: incrementally as device spans are recorded — same rows, same
+        #: dedup-by-dispatch-id rule as :func:`cost_ledger` over the
+        #: ring (asserted equal on the same trace), but O(1) to serve
+        #: (``GET /ledger.json``) and unbounded in TIME: it survives
+        #: ring eviction and errors-mode discards.  Memory stays
+        #: bounded: exact dispatch/lane counts, quantiles over the
+        #: newest ``ledger_durs`` dispatch durations per row.
+        self.ledger_durs = 2048
+        self._ledger_live = {}           # key -> {durs, lanes, n}
         self.started = 0
         self.finished = 0
         self.sampled_out = 0
@@ -337,6 +347,7 @@ class SpanTracer(Logger):
         still counts once.  Returns the did (None when nothing
         recorded)."""
         did = None
+        recorded = 0
         t0 -= self._origin
         t1 -= self._origin
         with self._lock:
@@ -359,11 +370,50 @@ class SpanTracer(Logger):
                 rec["spans"][self._sid] = _Span(
                     self._sid, ctx.parent, name, cat, t0, t1,
                     span_attrs)
+                recorded += 1
+            if recorded:
+                self._ledger_note(name, attrs, t0, t1, recorded)
         return did
 
     def add(self, ctx, name, cat, t0, t1, attrs=None):
         """One completed span on one request (unbatched dispatches)."""
         return self.add_many((ctx,), name, cat, t0, t1, attrs)
+
+    def _ledger_note(self, name, attrs, t0, t1, lanes):
+        """Fold one recorded dispatch into the live cost ledger
+        (tracer lock held).  Mirrors :func:`cost_ledger` exactly: only
+        device spans (a ``backend`` attr) count, one duration per
+        dispatch id (this call), ``lanes`` per recorded span copy.
+        Cost: one dict lookup + a deque append — measured and bounded
+        (with the telemetry sampler) by the chaos overhead leg."""
+        backend = (attrs or {}).get("backend") if attrs else None
+        if backend is None:
+            return
+        key = (name, str((attrs or {}).get("bucket", "-")),
+               str(backend))
+        row = self._ledger_live.get(key)
+        if row is None:
+            row = self._ledger_live[key] = {
+                "durs": collections.deque(maxlen=self.ledger_durs),
+                "lanes": 0, "dispatches": 0}
+        row["durs"].append(max(0.0, t1 - t0) * 1e3)
+        row["lanes"] += lanes
+        row["dispatches"] += 1
+
+    def live_ledger(self):
+        """The incrementally-maintained per-op cost ledger — the same
+        row shape (and, while nothing has aged past the ring or the
+        per-row duration window, the same values) as
+        :func:`cost_ledger` over this tracer's records, served without
+        touching the flight recorder.  ``dispatches``/``lanes`` are
+        exact lifetime counts; p50/p95/mean/total cover the newest
+        ``ledger_durs`` dispatches per row."""
+        with self._lock:
+            table = {key: {"durs": list(row["durs"]),
+                           "lanes": row["lanes"],
+                           "dispatches": row["dispatches"]}
+                     for key, row in self._ledger_live.items()}
+        return _ledger_rows(table)
 
     def event(self, name, cat="engine", t0=None, t1=None, attrs=None):
         """An ENGINE-scope span with no owning request (weight-swap
@@ -624,12 +674,22 @@ def cost_ledger(records):
                 seen.add((key, did))
             row["durs"].append(
                 max(0.0, (sp["t1"] or sp["t0"]) - sp["t0"]) * 1e3)
+    return _ledger_rows(table)
+
+
+def _ledger_rows(table):
+    """``{(op, bucket, backend): {durs, lanes[, dispatches]}}`` into
+    the sorted ledger-row list — ONE builder for :func:`cost_ledger`
+    (record aggregation) and :meth:`SpanTracer.live_ledger` (the
+    ISSUE 14 incremental ledger), so the two cannot drift in shape or
+    rounding."""
     rows = []
     for (op, bucket, backend), row in table.items():
         durs = sorted(row["durs"])
         rows.append({
             "op": op, "bucket": bucket, "backend": backend,
-            "dispatches": len(durs), "lanes": row["lanes"],
+            "dispatches": row.get("dispatches", len(durs)),
+            "lanes": row["lanes"],
             "p50_ms": round(_pct(durs, 0.50), 4),
             "p95_ms": round(_pct(durs, 0.95), 4),
             "mean_ms": round(sum(durs) / len(durs), 4) if durs else 0.0,

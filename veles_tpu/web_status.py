@@ -231,6 +231,33 @@ class WebStatus:
                     # gauges — dashboards and Prometheus share a source
                     body = status.render_metrics().encode()
                     ctype = "text/plain; version=0.0.4"
+                elif self.path.split("?")[0].rstrip("/") \
+                        == "/timeseries.json":
+                    # continuous telemetry (ISSUE 14): the process's
+                    # default TimeSeriesStore, when a serving stack
+                    # published one — dashboard and serving port then
+                    # expose the same rings, with the same ?window=S
+                    # contract (bad values fall back to the default:
+                    # the dashboard is best-effort, not an API)
+                    import urllib.parse
+                    from veles_tpu.serving import timeseries
+                    store = timeseries.get_default()
+                    if store is None:
+                        self.send_error(404)
+                        return
+                    query = urllib.parse.parse_qs(
+                        urllib.parse.urlsplit(self.path).query)
+                    window = 60.0
+                    try:
+                        if query.get("window"):
+                            w = float(query["window"][0])
+                            if w > 0 and w != float("inf"):
+                                window = w
+                    except ValueError:
+                        pass
+                    body = json.dumps(
+                        store.snapshot(window_s=window)).encode()
+                    ctype = "application/json"
                 elif self.path.startswith("/graph/"):
                     target = self.path[len("/graph/"):]
                     base, _, ext = target.rpartition(".")
