@@ -122,3 +122,36 @@ def _fresh_prng():
     prng.seed_all(1)
     yield
     prng.reset()
+
+
+#: suites the lock-order witness (ISSUE 15) is armed around: the
+#: concurrency-heavy serving tests.  Everything else keeps the
+#: unarmed one-None-check shims; tests/test_lint.py manages its own
+#: witness (it asserts deliberate violations ARE caught).
+_WITNESSED_SUITES = frozenset((
+    "test_serving", "test_kv_pool", "test_tracing", "test_timeseries",
+))
+
+
+@pytest.fixture(autouse=True)
+def _lock_order_witness(request):
+    """Arm the serving lock-order witness for the serving suites: a
+    fresh witness per test, disarmed at teardown, and any recorded
+    violation — an acquisition-order cycle or a lock held across a
+    device dispatch — fails the test loudly with both stacks."""
+    module = getattr(request.node, "module", None)
+    name = getattr(module, "__name__", "")
+    if name.rsplit(".", 1)[-1] not in _WITNESSED_SUITES:
+        yield
+        return
+    from veles_tpu.serving import lockcheck
+    witness = lockcheck.LockOrderWitness(name="conftest:%s" % name)
+    lockcheck.arm(witness)
+    try:
+        yield
+    finally:
+        lockcheck.disarm()
+    assert not witness.violations, (
+        "lock-order witness recorded %d violation(s) during %s:\n\n%s"
+        % (len(witness.violations), request.node.nodeid,
+           "\n\n".join(witness.violations)))

@@ -1,0 +1,23 @@
+"""Lint fixture: a broken caller-holds chain — the helper declares
+its caller holds the lock, and one caller does not."""
+
+import threading
+
+
+class Pool:
+    _guarded_by = {"_slots": "_lock"}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._slots = []
+
+    def _push(self, x):
+        # caller-holds: _lock
+        self._slots.append(x)
+
+    def put_locked(self, x):
+        with self._lock:
+            self._push(x)
+
+    def put_unlocked(self, x):
+        self._push(x)              # EXPECT-LINT lock-discipline

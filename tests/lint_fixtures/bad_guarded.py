@@ -1,0 +1,21 @@
+"""Lint fixture: unlocked reads/writes of guarded attributes —
+``# EXPECT-LINT <check>`` marks each line the pass must flag."""
+
+import threading
+
+
+class Queue:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []   # guarded-by: _lock
+        self._depth = 0    # guarded-by: _lock
+
+    def push(self, x):
+        with self._lock:
+            self._items.append(x)
+            self._depth += 1
+
+    def steal(self):
+        item = self._items.pop()   # EXPECT-LINT lock-discipline
+        self._depth -= 1           # EXPECT-LINT lock-discipline
+        return item

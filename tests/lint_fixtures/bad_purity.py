@@ -1,0 +1,30 @@
+"""Lint fixture: host-side impurity inside traced/scanned bodies —
+each flagged construct would be baked in as a trace-time constant (or
+a silent host mutation) on a real TPU compile."""
+
+import time
+
+import jax
+import numpy as np
+from jax import lax
+
+TRACE_LOG = []
+
+
+def scan_body(carry, x):
+    t = time.time()                # EXPECT-LINT traced-purity
+    noise = np.random.rand()       # EXPECT-LINT traced-purity
+    print("step", t)               # EXPECT-LINT traced-purity
+    TRACE_LOG.append(x)            # EXPECT-LINT traced-purity
+    return carry + x + noise, x
+
+
+def run(xs):
+    return lax.scan(scan_body, 0.0, xs)
+
+
+def clean_fn(x):
+    return x * 2
+
+
+fast = jax.jit(clean_fn)

@@ -1,0 +1,16 @@
+"""Lint fixture: a suppression with NO reason string — itself a
+finding, and the access it failed to suppress is flagged too."""
+
+import threading
+
+
+class Sloppy:
+    _guarded_by = {"_x": "_lock"}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._x = 0
+
+    def bump(self):
+        # lint: allow(lock-discipline):
+        self._x += 1

@@ -1,0 +1,42 @@
+"""Lint fixture: a module the ISSUE 15 passes must find NOTHING in —
+correct lock discipline, a pure scanned body, no suppressions."""
+
+import threading
+
+from jax import lax
+
+
+class Counter:
+    _guarded_by = {"_n": "_lock", "_peak": "_lock"}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+        self._peak = 0
+
+    def bump(self):
+        with self._lock:
+            self._n += 1
+            self._peak = max(self._peak, self._n)
+
+    def read(self):
+        with self._lock:
+            return self._n
+
+    def _drop(self):
+        # caller-holds: _lock
+        self._n -= 1
+
+    def drop(self):
+        with self._lock:
+            self._drop()
+
+
+def scan_body(carry, x):
+    rows = []
+    rows.append(x)          # local container: not a closure mutation
+    return carry + x, rows[0]
+
+
+def run(xs):
+    return lax.scan(scan_body, 0.0, xs)

@@ -1,0 +1,20 @@
+"""Lint fixture: a NAMED, reasoned suppression — the access is real
+but excepted, so the pass reports nothing and lists the suppression."""
+
+import threading
+
+
+class Peeker:
+    _guarded_by = {"_flag": "_lock"}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._flag = False
+
+    def set(self):
+        with self._lock:
+            self._flag = True
+
+    def peek(self):
+        # lint: allow(lock-discipline): benign racy peek for a fast-path shortcut
+        return self._flag

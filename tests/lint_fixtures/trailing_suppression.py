@@ -1,0 +1,19 @@
+"""Lint fixture: a TRAILING suppression covers only its own line —
+the unrelated violation directly below it must still be flagged (a
+suppression must never swallow a second finding)."""
+
+import threading
+
+
+class Sneaky:
+    _guarded_by = {"_x": "_lock", "_y": "_lock"}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._x = 0
+        self._y = 0
+
+    def peek_and_poke(self):
+        x = self._x  # lint: allow(lock-discipline): reasoned racy peek
+        self._y = x + 1            # EXPECT-LINT lock-discipline
+        return x

@@ -1,0 +1,366 @@
+"""Concurrency and invariant analysis (ISSUE 15): the veles_lint
+static passes, the lock-order witness, and the shutdown-ordering
+contract they pin.
+
+Three layers under test:
+
+- the LINTER itself, against fixture modules with seeded violations
+  (``tests/lint_fixtures/``): each must be caught at exactly the
+  marked file:line, the clean fixture at zero findings, and the
+  suppression hygiene (reason required, stale suppressions flagged)
+  must hold;
+- the FULL TREE: ``tools/veles_lint.py --check`` semantics ride
+  tier-1 here, so a future unguarded access or impure traced body
+  fails the suite, not a review round;
+- the RUNTIME witness (``serving/lockcheck.py``): a deliberately
+  inverted acquisition order and a lock held across a device-dispatch
+  site are caught with both stacks, and the serving stack's stop()
+  ordering — retry timers, the hedge loop, the health prober, the
+  telemetry sampler — runs under an armed witness without violations
+  or wedged futures.
+"""
+
+import os
+import re
+import sys
+import threading
+import time
+
+import numpy
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tools"))
+
+import veles_lint  # noqa: E402
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "lint_fixtures")
+
+EXPECT_RE = re.compile(r"#\s*EXPECT-LINT\s+([\w-]+)")
+
+
+def _expected(name):
+    """[(line, check)] markers in a fixture module."""
+    out = []
+    with open(os.path.join(FIXTURES, name), "r",
+              encoding="utf-8") as f:
+        for i, line in enumerate(f, start=1):
+            m = EXPECT_RE.search(line)
+            if m:
+                out.append((i, m.group(1)))
+    return out
+
+
+def _run_fixture(name, purity=False):
+    """(findings, suppressions) for one fixture module through the
+    full check (lock pass + purity pass + suppression hygiene)."""
+    findings, sups, _stats = veles_lint.run_check(
+        root=FIXTURES, modules=(name,),
+        purity_modules=(name,) if purity else (), registry=())
+    return findings, sups
+
+
+class TestLintFixtures:
+    def test_clean_fixture_zero_findings(self):
+        findings, sups = _run_fixture("clean_module.py", purity=True)
+        assert findings == [], "\n".join(map(repr, findings))
+        assert sups == []
+
+    def test_unlocked_guarded_access_caught_at_line(self):
+        findings, _ = _run_fixture("bad_guarded.py")
+        got = sorted((f.line, f.check) for f in findings)
+        assert got == sorted(_expected("bad_guarded.py")), \
+            "\n".join(map(repr, findings))
+        assert all(f.file == "bad_guarded.py" for f in findings)
+        # the messages name the attribute AND the missing lock
+        assert any("_items" in f.message and "_lock" in f.message
+                   for f in findings)
+
+    def test_broken_caller_holds_chain_caught(self):
+        findings, _ = _run_fixture("bad_chain.py")
+        got = [(f.line, f.check) for f in findings]
+        assert got == _expected("bad_chain.py"), \
+            "\n".join(map(repr, findings))
+        assert "caller-holds chain broken" in findings[0].message
+
+    def test_purity_violations_caught_at_line(self):
+        findings, _ = _run_fixture("bad_purity.py", purity=True)
+        got = sorted((f.line, f.check) for f in findings)
+        assert got == sorted(_expected("bad_purity.py")), \
+            "\n".join(map(repr, findings))
+        msgs = " | ".join(f.message for f in findings)
+        assert "time.time" in msgs
+        assert "np.random" in msgs
+        assert "print" in msgs
+        assert "TRACE_LOG" in msgs and "mutates" in msgs
+
+    def test_reasoned_suppression_silences_and_is_listed(self):
+        findings, sups = _run_fixture("suppressed.py")
+        assert findings == [], "\n".join(map(repr, findings))
+        assert len(sups) == 1
+        assert sups[0].check == "lock-discipline"
+        assert "benign racy peek" in sups[0].reason
+        assert sups[0].used
+
+    def test_trailing_suppression_covers_only_its_own_line(self):
+        """A trailing `# lint: allow` must not reach the next line —
+        else one reasoned exception could silently swallow a second,
+        unrelated violation."""
+        findings, sups = _run_fixture("trailing_suppression.py")
+        got = [(f.line, f.check) for f in findings]
+        assert got == _expected("trailing_suppression.py"), \
+            "\n".join(map(repr, findings))
+        assert len(sups) == 1 and sups[0].used
+        assert not sups[0].standalone
+
+    def test_reasonless_suppression_is_a_finding(self):
+        findings, sups = _run_fixture("bad_suppression.py")
+        assert sups == []          # rejected, never registered
+        checks = sorted(f.check for f in findings)
+        # the malformed suppression AND the access it failed to cover
+        assert checks == ["lock-discipline", "suppression"]
+        sup = next(f for f in findings if f.check == "suppression")
+        assert "no reason" in sup.message
+
+
+class TestFullTree:
+    def test_full_tree_lint_clean(self):
+        """THE tier-1 enforcement: the shipped tree has zero findings
+        and every suppression carries a reason — a future unguarded
+        access or impure traced body fails here, not in review."""
+        findings, sups, stats = veles_lint.run_check()
+        assert findings == [], (
+            "veles_lint found %d problem(s) in the tree:\n%s"
+            % (len(findings), "\n".join(map(repr, findings))))
+        assert all(s.reason for s in sups)
+        # the analysis actually covered the serving tier (a silently
+        # empty pass must not read as a clean one)
+        assert stats["files"] >= 10
+        assert stats["guarded_attrs"] >= 50
+        assert stats["module_globals"] >= 2
+        assert stats["traced_functions"] >= 40
+
+    def test_summary_record_shape(self):
+        rec = veles_lint.summary_record(
+            {"findings": 0, "stats": {"files": 11}})[0]
+        for key in ("metric", "value", "unit", "vs_baseline",
+                    "configs"):
+            assert key in rec
+        assert rec["metric"] == "lint_findings"
+        # the empty-results worst case conforms too (the
+        # check_stream_records builtin contract)
+        empty = veles_lint.summary_record({})[0]
+        assert empty["value"] == 0
+
+
+class TestLockOrderWitness:
+    def test_deliberate_inversion_caught_with_both_stacks(self):
+        from veles_tpu.serving import lockcheck
+        w = lockcheck.LockOrderWitness(name="t_invert")
+        lockcheck.arm(w)
+        try:
+            a = lockcheck.make_lock("fixture.A")
+            b = lockcheck.make_lock("fixture.B")
+            with a:
+                with b:
+                    pass
+            with b:                # the documented order, inverted
+                with a:
+                    pass
+        finally:
+            lockcheck.disarm()
+        assert len(w.violations) == 1
+        report = w.violations[0]
+        assert "cycle" in report
+        assert "fixture.A" in report and "fixture.B" in report
+        # both stacks: where the held lock was taken, where the
+        # conflicting acquire happened
+        assert report.count("test_lint.py") >= 2
+
+    def test_inversion_raises_when_asked(self):
+        from veles_tpu.serving import lockcheck
+        w = lockcheck.LockOrderWitness(raise_on_violation=True)
+        lockcheck.arm(w)
+        try:
+            a = lockcheck.make_lock("fixture.C")
+            b = lockcheck.make_lock("fixture.D")
+            with a:
+                with b:
+                    pass
+            with pytest.raises(lockcheck.LockOrderViolation):
+                with b:
+                    with a:
+                        pass
+        finally:
+            lockcheck.disarm()
+
+    def test_lock_held_across_dispatch_caught(self):
+        from veles_tpu.serving import lockcheck
+        w = lockcheck.LockOrderWitness(name="t_dispatch")
+        lockcheck.arm(w)
+        try:
+            lock = lockcheck.make_lock("fixture.E")
+            lockcheck.note_dispatch("engine.step")   # lock-free: fine
+            with lock:
+                lockcheck.note_dispatch("engine.step")
+        finally:
+            lockcheck.disarm()
+        assert len(w.violations) == 1
+        assert "held across device dispatch" in w.violations[0]
+        assert "engine.step" in w.violations[0]
+
+    def test_nonreentrant_reacquire_caught(self):
+        from veles_tpu.serving import lockcheck
+        w = lockcheck.LockOrderWitness(name="t_reent",
+                                       raise_on_violation=True)
+        lockcheck.arm(w)
+        try:
+            lock = lockcheck.make_lock("fixture.F")
+            with lock:
+                with pytest.raises(lockcheck.LockOrderViolation):
+                    with lock:
+                        pass
+        finally:
+            lockcheck.disarm()
+
+    def test_condition_wait_notify_under_witness(self):
+        """The Condition wrapper keeps primitive semantics while
+        armed: wait releases (held-stack popped — a concurrent
+        notifier acquiring is no violation) and re-acquires."""
+        from veles_tpu.serving import lockcheck
+        w = lockcheck.LockOrderWitness(name="t_cond")
+        lockcheck.arm(w)
+        try:
+            cond = lockcheck.make_condition("fixture.cond")
+            seen = []
+
+            def waiter():
+                with cond:
+                    while not seen:
+                        cond.wait(5.0)
+                    seen.append("woke")
+
+            t = threading.Thread(target=waiter)
+            t.start()
+            time.sleep(0.05)
+            with cond:
+                seen.append("go")
+                cond.notify_all()
+            t.join(timeout=10)
+            assert not t.is_alive()
+            assert seen == ["go", "woke"]
+        finally:
+            lockcheck.disarm()
+        assert w.violations == []
+        assert w.acquisitions >= 2
+
+    def test_unarmed_shims_are_inert(self):
+        from veles_tpu.serving import lockcheck
+        assert lockcheck.armed() is None
+        lock = lockcheck.make_lock("fixture.G")
+        with lock:
+            lockcheck.note_dispatch("engine.step")
+        cond = lockcheck.make_condition("fixture.H")
+        with cond:
+            cond.notify_all()
+
+
+def _tiny_params(max_len=48, vocab=16, n_heads=2, n_layers=2):
+    import jax
+    import jax.numpy as jnp
+    from veles_tpu import prng
+    from veles_tpu.ops.transformer import init_transformer_params
+    host = init_transformer_params(prng.get("init"), vocab, d_model=32,
+                                   n_heads=n_heads, n_layers=n_layers,
+                                   max_len=max_len)
+    return jax.tree.map(jnp.asarray, host)
+
+
+class TestStopOrderingUnderWitness:
+    def test_serving_stack_stop_ordering(self):
+        """The ISSUE 15 shutdown audit, pinned: a fleet with a parked
+        retry timer (long backoff), a live hedge loop, a health
+        prober and the telemetry sampler+SLO listener stops in the
+        serve_lm order — every outstanding future resolves loudly
+        (never wedges on a cancelled timer), every daemon joins, and
+        the armed witness sees no ordering violation across the whole
+        teardown."""
+        from veles_tpu.serving import (FaultPlan, HealthChecker,
+                                       LMEngine, Router, SLOMonitor,
+                                       lockcheck, telemetry_for)
+        params = _tiny_params()
+        plan = FaultPlan(seed=0)
+        # replica 0 poisons every step dispatch: the first attempt
+        # faults and schedules a retry with a deliberately HUGE
+        # backoff, so stop() runs with the timer still parked
+        plan.arm("engine.step", kind="error")
+        witness = lockcheck.LockOrderWitness(name="t_stop")
+        lockcheck.arm(witness)
+        try:
+            replicas = [
+                LMEngine(params, n_heads=2, max_len=48, slots=2,
+                         name="lint_stop0", faults=plan),
+                LMEngine(params, n_heads=2, max_len=48, slots=2,
+                         name="lint_stop1"),
+            ]
+            router = Router(replicas, retries=3,
+                            retry_backoff_s=30.0,
+                            retry_backoff_cap_s=60.0,
+                            hedge_after_s=5.0, seed=0)
+            router.start()
+            checker = HealthChecker(router, interval_s=0.2,
+                                    stall_s=60.0).warm_probes()
+            checker.start()
+            store = telemetry_for(router, interval_s=0.2)
+            monitor = SLOMonitor(store,
+                                 SLOMonitor.default_objectives(),
+                                 windows_s=(1.0, 5.0), min_events=1,
+                                 checker=checker)
+            store.add_listener(monitor.sample_once)
+            store.start()
+            # exclude the healthy replica so the first placement hits
+            # the poisoned one and schedules the long-backoff retry
+            with router._lock:
+                router._live[1] = False
+            fut = router.submit([1, 2, 3], 4)
+            deadline = time.monotonic() + 30.0
+            while router.metrics.counter("requests_retried") < 1:
+                assert time.monotonic() < deadline, \
+                    "retry was never scheduled"
+                time.sleep(0.01)
+            with router._lock:
+                router._live[1] = True
+            # the serve_lm stop order: telemetry → publisher (none) →
+            # health prober → router (timers, hedge, replicas)
+            store.stop()
+            checker.stop()
+            router.stop()
+            # the parked-timer job fails LOUDLY instead of wedging
+            with pytest.raises(Exception):
+                fut.result(timeout=10)
+            assert fut.done()
+            assert router._hedge_thread is None
+            with router._lock:
+                assert not router._timers
+            assert store._thread is None
+            assert checker._thread is None
+            for e in replicas:
+                assert e._thread is None
+        finally:
+            plan.release()
+            lockcheck.disarm()
+        assert witness.violations == [], \
+            "\n\n".join(witness.violations)
+        assert witness.acquisitions > 0
+
+
+class TestStreamRecordIntegration:
+    def test_check_stream_records_validates_lint_record(self):
+        """The <1s builtin path: check_stream_records --tool
+        veles_lint validates exactly this tool's record without
+        importing the jax-heavy benches."""
+        import check_stream_records
+        problems = check_stream_records.check_tool("veles_lint")
+        assert problems == []

@@ -220,6 +220,8 @@ def _builtin_records():
     out.append(("trace_report.summary_record({})",
                 trace_report.summary_record({})[0]))
 
+    out.extend(_lint_records())
+
     import slo_report
     out.append(("slo_report.summary_record({})",
                 slo_report.summary_record({})[0]))
@@ -242,6 +244,42 @@ def _builtin_records():
     line = buf.getvalue().strip().splitlines()[-1]
     out.append(("profile_ops.stream_summary()", json.loads(line)))
     return out
+
+
+def _lint_records():
+    """veles_lint's streamed record (ISSUE 15): the empty-results
+    worst case plus a populated run — no jax import, <1s."""
+    import veles_lint
+    return [
+        ("veles_lint.summary_record({})",
+         veles_lint.summary_record({})[0]),
+        ("veles_lint.summary_record(populated)",
+         veles_lint.summary_record(
+             {"findings": 2, "stats": {"files": 11,
+                                       "suppressions": 3}})[0]),
+    ]
+
+
+#: tools checkable WITHOUT importing the jax-heavy benches — the <1s
+#: ``--tool`` mode (tests/test_lint.py rides it)
+FAST_TOOLS = {"veles_lint": _lint_records}
+
+
+def check_tool(name):
+    """Validate one fast tool's records only (no bench imports);
+    returns problems."""
+    if name not in FAST_TOOLS:
+        return ["unknown fast tool %r (one of %r)"
+                % (name, sorted(FAST_TOOLS))]
+    problems = []
+    try:
+        records = FAST_TOOLS[name]()
+    except Exception as e:   # noqa: BLE001 — an unimportable tool IS
+        return ["collecting %s records failed: %s: %s"
+                % (name, type(e).__name__, e)]
+    for where, record in records:
+        problems.extend(check_record(record, where))
+    return problems
 
 
 def check_builtin():
@@ -267,11 +305,18 @@ def main(argv=None):
     parser.add_argument("--file", default=None, metavar="JSONL",
                         help="validate every line of this captured "
                              "stream instead of the builtin tool check")
+    parser.add_argument("--tool", default=None, metavar="NAME",
+                        help="validate only this fast tool's records "
+                             "(no bench imports, <1s): one of %s"
+                             % sorted(FAST_TOOLS))
     args = parser.parse_args(argv)
     if args.file:
         with open(args.file, "r", encoding="utf-8") as f:
             problems = check_stream(f.read(), args.file)
         checked = "stream %s" % args.file
+    elif args.tool:
+        problems = check_tool(args.tool)
+        checked = "fast tool %s" % args.tool
     else:
         problems = check_builtin()
         checked = "builtin summary_record sources"

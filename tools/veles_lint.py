@@ -1,0 +1,817 @@
+"""veles_lint — project-specific static analysis for the serving tier
+(ISSUE 15).
+
+Three review-hardening rounds (PRs 11, 12, 14) each found real
+concurrency violations by hand; this tool makes the rules they were
+checking executable.  Two static passes (the runtime third — the
+lock-order witness — lives in ``veles_tpu/serving/lockcheck.py``):
+
+LOCK DISCIPLINE.  A class declares which attributes its lock guards::
+
+    class Router:
+        _guarded_by = {"_live": "_lock", "_jobs": "_lock"}
+
+or per attribute, with a trailing comment on the assignment::
+
+    self._queue = collections.deque()   # guarded-by: _cond
+
+The pass walks every method and flags any read or write of a guarded
+attribute that is not (a) inside a ``with self.<lock>:`` block, (b) in
+a method marked ``# caller-holds: <lock>`` (placed on the ``def`` line
+or directly under it, before the first real statement), or (c) in
+``__init__`` (no concurrency before construction completes).  A call
+``self.helper()`` where ``helper`` is marked ``# caller-holds: X``
+and ``X`` is not held at the call site is flagged too — the broken
+caller-holds CHAIN is exactly the bug class PR 12's review caught by
+hand.  Module-level globals ride the same pass via a trailing
+``# guarded-by: <lock>`` on the global's assignment (the metrics
+registry, the default telemetry store).
+
+Classes that are deliberately lock-free declare why::
+
+    _synchronized_externally = "engine worker thread (single owner)"
+
+TRACED PURITY.  Every function the engine jits or scans — discovered
+from ``self._jit(...)`` / ``jax.jit(...)`` / ``lax.scan(...)`` call
+sites plus the explicit ``TRACED_REGISTRY`` below — must be pure host-
+side: the pass walks its call graph (same module, and one import hop
+into project modules) and flags ``time.*``, ``random`` /
+``numpy.random`` (``veles_tpu.prng`` is exempt — counter-based,
+trace-safe by design), threading primitives, ``print``, and mutation
+of closed-over containers.  A ``time.time()`` baked into a scanned
+body is a constant at trace time — the class of bug that silently
+costs a TPU window (PAPERS.md, the Julia-to-TPU compilation paper).
+
+SUPPRESSIONS are per-site, named and greppable::
+
+    x = self._queue  # lint: allow(lock-discipline): benign racy peek
+
+Every suppression must carry a non-empty reason; a reasonless or
+UNUSED suppression is itself a finding, so the exception list can
+never rot.
+
+Run standalone (``python tools/veles_lint.py --check``) — findings to
+stderr, one bench.py-style summary record streamed to stdout — or via
+tier-1 (``tests/test_lint.py`` runs the full-tree check), so a future
+unguarded access fails the suite, not a review round.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import io
+import json
+import os
+import re
+import sys
+import tokenize
+
+TOOLS_DIR = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(TOOLS_DIR)
+
+#: the serving modules the lock-discipline pass covers (ISSUE 15) —
+#: every module that owns a lock or declares external synchronization
+SERVING_MODULES = (
+    "veles_tpu/serving/lm_engine.py",
+    "veles_tpu/serving/router.py",
+    "veles_tpu/serving/batcher.py",
+    "veles_tpu/serving/kv_pool.py",
+    "veles_tpu/serving/metrics.py",
+    "veles_tpu/serving/tracing.py",
+    "veles_tpu/serving/timeseries.py",
+    "veles_tpu/serving/slo.py",
+    "veles_tpu/serving/model_manager.py",
+    "veles_tpu/serving/faults.py",
+    "veles_tpu/serving/lockcheck.py",
+)
+
+#: traced-purity entry points beyond what call-site discovery finds:
+#: (path suffix, bare function name) — functions RETURNED by builders
+#: and jitted indirectly, or library functions every traced body runs
+TRACED_REGISTRY = (
+    ("veles_tpu/serving/lm_engine.py", "mega_plain"),
+    ("veles_tpu/serving/lm_engine.py", "mega_spec"),
+    ("veles_tpu/serving/lm_engine.py", "plain_iter"),
+    ("veles_tpu/serving/lm_engine.py", "spec_iter"),
+    ("veles_tpu/ops/transformer.py", "propose_draft_in_graph"),
+)
+
+#: modules the purity pass scans for jit/scan call sites
+PURITY_MODULES = (
+    "veles_tpu/serving/lm_engine.py",
+    "veles_tpu/ops/transformer.py",
+)
+
+CHECKS = ("lock-discipline", "traced-purity", "suppression")
+
+SUPPRESS_RE = re.compile(
+    r"#\s*lint:\s*allow\((?P<check>[\w-]+)\)\s*:?\s*(?P<reason>.*)")
+GUARDED_RE = re.compile(r"#\s*guarded-by:\s*(?P<lock>\w+)")
+HOLDS_RE = re.compile(r"#\s*caller-holds:\s*(?P<locks>[\w\s,]+)")
+
+#: mutating container methods (closed-over mutation detection)
+MUTATORS = frozenset((
+    "append", "extend", "insert", "remove", "pop", "popleft",
+    "appendleft", "clear", "update", "setdefault", "add", "discard",
+    "sort", "reverse",
+))
+
+#: dotted-call prefixes that are impure on a traced path
+IMPURE_PREFIXES = (
+    "time.", "random.", "numpy.random.", "np.random.", "threading.",
+    "os.urandom", "secrets.",
+)
+IMPURE_BARE = frozenset(("print", "input", "open"))
+
+#: prefixes exempt from the random rule — the project's counter-based
+#: PRNG is trace-safe by design (veles_tpu/prng.py)
+PURE_PREFIXES = ("prng.",)
+
+
+class Finding:
+    __slots__ = ("file", "line", "check", "message")
+
+    def __init__(self, file, line, check, message):
+        self.file = file
+        self.line = int(line)
+        self.check = check
+        self.message = message
+
+    def __repr__(self):
+        return "%s:%d: [%s] %s" % (self.file, self.line, self.check,
+                                   self.message)
+
+    def to_dict(self):
+        return {"file": self.file, "line": self.line,
+                "check": self.check, "message": self.message}
+
+
+class Suppression:
+    __slots__ = ("file", "line", "check", "reason", "standalone",
+                 "used")
+
+    def __init__(self, file, line, check, reason, standalone):
+        self.file = file
+        self.line = int(line)
+        self.check = check
+        self.reason = reason.strip()
+        #: a comment-only line (covers the statement BELOW it); a
+        #: trailing comment covers its own line only
+        self.standalone = bool(standalone)
+        self.used = False
+
+
+def _comments(src):
+    """({lineno: comment text}, {standalone linenos}) over ``src`` —
+    standalone marks comment-only lines (tokenize survives anything
+    that parses as Python)."""
+    out, standalone = {}, set()
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(src).readline):
+            if tok.type == tokenize.COMMENT:
+                out[tok.start[0]] = tok.string
+                if not tok.line[:tok.start[1]].strip():
+                    standalone.add(tok.start[0])
+    except tokenize.TokenError:
+        pass
+    return out, standalone
+
+
+def _suppressions(relpath, comments, standalone):
+    """Every ``# lint: allow(check): reason`` site in the file, plus a
+    finding for each malformed one (unknown check / missing reason)."""
+    sups, findings = [], []
+    for line, text in comments.items():
+        m = SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        check, reason = m.group("check"), m.group("reason").strip()
+        if check not in CHECKS:
+            findings.append(Finding(
+                relpath, line, "suppression",
+                "unknown check %r in suppression (one of %r)"
+                % (check, CHECKS)))
+            continue
+        if not reason:
+            findings.append(Finding(
+                relpath, line, "suppression",
+                "suppression carries no reason string — every "
+                "exception must say why"))
+            continue
+        sups.append(Suppression(relpath, line, check, reason,
+                                line in standalone))
+    return sups, findings
+
+
+def _suppressed(sups, line, check):
+    """A TRAILING suppression covers exactly its own line; a
+    STANDALONE comment-line suppression covers exactly the statement
+    directly below it — never both, so one comment can never swallow
+    a second, unrelated finding on the next line."""
+    for s in sups:
+        if s.check == check \
+                and line == (s.line + 1 if s.standalone else s.line):
+            s.used = True
+            return True
+    return False
+
+
+def _dotted(node):
+    """'a.b.c' for an Attribute/Name chain, or None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+# --------------------------------------------------------------- lock pass
+def _caller_holds(fn, comments):
+    """The locks a method declares its caller holds: a ``#
+    caller-holds: X[, Y]`` comment on the ``def`` line or between it
+    and the first real (non-docstring) statement."""
+    if not fn.body:
+        return frozenset()
+    first = fn.body[0]
+    end = first.lineno
+    if (isinstance(first, ast.Expr)
+            and isinstance(first.value, ast.Constant)
+            and isinstance(first.value.value, str)):
+        end = (fn.body[1].lineno if len(fn.body) > 1
+               else first.end_lineno or first.lineno)
+    locks = set()
+    for line in range(fn.lineno, end + 1):
+        m = HOLDS_RE.search(comments.get(line, ""))
+        if m:
+            locks.update(x.strip() for x in
+                         m.group("locks").split(",") if x.strip())
+    return frozenset(locks)
+
+
+class _ClassLint:
+    """Lock-discipline over one class: guard map, caller-holds chain,
+    with-block tracking."""
+
+    def __init__(self, relpath, cls, comments, sups, findings):
+        self.relpath = relpath
+        self.cls = cls
+        self.comments = comments
+        self.sups = sups
+        self.findings = findings
+        self.guard = {}          # attr -> lock
+        self.external = None
+        self.holds = {}          # method name -> frozenset(locks)
+        self._collect()
+        self.locks = frozenset(self.guard.values())
+
+    def _collect(self):
+        for node in self.cls.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                name = node.targets[0].id
+                if name == "_guarded_by" \
+                        and isinstance(node.value, ast.Dict):
+                    for k, v in zip(node.value.keys, node.value.values):
+                        if isinstance(k, ast.Constant) \
+                                and isinstance(v, ast.Constant):
+                            self.guard[str(k.value)] = str(v.value)
+                elif name == "_synchronized_externally" \
+                        and isinstance(node.value, ast.Constant):
+                    self.external = str(node.value.value)
+                    if not self.external.strip():
+                        self.findings.append(Finding(
+                            self.relpath, node.lineno, "lock-discipline",
+                            "_synchronized_externally must name the "
+                            "owner (empty string)"))
+        # trailing `# guarded-by:` comments on self.<attr> assignments
+        for fn in self._methods():
+            for node in ast.walk(fn):
+                if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    continue
+                targets = (node.targets
+                           if isinstance(node, ast.Assign)
+                           else [node.target])
+                m = GUARDED_RE.search(
+                    self.comments.get(node.lineno, ""))
+                if not m:
+                    continue
+                for t in targets:
+                    if isinstance(t, ast.Attribute) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id == "self":
+                        self.guard[t.attr] = m.group("lock")
+        for fn in self._methods():
+            self.holds[fn.name] = _caller_holds(fn, self.comments)
+
+    def _methods(self):
+        return [n for n in self.cls.body
+                if isinstance(n, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef))]
+
+    def run(self):
+        if not self.guard:
+            return
+        for fn in self._methods():
+            if fn.name == "__init__":
+                continue
+            self._walk_stmts(fn.body, self.holds.get(fn.name,
+                                                     frozenset()))
+
+    def _lock_of_with_item(self, item):
+        expr = item.context_expr
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name) \
+                and expr.value.id == "self" and expr.attr in self.locks:
+            return expr.attr
+        return None
+
+    def _walk_stmts(self, stmts, held):
+        for stmt in stmts:
+            self._walk(stmt, held)
+
+    def _walk(self, node, held):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            newly = set()
+            for item in node.items:
+                lock = self._lock_of_with_item(item)
+                if lock:
+                    newly.add(lock)
+                else:
+                    self._walk(item.context_expr, held)
+            self._walk_stmts(node.body, held | newly)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # a nested function runs LATER, on whatever thread calls
+            # it — it holds nothing unless it says so itself
+            inner = _caller_holds(node, self.comments)
+            self._walk_stmts(node.body, frozenset(inner))
+            return
+        if isinstance(node, ast.Lambda):
+            self._walk(node.body, frozenset())
+            return
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "self":
+            attr = node.attr
+            lock = self.guard.get(attr)
+            if lock is not None and lock not in held \
+                    and not _suppressed(self.sups, node.lineno,
+                                        "lock-discipline"):
+                kind = ("write" if isinstance(node.ctx, (ast.Store,
+                                                         ast.Del))
+                        else "read")
+                self.findings.append(Finding(
+                    self.relpath, node.lineno, "lock-discipline",
+                    "%s of %s.%s (guarded by %s) outside `with "
+                    "self.%s:` and no `# caller-holds: %s` marker"
+                    % (kind, self.cls.name, attr, lock, lock, lock)))
+            return      # leaf: Name('self') below needs no recursion
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id == "self":
+            callee = node.func.attr
+            missing = self.holds.get(callee, frozenset()) - held
+            if missing and not _suppressed(self.sups, node.lineno,
+                                           "lock-discipline"):
+                self.findings.append(Finding(
+                    self.relpath, node.lineno, "lock-discipline",
+                    "call to %s.%s() (# caller-holds: %s) without "
+                    "holding %s — caller-holds chain broken"
+                    % (self.cls.name, callee,
+                       ", ".join(sorted(self.holds[callee])),
+                       ", ".join(sorted(missing)))))
+            for arg in list(node.args) + [kw.value
+                                          for kw in node.keywords]:
+                self._walk(arg, held)
+            return
+        for child in ast.iter_child_nodes(node):
+            self._walk(child, held)
+
+
+class _ModuleGlobalsLint:
+    """Lock discipline over module-level globals: ``# guarded-by:``
+    trailing a top-level assignment makes every module-level
+    function's access of that global require ``with <lock>:``."""
+
+    def __init__(self, relpath, tree, comments, sups, findings):
+        self.relpath = relpath
+        self.tree = tree
+        self.comments = comments
+        self.sups = sups
+        self.findings = findings
+        self.guard = {}
+        for node in tree.body:
+            if isinstance(node, ast.Assign) \
+                    and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                m = GUARDED_RE.search(comments.get(node.lineno, ""))
+                if m:
+                    self.guard[node.targets[0].id] = m.group("lock")
+        self.locks = frozenset(self.guard.values())
+
+    def run(self):
+        if not self.guard:
+            return
+        for node in self.tree.body:
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                self._walk_stmts(node.body, frozenset())
+
+    def _walk_stmts(self, stmts, held):
+        for stmt in stmts:
+            self._walk(stmt, held)
+
+    def _walk(self, node, held):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            newly = set()
+            for item in node.items:
+                expr = item.context_expr
+                if isinstance(expr, ast.Name) and expr.id in self.locks:
+                    newly.add(expr.id)
+            self._walk_stmts(node.body, held | newly)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            body = node.body if isinstance(node.body, list) \
+                else [node.body]
+            self._walk_stmts(body, frozenset())
+            return
+        if isinstance(node, ast.Name) and node.id in self.guard:
+            lock = self.guard[node.id]
+            if lock not in held \
+                    and not _suppressed(self.sups, node.lineno,
+                                        "lock-discipline"):
+                self.findings.append(Finding(
+                    self.relpath, node.lineno, "lock-discipline",
+                    "access of module global %s (guarded by %s) "
+                    "outside `with %s:`" % (node.id, lock, lock)))
+            return
+        for child in ast.iter_child_nodes(node):
+            self._walk(child, held)
+
+
+# ------------------------------------------------------------- purity pass
+class _ModuleIndex:
+    """Parsed-module cache for the purity pass: defs by bare name,
+    project imports, comments."""
+
+    def __init__(self, root, relpath):
+        self.relpath = relpath
+        path = os.path.join(root, relpath)
+        with open(path, "r", encoding="utf-8") as f:
+            self.src = f.read()
+        self.tree = ast.parse(self.src, filename=relpath)
+        self.comments, self.standalone = _comments(self.src)
+        self.defs = {}           # bare name -> [FunctionDef]
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                self.defs.setdefault(node.name, []).append(node)
+        #: imported name -> project-relative module path (one hop)
+        self.imports = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.ImportFrom) and node.module \
+                    and node.module.startswith("veles_tpu"):
+                mod_rel = node.module.replace(".", "/") + ".py"
+                for alias in node.names:
+                    self.imports[alias.asname or alias.name] = \
+                        (mod_rel, alias.name)
+
+
+class _PurityPass:
+    """Traced-purity over discovered jit/scan targets + the registry;
+    call graph followed same-module and one hop into project
+    modules."""
+
+    def __init__(self, root, sups_by_file, findings):
+        self.root = root
+        self.sups_by_file = sups_by_file
+        self.findings = findings
+        self._modules = {}
+        self._analyzed = set()
+        self.traced_functions = 0
+
+    def module(self, relpath):
+        if relpath not in self._modules:
+            try:
+                self._modules[relpath] = _ModuleIndex(self.root,
+                                                      relpath)
+            except (OSError, SyntaxError):
+                self._modules[relpath] = None
+        return self._modules[relpath]
+
+    # ----------------------------------------------------------- discovery
+    def discover(self, relpath):
+        """Traced roots in ``relpath``: first args of self._jit /
+        jax.jit / jit / (jax.)lax.scan calls, resolved through local
+        ``name = vmap/partial/checkpoint(...)`` aliases."""
+        mod = self.module(relpath)
+        if mod is None:
+            return []
+        roots = []
+        aliases = self._aliases(mod.tree)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            name = _dotted(node.func)
+            if name is None:
+                continue
+            traced = (name in ("jax.jit", "jit")
+                      or name.endswith("._jit")
+                      or name in ("lax.scan", "jax.lax.scan"))
+            if not traced:
+                continue
+            roots.extend(self._resolve(node.args[0], mod, aliases))
+        return roots
+
+    def _aliases(self, tree):
+        """name -> value expr for simple ``name = <call>`` bindings
+        anywhere in the module (function-local included) — how
+        ``step_all = jax.vmap(step_one)`` resolves to ``step_one``."""
+        out = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) \
+                    and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                out[node.targets[0].id] = node.value
+        return out
+
+    def _resolve(self, expr, mod, aliases, depth=0):
+        """FunctionDef/Lambda nodes an expression can denote."""
+        if depth > 6:
+            return []
+        if isinstance(expr, ast.Lambda):
+            return [(mod, expr)]
+        if isinstance(expr, ast.Name):
+            name = expr.id
+            if name in mod.defs:
+                return [(mod, fn) for fn in mod.defs[name]]
+            alias = aliases.get(name)
+            if alias is not None:
+                return self._resolve(alias, mod, aliases, depth + 1)
+            return []
+        if isinstance(expr, ast.Call):
+            wrapper = _dotted(expr.func) or ""
+            if wrapper.split(".")[-1] in ("vmap", "partial",
+                                          "checkpoint", "remat",
+                                          "named_call"):
+                out = []
+                for arg in expr.args:
+                    out.extend(self._resolve(arg, mod, aliases,
+                                             depth + 1))
+                return out
+        return []
+
+    # ------------------------------------------------------------ analysis
+    def analyze(self, mod, fn, depth=0):
+        key = (mod.relpath, getattr(fn, "name", "<lambda>"),
+               fn.lineno)
+        if key in self._analyzed or depth > 8:
+            return
+        self._analyzed.add(key)
+        self.traced_functions += 1
+        local = self._local_names(fn)
+        aliases = self._aliases(fn) if not isinstance(fn, ast.Lambda) \
+            else {}
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        for stmt in body:
+            for node in ast.walk(stmt):
+                self._check_node(mod, fn, node, local, aliases, depth)
+
+    @staticmethod
+    def _local_names(fn):
+        names = set()
+        args = fn.args
+        for a in (args.args + args.posonlyargs + args.kwonlyargs
+                  + ([args.vararg] if args.vararg else [])
+                  + ([args.kwarg] if args.kwarg else [])):
+            names.add(a.arg)
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Name) \
+                        and isinstance(node.ctx, ast.Store):
+                    names.add(node.id)
+                elif isinstance(node, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    names.add(node.name)
+        return names
+
+    def _flag(self, mod, node, message):
+        sups = self.sups_by_file.get(mod.relpath, [])
+        if _suppressed(sups, node.lineno, "traced-purity"):
+            return
+        self.findings.append(Finding(
+            mod.relpath, node.lineno, "traced-purity", message))
+
+    def _check_node(self, mod, fn, node, local, aliases, depth):
+        if isinstance(node, ast.Call):
+            name = _dotted(node.func)
+            if name:
+                if any(name.startswith(p) for p in PURE_PREFIXES):
+                    return
+                if name in IMPURE_BARE:
+                    self._flag(mod, node,
+                               "%s() in a traced/scanned body — a "
+                               "host side effect baked in at trace "
+                               "time" % name)
+                    return
+                for p in IMPURE_PREFIXES:
+                    if name.startswith(p) or name == p.rstrip("."):
+                        self._flag(mod, node,
+                                   "%s in a traced/scanned body — "
+                                   "host-side nondeterminism is a "
+                                   "trace-time constant" % name)
+                        return
+                # closed-over container mutation: obj.append(...) on a
+                # name not local to the traced function
+                if isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in MUTATORS \
+                        and isinstance(node.func.value, ast.Name) \
+                        and node.func.value.id not in local:
+                    self._flag(mod, node,
+                               "%s.%s() mutates a closed-over/global "
+                               "container inside a traced body"
+                               % (node.func.value.id, node.func.attr))
+                    return
+                # call-graph follow
+                self._follow(mod, name, aliases, depth)
+        elif isinstance(node, ast.AugAssign) \
+                and isinstance(node.target, ast.Name) \
+                and node.target.id not in local:
+            self._flag(mod, node,
+                       "augmented assignment to closed-over/global "
+                       "%r inside a traced body" % node.target.id)
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id not in local:
+                    self._flag(mod, node,
+                               "subscript store into closed-over/"
+                               "global %r inside a traced body"
+                               % t.value.id)
+
+    def _follow(self, mod, name, aliases, depth):
+        if "." in name:
+            return          # dotted calls: library (jnp/jax/numpy) —
+        targets = []        # flagged above if impure, else trusted
+        if name in mod.defs:
+            targets = [(mod, f) for f in mod.defs[name]]
+        elif name in aliases:
+            targets = self._resolve(aliases[name], mod,
+                                    self._aliases(mod.tree))
+        elif name in mod.imports:
+            rel, orig = mod.imports[name]
+            other = self.module(rel)
+            if other is not None and orig in other.defs:
+                targets = [(other, f) for f in other.defs[orig]]
+        for m, f in targets:
+            self.analyze(m, f, depth + 1)
+
+    # -------------------------------------------------------------- driver
+    def run(self, purity_modules=PURITY_MODULES,
+            registry=TRACED_REGISTRY):
+        for relpath in purity_modules:
+            for mod, fn in self.discover(relpath):
+                self.analyze(mod, fn)
+        for relpath, name in registry:
+            mod = self.module(relpath)
+            if mod is None or name not in mod.defs:
+                self.findings.append(Finding(
+                    relpath, 1, "traced-purity",
+                    "TRACED_REGISTRY names %r but no such function "
+                    "exists — registry drift" % name))
+                continue
+            for fn in mod.defs[name]:
+                self.analyze(mod, fn)
+
+
+# --------------------------------------------------------------- the lint
+def lint_file(root, relpath, findings, suppressions):
+    """Lock-discipline (classes + module globals) over one file.
+    Returns per-file stats."""
+    path = os.path.join(root, relpath)
+    with open(path, "r", encoding="utf-8") as f:
+        src = f.read()
+    tree = ast.parse(src, filename=relpath)
+    comments, standalone = _comments(src)
+    sups, sup_findings = _suppressions(relpath, comments, standalone)
+    findings.extend(sup_findings)
+    suppressions.extend(sups)
+    classes = guarded = external = 0
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef):
+            cl = _ClassLint(relpath, node, comments, sups, findings)
+            cl.run()
+            classes += 1
+            guarded += len(cl.guard)
+            if cl.external:
+                external += 1
+    mg = _ModuleGlobalsLint(relpath, tree, comments, sups, findings)
+    mg.run()
+    return {"classes": classes, "guarded_attrs": guarded,
+            "external": external,
+            "module_globals": len(mg.guard)}
+
+
+def run_check(root=REPO, modules=SERVING_MODULES,
+              purity_modules=PURITY_MODULES, registry=TRACED_REGISTRY):
+    """The full-tree check: every serving module through the lock
+    pass, the purity pass over its discovery set + registry, unused/
+    reasonless suppressions flagged.  Returns (findings,
+    suppressions, stats)."""
+    findings, suppressions = [], []
+    stats = {"files": 0, "classes": 0, "guarded_attrs": 0,
+             "module_globals": 0, "external": 0}
+    sups_by_file = {}
+    for relpath in modules:
+        st = lint_file(root, relpath, findings, suppressions)
+        stats["files"] += 1
+        for k in ("classes", "guarded_attrs", "module_globals",
+                  "external"):
+            stats[k] += st[k]
+    for s in suppressions:
+        sups_by_file.setdefault(s.file, []).append(s)
+    # purity files not already linted contribute their suppressions too
+    for relpath in tuple(purity_modules) + tuple(
+            r for r, _ in registry):
+        if relpath in sups_by_file or relpath in modules:
+            continue
+        try:
+            with open(os.path.join(root, relpath), "r",
+                      encoding="utf-8") as f:
+                src = f.read()
+        except OSError:
+            continue
+        sups, sup_findings = _suppressions(relpath, *_comments(src))
+        findings.extend(sup_findings)
+        suppressions.extend(sups)
+        sups_by_file[relpath] = sups
+    purity = _PurityPass(root, sups_by_file, findings)
+    purity.run(purity_modules, registry)
+    stats["traced_functions"] = purity.traced_functions
+    for s in suppressions:
+        if not s.used:
+            findings.append(Finding(
+                s.file, s.line, "suppression",
+                "suppression (%s) matched no finding — stale "
+                "exception, delete it" % s.check))
+    stats["suppressions"] = len(suppressions)
+    findings.sort(key=lambda f: (f.file, f.line))
+    return findings, suppressions, stats
+
+
+# ------------------------------------------------------------- record/CLI
+def summary_record(results):
+    """The bench.py-shaped streamed summary record (validated by
+    tools/check_stream_records.py builtin mode)."""
+    stats = results.get("stats", {}) if isinstance(results, dict) else {}
+    n = results.get("findings") if isinstance(results, dict) else None
+    return [{
+        "metric": "lint_findings",
+        "value": int(n) if n is not None else 0,
+        "unit": "count",
+        "vs_baseline": "0 on a clean tree (ISSUE 15 acceptance)",
+        "configs": {
+            "files": stats.get("files", 0),
+            "classes": stats.get("classes", 0),
+            "guarded_attrs": stats.get("guarded_attrs", 0),
+            "module_globals": stats.get("module_globals", 0),
+            "traced_functions": stats.get("traced_functions", 0),
+            "suppressions": stats.get("suppressions", 0),
+        },
+    }]
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description=__doc__.split("\n")[0])
+    parser.add_argument("--check", action="store_true",
+                        help="run the full-tree check (the default)")
+    parser.add_argument("--root", default=REPO,
+                        help="repository root (default: this repo)")
+    parser.add_argument("--list-suppressions", action="store_true",
+                        help="print every named suppression and exit")
+    args = parser.parse_args(argv)
+    findings, suppressions, stats = run_check(args.root)
+    if args.list_suppressions:
+        for s in suppressions:
+            print("%s:%d: allow(%s): %s"
+                  % (s.file, s.line, s.check, s.reason))
+        return 0
+    for f in findings:
+        print("%s:%d: [%s] %s" % (f.file, f.line, f.check, f.message),
+              file=sys.stderr)
+    results = {"findings": len(findings), "stats": stats}
+    print(json.dumps(summary_record(results)[0]))
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -89,6 +89,17 @@ request, this package amortizes dispatch across concurrent clients.
   the per-op cost ledger INCREMENTALLY (``SpanTracer.live_ledger``,
   ``GET /ledger.json``) — same dedup-by-dispatch-id rows as
   ``tools/trace_report.py``, no export round-trip.
+- :mod:`veles_tpu.serving.lockcheck` — :class:`LockOrderWitness`
+  (ISSUE 15): the runtime half of the concurrency-analysis layer.
+  Serving locks are built through :func:`lockcheck.make_lock` /
+  :func:`lockcheck.make_condition` (one module-global None-check per
+  operation when unarmed); an armed witness records the per-thread
+  lock-acquisition graph, flags ordering cycles (potential deadlocks)
+  and locks held across device dispatches, with both stacks as
+  evidence.  Armed around the serving test suites by
+  ``tests/conftest.py``; the static half — which attribute needs
+  which lock, traced-purity of jitted bodies — is
+  ``tools/veles_lint.py`` (rides tier-1 as ``tests/test_lint.py``).
 - :mod:`veles_tpu.serving.slo` — :class:`SLOMonitor` (ISSUE 14):
   declarative objectives (availability, TTFT/decode-step latency,
   shed rate) evaluated as multi-window error-budget BURN RATES over
@@ -111,6 +122,8 @@ from veles_tpu.serving.batcher import (DeadlineExceeded, MicroBatcher,
 from veles_tpu.serving.faults import (FaultPlan, InjectedFault,
                                       InjectedHTTPError)
 from veles_tpu.serving.kv_pool import KVPagePool
+from veles_tpu.serving.lockcheck import (LockOrderViolation,
+                                         LockOrderWitness)
 from veles_tpu.serving.lm_engine import (LMEngine, RadixPrefixCache,
                                          prompt_bucket, propose_draft)
 from veles_tpu.serving.metrics import (ServingMetrics, get,
@@ -137,7 +150,8 @@ __all__ = ["MicroBatcher", "LMEngine", "RadixPrefixCache",
            "TimeSeriesStore", "SLOMonitor", "Objective",
            "telemetry_for", "runtime_probe",
            "decode_flops_per_token", "peak_flops_estimate",
-           "KVPagePool", "Router", "RouterMetrics", "HealthChecker",
+           "KVPagePool", "LockOrderViolation", "LockOrderWitness",
+           "Router", "RouterMetrics", "HealthChecker",
            "ModelManager", "ServingMetrics", "FaultPlan",
            "InjectedFault",
            "InjectedHTTPError", "NoLiveReplicas", "Overloaded",

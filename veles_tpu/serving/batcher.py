@@ -32,7 +32,7 @@ from concurrent.futures import Future
 import numpy
 
 from veles_tpu.logger import Logger
-from veles_tpu.serving import tracing
+from veles_tpu.serving import lockcheck, tracing
 from veles_tpu.serving.metrics import ServingMetrics
 
 
@@ -104,6 +104,17 @@ class MicroBatcher(Logger):
     request of each bucket pays the compile.
     """
 
+    #: lock-discipline map (ISSUE 15): handler threads vs the worker.
+    #: ``sample_shape`` and ``_dispatch_ewma`` are written by the
+    #: worker after a dispatch and read on the admission path, so they
+    #: ride the lock too.
+    _guarded_by = {
+        "_queue": "_cond",
+        "_stop": "_cond",
+        "_dispatch_ewma": "_cond",
+        "sample_shape": "_cond",
+    }
+
     def __init__(self, forward, max_batch=64, queue_depth=128,
                  batch_wait_s=0.002, deadline_s=2.0, sample_shape=None,
                  dtype=numpy.float32, metrics=None, name="predict",
@@ -130,7 +141,7 @@ class MicroBatcher(Logger):
         self.dtype = dtype
         self.metrics = metrics or ServingMetrics(name)
         self._queue = collections.deque()
-        self._cond = threading.Condition()
+        self._cond = lockcheck.make_condition("batcher._cond")
         self._thread = None
         self._stop = False
         #: EWMA of dispatch seconds — the Retry-After estimate
@@ -138,13 +149,15 @@ class MicroBatcher(Logger):
 
     # --------------------------------------------------------------- lifecycle
     def start(self):
-        if self.sample_shape is not None:
+        # lint: allow(lock-discipline): pre-start warmup — no worker thread exists yet
+        shape = self.sample_shape
+        if shape is not None:
             for b in self.buckets:
-                self.forward(numpy.zeros((b,) + self.sample_shape,
-                                         self.dtype))
+                self.forward(numpy.zeros((b,) + shape, self.dtype))
             self.debug("warmed %d batch buckets %s", len(self.buckets),
                        self.buckets)
-        self._stop = False
+        with self._cond:
+            self._stop = False
         self._thread = threading.Thread(target=self._worker, daemon=True,
                                         name="micro-batcher-%s" % self.name)
         self._thread.start()
@@ -289,6 +302,8 @@ class MicroBatcher(Logger):
             # the real fault-isolation path (fails the batch's clients,
             # never the worker)
             self._faults.fire("batcher.dispatch")
+        if lockcheck._witness is not None:
+            lockcheck._witness.dispatch("batcher.dispatch")
         x = numpy.concatenate([it.rows for it in items]) \
             if len(items) > 1 else items[0].rows
         outs = []
@@ -302,8 +317,11 @@ class MicroBatcher(Logger):
                 chunk = numpy.concatenate([chunk, pad])
             t0 = time.monotonic()
             out = numpy.asarray(self.forward(chunk))
-            self._dispatch_ewma = (0.8 * self._dispatch_ewma
-                                   + 0.2 * (time.monotonic() - t0))
+            with self._cond:
+                # the admission path reads this EWMA for Retry-After:
+                # the update must not race it (ISSUE 15 lint find)
+                self._dispatch_ewma = (0.8 * self._dispatch_ewma
+                                       + 0.2 * (time.monotonic() - t0))
             if self._tracer is not None:
                 # numpy.asarray above already forced the result — no
                 # extra fence needed on this path
@@ -319,9 +337,12 @@ class MicroBatcher(Logger):
                 real, queue_waits=[now - it.t_enq for it in items]
                 if lo == 0 else ())
         out = numpy.concatenate(outs) if len(outs) > 1 else outs[0]
-        if self.sample_shape is None:
-            # adopt the canonical shape only once the forward PROVED it
-            self.sample_shape = x.shape[1:]
+        with self._cond:
+            if self.sample_shape is None:
+                # adopt the canonical shape only once the forward
+                # PROVED it — under the lock: _admit's shape check
+                # reads it concurrently (ISSUE 15 lint find)
+                self.sample_shape = x.shape[1:]
         offset = 0
         for it in items:
             n = len(it.rows)
@@ -340,7 +361,11 @@ class MicroBatcher(Logger):
                     "request shed after %.3fs in queue (deadline %.3fs)"
                     % (time.monotonic() - it.t_enq, self.deadline_s)))
             if not items:
-                if self._stop:
+                with self._cond:
+                    # read under the lock (ISSUE 15 lint find): stop()
+                    # publishes the flag from another thread
+                    stopping = self._stop
+                if stopping:
                     return
                 continue
             try:

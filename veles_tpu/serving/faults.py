@@ -69,6 +69,8 @@ import time
 
 import numpy
 
+from veles_tpu.serving import lockcheck
+
 
 class InjectedFault(RuntimeError):
     """An exception the fault layer raised on purpose — never confusable
@@ -140,11 +142,20 @@ class FaultPlan:
 
     KINDS = ("error", "latency", "freeze")
 
+    #: lock-discipline map (ISSUE 15): rules/counters/RNG are touched
+    #: from every armed site's thread — one plan lock guards them all.
+    _guarded_by = {
+        "_rules": "_lock",
+        "_counts": "_lock",
+        "_fired": "_lock",
+        "_rng": "_lock",
+    }
+
     def __init__(self, seed=0):
         self._rules = {}        # site -> [_Rule]
         self._counts = {}       # site -> calls observed
         self._fired = {}        # site -> rules fired
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("faults._lock")
         self._rng = numpy.random.RandomState(seed)
         #: set by release(): every current AND future freeze is a no-op
         #: (teardown must always be able to thaw a wedged worker)

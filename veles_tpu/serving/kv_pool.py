@@ -45,6 +45,13 @@ class KVPagePool:
 
     SCRATCH = 0
 
+    #: ISSUE 15 annotation: the allocator is deliberately lock-free —
+    #: every mutation happens on the engine worker thread (the engine
+    #: lock is the module docstring's "single-threaded by design"
+    #: rule), so the per-token path pays no contention.  checkpoint()
+    #: documents the torn-read consequence for its best-effort reads.
+    _synchronized_externally = "LMEngine worker thread (single owner)"
+
     def __init__(self, num_pages, page_size):
         if num_pages < 1:
             raise ValueError("kv pool needs at least one page")

@@ -186,7 +186,7 @@ from concurrent.futures import Future
 import numpy
 
 from veles_tpu.logger import Logger
-from veles_tpu.serving import tracing
+from veles_tpu.serving import lockcheck, tracing
 from veles_tpu.serving.batcher import (DeadlineExceeded, Overloaded,
                                        PoolExhausted)
 from veles_tpu.serving.kv_pool import KVPagePool
@@ -460,6 +460,23 @@ class LMEngine(Logger):
     docstring.
     """
 
+    #: lock-discipline map (ISSUE 15, checked by tools/veles_lint.py):
+    #: the CROSS-THREAD state — client admission vs the worker loop —
+    #: lives under ``_cond``.  Everything else (_lanes, _free, _pos,
+    #: _last, _caches, _kv_pools, _page_tables, _pool, _trie,
+    #: _pool_blocked) is owned by the worker thread alone and is
+    #: deliberately NOT guarded (checkpoint() documents the torn-read
+    #: consequences for its best-effort pool section).
+    _guarded_by = {
+        "_queue": "_cond",
+        "_queued_tokens": "_cond",
+        "_queued_pages": "_cond",
+        "_journal": "_cond",
+        "_rid": "_cond",
+        "_pending_swap": "_cond",
+        "_stop": "_cond",
+    }
+
     def __init__(self, params, n_heads, max_len, slots=4, rope=False,
                  window=None, sinks=0, queue_depth=64, deadline_s=30.0,
                  metrics=None, name="lm", prefill_chunk=0,
@@ -690,7 +707,7 @@ class LMEngine(Logger):
         self._queued_tokens = 0
         self._queued_pages = 0
         self._pool_blocked = False
-        self._cond = threading.Condition()
+        self._cond = lockcheck.make_condition("lm_engine._cond")
         self._thread = None
         self._stop = False
         #: admission journal (ISSUE 10): rid -> _Request for every
@@ -705,9 +722,14 @@ class LMEngine(Logger):
     # ----------------------------------------------------------- placement
     def _fault(self, site):
         """Fault-injection hook (ISSUE 10): free when no plan is
-        attached — one attribute-is-None check on the hot path."""
+        attached — one attribute-is-None check on the hot path.  The
+        lock-order witness (ISSUE 15) piggybacks here: every dispatch-
+        class site doubles as a lock-held-across-dispatch probe, one
+        module-global None-check when unarmed."""
         if self._faults is not None:
             self._faults.fire(site)
+        if lockcheck._witness is not None:
+            lockcheck._witness.dispatch(site)
 
     # ------------------------------------------------------------- tracing
     def _tfence(self, state, traced=True):
@@ -719,6 +741,8 @@ class LMEngine(Logger):
         sampled fraction, and the unarmed path never syncs."""
         if self._tracer is not None and traced:
             import jax
+            if lockcheck._witness is not None:
+                lockcheck._witness.dispatch("engine.fence")
             jax.block_until_ready(state)
 
     def _trace_admitted(self, req):
@@ -1276,7 +1300,8 @@ class LMEngine(Logger):
                     self.params, self._caches,
                     jnp.zeros(self.slots, jnp.int32),
                     jnp.ones(self.slots, jnp.int32))
-        self._stop = False
+        with self._cond:
+            self._stop = False
         self._thread = threading.Thread(target=self._worker, daemon=True,
                                         name="lm-engine-%s" % self.name)
         self._thread.start()
@@ -1394,6 +1419,7 @@ class LMEngine(Logger):
         drain mode re-queues them whole first.  The apply itself is a
         pointer assignment — the tree was placed on the caller's
         thread."""
+        # lint: allow(lock-discipline): racy worker peek; claim re-checked under _cond
         swap = self._pending_swap
         if swap is None:
             return
@@ -1815,6 +1841,7 @@ class LMEngine(Logger):
         queue head (FIFO — retried next tick as lanes free pages, shed
         at its deadline) instead of wedging or being skipped."""
         import jax.numpy as jnp
+        # lint: allow(lock-discipline): racy worker peek; _maybe_apply_swap claims under _cond
         if self._pending_swap is not None:
             # a finish-on-old swap is quiescing: admitting now would
             # extend old-weights serving indefinitely — the queue

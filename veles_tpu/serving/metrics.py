@@ -23,6 +23,8 @@ import collections
 import threading
 import time
 
+from veles_tpu.serving import lockcheck
+
 #: default histogram bucket bounds (seconds) for queue-wait / latency
 TIME_BOUNDS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
                0.5, 1.0, 2.5, 5.0, 10.0)
@@ -110,12 +112,27 @@ class ServingMetrics:
     labels=)`` / ``inc(..., labels=)`` — the router's per-replica
     placement counters ride that path."""
 
+    #: lock-discipline map (ISSUE 15): every counter, histogram, gauge
+    #: and the latency reservoir — recorded from serving threads, read
+    #: by snapshots/renderers/the telemetry sampler — lives under the
+    #: one instance lock.
+    _guarded_by = {
+        "requests": "_lock", "responses": "_lock",
+        "rejected": "_lock", "shed": "_lock", "errors": "_lock",
+        "dispatches": "_lock", "rows": "_lock",
+        "queue_wait": "_lock", "batch_size": "_lock",
+        "latency": "_lock", "ttft": "_lock", "decode_step": "_lock",
+        "counters": "_lock", "gauges": "_lock", "ewmas": "_lock",
+        "_recent": "_lock", "_labeled_gauges": "_lock",
+        "_labeled_counters": "_lock",
+    }
+
     def __init__(self, name="serving", latency_window=4096, labels=None):
         self.name = name
         #: constant instance-level labels rendered on every sample
         self.labels = {str(k): str(v)
                        for k, v in (labels or {}).items()}
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("metrics._lock")
         #: counters
         self.requests = 0        # admitted into a queue
         self.responses = 0       # completed successfully
@@ -215,6 +232,7 @@ class ServingMetrics:
                 self.counters[name] = self.counters.get(name, 0) + n
 
     def _ewma(self, name, value, alpha=0.2):
+        # caller-holds: _lock
         prev = self.ewmas.get(name)
         self.ewmas[name] = value if prev is None \
             else (1.0 - alpha) * prev + alpha * value
@@ -401,7 +419,7 @@ class ServingMetrics:
 
 
 # ------------------------------------------------------------------ registry
-_registry = {}
+_registry = {}   # guarded-by: _registry_lock
 _registry_lock = threading.Lock()
 
 

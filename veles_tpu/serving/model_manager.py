@@ -107,6 +107,14 @@ class ModelManager(Logger):
     from the fleet's current ``weights_version``; a rolled-back
     deploy burns its number (the gauge history stays monotone)."""
 
+    #: ISSUE 15 annotation: the manager holds no lock by design — its
+    #: mutable state (_seen, _version, last_record) is owned by the
+    #: poller thread (or the test driving ``poll_once()`` with the
+    #: thread stopped); the deploy/swap targets do their own locking.
+    _synchronized_externally = \
+        "publisher poller thread (single owner; poll_once() callers " \
+        "must hold the thread stopped)"
+
     def __init__(self, target, model_dir, interval_s=5.0, canary=1,
                  canary_fraction=0.25, watch_s=0.0, auto_rollback=True,
                  drain=False, prefix=None, load=None, validate=None,

@@ -105,7 +105,7 @@ from concurrent.futures import Future
 import numpy
 
 from veles_tpu.logger import Logger
-from veles_tpu.serving import tracing
+from veles_tpu.serving import lockcheck, tracing
 from veles_tpu.serving.batcher import Overloaded
 from veles_tpu.serving.metrics import ServingMetrics
 
@@ -233,6 +233,27 @@ class Router(Logger):
 
     POLICIES = ("metrics", "round_robin")
 
+    #: lock-discipline map (ISSUE 15): placement state is touched by
+    #: client threads, engine-worker completion callbacks, retry
+    #: timers, the hedge loop and the health checker — everything
+    #: shared lives under ``_lock``.  ``_deploy_lock`` serializes
+    #: whole deploys and guards no attributes.  Job/attempt fields
+    #: (job.live, job.delivered) are guarded by ``_lock`` too —
+    #: documented on _Job, enforced by review (the pass is per-class
+    #: attribute scoped).
+    _guarded_by = {
+        "_live": "_lock",
+        "_routed": "_lock",
+        "_pending": "_lock",
+        "_jobs": "_lock",
+        "_timers": "_lock",
+        "_stopping": "_lock",
+        "_rr": "_lock",
+        "_canary": "_lock",
+        "_canary_fraction": "_lock",
+        "_rng": "_lock",
+    }
+
     def __init__(self, replicas, metrics=None, name="lm_router",
                  policy="metrics", retries=0, retry_backoff_s=0.05,
                  retry_backoff_cap_s=2.0, hedge_after_s=0.0,
@@ -264,7 +285,7 @@ class Router(Logger):
         self._pending = [set() for _ in replicas]
         self._jobs = set()              # outstanding (hedge scan set)
         self._timers = set()            # pending retry timers
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("router._lock")
         self._rng = numpy.random.RandomState(seed)
         self._rr = 0
         self._stopping = False
@@ -275,7 +296,7 @@ class Router(Logger):
         #: probability and the rest of the fleet otherwise
         self._canary = frozenset()
         self._canary_fraction = 0.0
-        self._deploy_lock = threading.Lock()
+        self._deploy_lock = lockcheck.make_lock("router._deploy_lock")
         self.metrics.set_gauge("replicas_total", len(replicas))
         self.metrics.set_gauge("replicas_live", len(replicas))
         for i in range(len(replicas)):
@@ -1253,9 +1274,30 @@ class HealthChecker(Logger):
 
     ``step()`` is public and synchronous: tests and the chaos harness
     drive the state machine deterministically without the thread;
-    ``start()`` runs it every ``interval_s`` in the background."""
+    ``start()`` runs it every ``interval_s`` in the background.
+
+    THREADING (ISSUE 15): the prober thread is not alone — the SLO
+    monitor's ``note_slo_page`` / ``note_slo_ok`` hooks arrive on the
+    telemetry sampler thread, and ``states()`` is read by deploy
+    watches on theirs.  The circuit state (``_state``, ``_fails``,
+    ``_slo_fails``, ``_cooldown``, ``_reopen_at``) therefore lives
+    under ``_lock``; probes and quarantine side effects (router
+    drains) run OUTSIDE it, so the lock is never held across an
+    engine submit or the router's own lock any longer than a state
+    read.  The progress clocks (``_last_progress``, ``_last_counts``,
+    ``_warmed``) stay unguarded: they are touched only by the prober
+    thread (or the test driving ``step()`` by hand in its place)."""
 
     HEALTHY, OPEN, HALF_OPEN = 0, 1, 2
+
+    #: lock-discipline map (ISSUE 15, tools/veles_lint.py)
+    _guarded_by = {
+        "_state": "_lock",
+        "_fails": "_lock",
+        "_slo_fails": "_lock",
+        "_cooldown": "_lock",
+        "_reopen_at": "_lock",
+    }
 
     def __init__(self, router, interval_s=1.0, probe_timeout_s=5.0,
                  fail_threshold=3, cooldown_s=5.0, cooldown_cap_s=60.0,
@@ -1275,6 +1317,7 @@ class HealthChecker(Logger):
         self.probe_token = int(probe_token)
         n = len(router.replicas)
         now = time.monotonic()
+        self._lock = lockcheck.make_lock("health._lock")
         self._state = [self.HEALTHY] * n
         self._fails = [0] * n
         self._cooldown = [self.cooldown_s] * n
@@ -1359,16 +1402,19 @@ class HealthChecker(Logger):
     # ----------------------------------------------------------- the check
     def states(self):
         """Per-replica circuit state (the gauge's source of truth)."""
-        return list(self._state)
+        with self._lock:
+            return list(self._state)
 
     def step(self, now=None):
         """One synchronous scan of every replica (see the class
         docstring for the state machine)."""
         now = time.monotonic() if now is None else now
         for i, engine in enumerate(self.router.replicas):
-            state = self._state[i]
+            with self._lock:
+                state = self._state[i]
+                reopen_at = self._reopen_at[i]
             if state == self.OPEN:
-                if now >= self._reopen_at[i]:
+                if now >= reopen_at:
                     self._half_open_probe(i, engine, now)
                 continue
             if state == self.HALF_OPEN:
@@ -1396,12 +1442,15 @@ class HealthChecker(Logger):
                 failed = (now - self._last_progress[i]) > self.stall_s
             else:
                 failed = not self._probe(engine)
-            if failed:
-                self._fails[i] += 1
-                if self._fails[i] >= self.fail_threshold:
-                    self._quarantine(i, now)
-            else:
-                self._fails[i] = 0
+            with self._lock:
+                if failed:
+                    self._fails[i] += 1
+                    quarantine = self._fails[i] >= self.fail_threshold
+                else:
+                    self._fails[i] = 0
+                    quarantine = False
+            if quarantine:
+                self._quarantine(i, now)
 
     def note_slo_page(self, i, reason="slo page", now=None):
         """An EXTERNAL page-level signal against replica ``i`` — the
@@ -1420,19 +1469,27 @@ class HealthChecker(Logger):
         now = time.monotonic() if now is None else now
         if not 0 <= i < len(self.router.replicas):
             raise ValueError("no replica %r" % (i,))
-        if self._state[i] != self.HEALTHY:
-            return
+        with self._lock:
+            if self._state[i] != self.HEALTHY:
+                return
         with self.router._lock:
             router_live = self.router._live[i]
         if not router_live:
             return
         self.metrics.inc("slo_page_signals")
-        self._slo_fails[i] += 1
+        with self._lock:
+            # this hook runs on the TELEMETRY thread while step() runs
+            # on the prober's — the streak counter must not tear
+            # (ISSUE 15 lint find)
+            self._slo_fails[i] += 1
+            streak = self._slo_fails[i]
+            quarantine = streak >= self.fail_threshold
+            if quarantine:
+                self._slo_fails[i] = 0
         self.warning("replica %d: external SLO page signal (%s) — "
                      "%d/%d toward quarantine", i, reason,
-                     self._slo_fails[i], self.fail_threshold)
-        if self._slo_fails[i] >= self.fail_threshold:
-            self._slo_fails[i] = 0
+                     streak, self.fail_threshold)
+        if quarantine:
             self._quarantine(i, now)
 
     def note_slo_ok(self, i):
@@ -1440,8 +1497,9 @@ class HealthChecker(Logger):
         this for every mapped source NOT paging on a scan, so two
         pages separated by a healthy stretch never sum to a
         quarantine."""
-        if 0 <= i < len(self._slo_fails):
-            self._slo_fails[i] = 0
+        with self._lock:
+            if 0 <= i < len(self._slo_fails):
+                self._slo_fails[i] = 0
 
     def _probe(self, engine):
         """Synthetic 1-token decode against ``engine`` — bounded, and
@@ -1464,38 +1522,51 @@ class HealthChecker(Logger):
 
     # ------------------------------------------------------ state changes
     def _set_state(self, i, state):
-        self._state[i] = state
+        with self._lock:
+            self._state[i] = state
         self.metrics.set_gauge("replica_health_state", state,
                                labels={"replica": str(i)})
 
     def _quarantine(self, i, now):
-        self._fails[i] = 0
-        self._set_state(i, self.OPEN)
-        self._reopen_at[i] = now + self._cooldown[i]
+        with self._lock:
+            # CLAIM the transition: the prober's step() and the
+            # telemetry thread's note_slo_page() can both decide to
+            # quarantine in the same window — exactly one may act, or
+            # circuit_open_total double-counts one outage
+            if self._state[i] != self.HEALTHY:
+                return
+            self._state[i] = self.OPEN
+            self._fails[i] = 0
+            self._reopen_at[i] = now + self._cooldown[i]
+            cooldown = self._cooldown[i]
+        self.metrics.set_gauge("replica_health_state", self.OPEN,
+                               labels={"replica": str(i)})
         self.metrics.inc("circuit_open_total")
         self.warning("replica %d failed %d consecutive health checks: "
                      "circuit OPEN for %.1fs", i, self.fail_threshold,
-                     self._cooldown[i])
+                     cooldown)
         self.router.unregister(i, reason="health circuit open")
 
     def _half_open_probe(self, i, engine, now):
         self._set_state(i, self.HALF_OPEN)
         if self._probe(engine):
+            with self._lock:
+                self._cooldown[i] = self.cooldown_s
+                self._fails[i] = 0
+                self._slo_fails[i] = 0
             self._set_state(i, self.HEALTHY)
-            self._cooldown[i] = self.cooldown_s
-            self._fails[i] = 0
-            self._slo_fails[i] = 0
             self._last_counts[i] = None
             self._last_progress[i] = now
             self.info("replica %d passed the half-open probe: "
                       "re-registered", i)
             self.router.reregister(i)
         else:
-            self._cooldown[i] = min(self.cooldown_cap_s,
-                                    2 * self._cooldown[i])
+            with self._lock:
+                self._cooldown[i] = min(self.cooldown_cap_s,
+                                        2 * self._cooldown[i])
+                self._reopen_at[i] = now + self._cooldown[i]
+                cooldown = self._cooldown[i]
             self._set_state(i, self.OPEN)
-            self._reopen_at[i] = now + self._cooldown[i]
             self.metrics.inc("circuit_open_total")
             self.warning("replica %d failed the half-open probe: "
-                         "circuit re-OPEN for %.1fs", i,
-                         self._cooldown[i])
+                         "circuit re-OPEN for %.1fs", i, cooldown)

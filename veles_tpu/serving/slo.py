@@ -70,6 +70,7 @@ import json
 import threading
 
 from veles_tpu.logger import Logger
+from veles_tpu.serving import lockcheck
 from veles_tpu.serving.metrics import ServingMetrics, monotonic_offset
 
 KINDS = ("availability", "latency", "shed_rate")
@@ -158,6 +159,16 @@ class SLOMonitor(Logger):
     HealthChecker page hook (``source_replicas`` maps source key →
     replica index — built automatically by ``serve_lm``)."""
 
+    #: lock-discipline map (ISSUE 15): the state machine advances on
+    #: the sampler thread while ``/slo.json`` snapshots read from
+    #: handlers — state and last-eval rows move together under one
+    #: lock.
+    _guarded_by = {
+        "_state": "_lock",
+        "_last": "_lock",
+        "evaluations": "_lock",
+    }
+
     def __init__(self, store, objectives, windows_s=(60.0, 300.0),
                  warn_burn=1.0, page_burn=2.0, min_events=5,
                  sources=None, checker=None, source_replicas=None,
@@ -178,7 +189,7 @@ class SLOMonitor(Logger):
         self.checker = checker
         self.source_replicas = dict(source_replicas or {})
         self.metrics = metrics or ServingMetrics(name)
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("slo._lock")
         #: (source, objective) -> state
         self._state = {}
         self._last = {}          # (source, objective) -> last eval row

@@ -59,6 +59,7 @@ import threading
 import time
 
 from veles_tpu.logger import Logger
+from veles_tpu.serving import lockcheck
 from veles_tpu.serving.metrics import ServingMetrics, monotonic_offset
 
 #: advertised peak FLOPs by TPU device kind (bf16 matmul peak — the
@@ -278,6 +279,18 @@ class TimeSeriesStore(Logger):
     determinism); ``start()`` runs it every ``interval_s`` on a
     daemon thread."""
 
+    #: lock-discipline map (ISSUE 15): the rings and wiring lists are
+    #: read by endpoint snapshots and the SLO monitor while the
+    #: sampler thread folds — all under ``_lock``.  The error counters
+    #: (probe_errors, listener_errors) stay unguarded: they are
+    #: touched only on the sampling thread (or the test driving
+    #: ``sample_once()`` in its place).
+    _guarded_by = {
+        "_sources": "_lock", "_probes": "_lock",
+        "_listeners": "_lock", "_series": "_lock",
+        "samples": "_lock", "last_sample_wall_s": "_lock",
+    }
+
     def __init__(self, interval_s=1.0, capacity=600, name="telemetry"):
         self.name = name
         self.interval_s = float(interval_s)
@@ -287,7 +300,7 @@ class TimeSeriesStore(Logger):
         if self.capacity < 2:
             raise ValueError("capacity must be >= 2 (rates need two "
                              "points)")
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("timeseries._lock")
         self._sources = []               # (key, ServingMetrics)
         self._probes = []
         self._listeners = []
@@ -378,6 +391,7 @@ class TimeSeriesStore(Logger):
         return t
 
     def _ring(self, name, kind, bounds=None):
+        # caller-holds: _lock
         s = self._series.get(name)
         if s is None:
             s = self._series[name] = _Series(kind, self.capacity,
@@ -385,6 +399,7 @@ class TimeSeriesStore(Logger):
         return s
 
     def _fold(self, key, snap, t):
+        # caller-holds: _lock
         """One source snapshot into the rings (store lock held)."""
         for cname in ("requests", "responses", "rejected", "shed",
                       "errors", "dispatches", "rows"):
@@ -661,7 +676,7 @@ def telemetry_for(server, interval_s=1.0, capacity=600,
 
 
 # ------------------------------------------------------------ default store
-_default = None
+_default = None   # guarded-by: _default_lock
 _default_lock = threading.Lock()
 
 

@@ -77,6 +77,7 @@ import time
 import numpy
 
 from veles_tpu.logger import Logger
+from veles_tpu.serving import lockcheck
 
 _tls = threading.local()
 
@@ -178,6 +179,18 @@ class SpanTracer(Logger):
 
     MODES = ("all", "errors", "sample")
 
+    #: lock-discipline map (ISSUE 15): the span store is mutated from
+    #: every serving thread (handlers, workers, timers) — all of it
+    #: under the one tracer lock, including the seeded sampler RNG.
+    _guarded_by = {
+        "_sid": "_lock", "_did": "_lock", "_auto_rid": "_lock",
+        "_live": "_lock", "_ring": "_lock", "_dumps": "_lock",
+        "_events": "_lock", "_ledger_live": "_lock", "_rng": "_lock",
+        "started": "_lock", "finished": "_lock",
+        "sampled_out": "_lock", "dropped_spans": "_lock",
+        "dump_count": "_lock",
+    }
+
     def __init__(self, mode="all", sample=1.0, last=64, max_spans=4096,
                  seed=0, name="trace", clock=time.monotonic):
         if mode not in self.MODES:
@@ -189,7 +202,7 @@ class SpanTracer(Logger):
         self.max_spans = int(max_spans)
         self._clock = clock
         self._origin = clock()
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("tracing._lock")
         self._rng = numpy.random.RandomState(seed)
         self._sid = 0
         self._did = 0
@@ -380,6 +393,7 @@ class SpanTracer(Logger):
         return self.add_many((ctx,), name, cat, t0, t1, attrs)
 
     def _ledger_note(self, name, attrs, t0, t1, lanes):
+        # caller-holds: _lock
         """Fold one recorded dispatch into the live cost ledger
         (tracer lock held).  Mirrors :func:`cost_ledger` exactly: only
         device spans (a ``backend`` attr) count, one duration per
