@@ -133,6 +133,38 @@ _WITNESSED_SUITES = frozenset((
 ))
 
 
+#: suites the TRANSFER-GUARD witness (ISSUE 17) is armed around: the
+#: engine-worker hot path must only move data through the explicit
+#: xfer shims.  Arming is via serving/xfer.py module state — the
+#: engine worker thread enters ``jax.transfer_guard("disallow")``
+#: itself (JAX guard state is thread-local), so the armed suites catch
+#: implicit transfers exactly where they matter: inside the serving
+#: loop and warmup, not in test-helper host math.
+_TRANSFER_GUARDED_SUITES = frozenset((
+    "test_serving", "test_lm_fastpath", "test_kv_pool",
+))
+
+
+@pytest.fixture(autouse=True)
+def _transfer_guard_witness(request):
+    """Arm ``jax.transfer_guard("disallow")`` for the serving suites:
+    every LMEngine worker loop (and ``start()`` warmup) started during
+    the test runs under the guard, so an implicit device↔host
+    transfer on the hot path raises with the offending stack instead
+    of silently syncing."""
+    module = getattr(request.node, "module", None)
+    name = getattr(module, "__name__", "")
+    if name.rsplit(".", 1)[-1] not in _TRANSFER_GUARDED_SUITES:
+        yield
+        return
+    from veles_tpu.serving import xfer
+    xfer.arm("disallow")
+    try:
+        yield
+    finally:
+        xfer.disarm()
+
+
 @pytest.fixture(autouse=True)
 def _lock_order_witness(request):
     """Arm the serving lock-order witness for the serving suites: a

@@ -1,0 +1,45 @@
+"""Seeded host-sync violations (ISSUE 17).
+
+Hot-path methods (trailing ``# hot-path`` marker) committing every
+implicit-sync sin the pass knows: host coercion of a dispatched
+value, ``.item()`` readback, ``jnp`` staging of a dispatch argument,
+an un-fenced timing read, and a dispatch issued under a held lock.
+The sanctioned shapes (``xfer.to_host`` / ``xfer.to_device``) appear
+too and must NOT be flagged.
+"""
+
+import time
+
+import numpy
+
+from veles_tpu.serving import xfer
+
+
+class FakeEngine:
+    def _step(self, active):   # hot-path
+        toks = self._step_jit(active)
+        n = int(toks)                          # EXPECT-LINT host-sync
+        arr = numpy.asarray(toks)              # EXPECT-LINT host-sync
+        v = toks.item()                        # EXPECT-LINT host-sync
+        return n, arr, v
+
+    def _tick(self):   # hot-path
+        t0 = time.monotonic()
+        out = self._decode_jit(t0)
+        self.ewma = time.monotonic() - t0      # EXPECT-LINT host-sync
+        return out
+
+    def _stage(self, xs):   # hot-path
+        import jax.numpy as jnp
+        return self._step_jit(jnp.asarray(xs))   # EXPECT-LINT host-sync
+
+    def _locked(self, x):   # hot-path
+        with self._lock:
+            return self._step_jit(x)           # EXPECT-LINT host-sync
+
+    def _sanctioned(self, active):   # hot-path
+        toks = self._step_jit(xfer.to_device(active))
+        host = xfer.to_host(toks)
+        n = int(host)
+        self.metrics.observe(time.monotonic())
+        return n

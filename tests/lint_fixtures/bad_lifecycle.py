@@ -1,0 +1,55 @@
+"""Seeded resource-lifecycle violations (ISSUE 17).
+
+Escape-analysis seeds: a Future created and dropped on the floor, a
+tracer span and a page allocation resolved only in straight-line code
+after raisable calls (the PR 12 hedge-loser-span and PR 6 COW-leak
+classes).  The clean shapes — resolution owned by a finally/except,
+ownership handed off by storing/returning — must NOT be flagged.
+"""
+
+from concurrent.futures import Future
+
+
+def leak_future(work):
+    fut = Future()                   # EXPECT-LINT resource-lifecycle
+    work.do()
+    return None
+
+
+def exception_path_span(tracer, engine):
+    span = tracer.begin("decode.tick")   # EXPECT-LINT resource-lifecycle
+    engine.dispatch()
+    tracer.end(span)
+
+
+def exception_path_pages(pool, table):
+    pages = pool.alloc(4)            # EXPECT-LINT resource-lifecycle
+    table.install(7)
+    pool.release(pages)
+
+
+def clean_resolved_future(work):
+    fut = Future()
+    try:
+        fut.set_result(work.do())
+    except Exception as e:   # noqa: BLE001 — fixture
+        fut.set_exception(e)
+    return None
+
+
+def clean_span_finally(tracer, engine):
+    span = tracer.begin("decode.tick")
+    try:
+        engine.dispatch()
+    finally:
+        tracer.end(span)
+
+
+def clean_escape_by_handoff(tracer, lane):
+    span = tracer.begin("prefill.chunk")
+    lane.spans.append(span)
+
+
+def clean_escape_by_return(pool):
+    pages = pool.alloc(2)
+    return pages

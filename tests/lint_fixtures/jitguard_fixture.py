@@ -1,0 +1,12 @@
+"""Stand-in jit-guard fixture for the census cross-check (ISSUE 17).
+
+Asserts compile-count bounds for families ``step`` and ``orphan`` —
+neither of which ``bad_recompile.py``'s census declares — so both
+directions of the census↔fixture agreement check fire at the marked
+lines.
+"""
+
+
+def check_programs(engine):
+    assert engine._step_jit._cache_size() <= 2     # EXPECT-LINT recompile-hazard
+    assert engine._orphan_jit._cache_size() <= 1   # EXPECT-LINT recompile-hazard

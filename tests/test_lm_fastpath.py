@@ -107,7 +107,12 @@ FEATURE_SETS = [
     {"paged_kv": True, "prefill_chunk": 8},
     {"paged_kv": 12, "prefill_chunk": 8},
     {"paged_kv": True, "prefill_chunk": 8, "prefix_cache": 32},
-    {"paged_kv": True, "prefill_chunk": 8, "spec_k": 3},
+    # paged+chunk+spec WITHOUT the cache rides the slow suite: the
+    # full-stack superset two lines down keeps the same paths tier-1
+    # (the PR 3/8 watchdog-headroom discipline, renewed for ISSUE 17's
+    # armed-transfer-guard cost on this suite)
+    pytest.param({"paged_kv": True, "prefill_chunk": 8, "spec_k": 3},
+                 marks=pytest.mark.slow),
     {"paged_kv": True, "prefill_chunk": 8, "prefix_cache": 32,
      "spec_k": 3},
     # Pallas serving kernels (ISSUE 7): 'force' runs the REAL kernels
@@ -126,8 +131,12 @@ FEATURE_SETS = [
     # Skips loudly via the cached conftest probe on 1-device jaxlibs.
     {"tp": 2},
     {"tp": 2, "prefill_chunk": 8, "spec_k": 3},
-    {"tp": 2, "paged_kv": True, "prefill_chunk": 8, "prefix_cache": 32,
-     "spec_k": 3},
+    # the tp2 FULL paged stack rides the slow suite: tp2+chunk+spec
+    # above and the non-tp full stack keep both dimensions tier-1
+    # (watchdog-headroom discipline)
+    pytest.param({"tp": 2, "paged_kv": True, "prefill_chunk": 8,
+                  "prefix_cache": 32, "spec_k": 3},
+                 marks=pytest.mark.slow),
     {"tp": 2, "paged_kv": True, "prefill_chunk": 8,
      "attn_kernel": True},
 ]
@@ -690,6 +699,8 @@ class TestShardedDecode:
     kernel-fallback rule, device-slice pinning for replicas, and the
     validation surface."""
 
+    @pytest.mark.slow   # tp=2 legs keep sharded decode tier-1; the
+    # 4-way width re-proof pays 16s per run (watchdog-headroom)
     def test_tp4_mesh_full_fastpath_parity(self, serving_mesh,
                                            jit_guard):
         """4-way sharded decode with the whole fast path stacked
@@ -863,12 +874,18 @@ class TestRadixCache:
 
 
 #: ISSUE 13 parity matrix: K ∈ {1, 4, 8} × the fast-path features.
-#: Tier-1 keeps ONE representative per family (K=1 no-op, contiguous
-#: plain, the full paged+spec stack at K=8, tp=2, interpret kernels);
-#: redundant K × feature geometries ride the slow suite — the PR 3/8
-#: watchdog-headroom discipline.
+#: Tier-1 keeps ONE representative per family (contiguous plain, the
+#: full paged+spec stack at K=8, tp=2, interpret kernels; the K=1
+#: no-op family is pinned by test_validation_and_noop); redundant
+#: K × feature geometries ride the slow suite — the PR 3/8 watchdog-
+#: headroom discipline.
 MEGASTEP_SETS = [
-    (1, {"paged_kv": True, "prefill_chunk": 8, "spec_k": 3}),
+    # K=1 parity rides the slow suite: test_validation_and_noop pins
+    # K=1 == tick path (no fused program built), and the tick path's
+    # paged+chunk+spec parity is FastPathParity's full-stack leg —
+    # this entry re-proved both at 15s (watchdog-headroom discipline)
+    pytest.param(1, {"paged_kv": True, "prefill_chunk": 8,
+                     "spec_k": 3}, marks=pytest.mark.slow),
     (4, {}),
     (8, {"paged_kv": True, "prefill_chunk": 8, "prefix_cache": 32,
          "spec_k": 3}),

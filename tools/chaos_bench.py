@@ -892,6 +892,23 @@ def summary_record(results):
             "unit": None, "vs_baseline": None, "configs": results}, 1
 
 
+def run_lint_leg(results):
+    """The dispatch-hygiene assertion leg (ISSUE 17): every
+    ``tools/veles_lint.py`` pass over the shipped tree before the
+    chaos scenarios — resilience numbers for an engine whose hot path
+    regressed into an implicit host sync describe a different engine
+    than the one the repo ships.  Streams the bench-schema
+    ``lint_clean`` record and ASSERTS zero findings."""
+    import veles_lint
+    findings, _, stats = veles_lint.run_check()
+    record = veles_lint.clean_record(findings, stats)[0]
+    print(json.dumps(record), flush=True)
+    assert not findings, (
+        "lint_clean leg: %d finding(s) on the shipped tree — %s"
+        % (len(findings), "; ".join(str(f) for f in findings[:5])))
+    results["lint_clean"] = record["configs"]
+
+
 def run_bench(smoke=False, n_new=16, requests=12, seed=0):
     if smoke:
         n_new, requests = 8, 6
@@ -909,6 +926,9 @@ def run_bench(smoke=False, n_new=16, requests=12, seed=0):
         record, _ = summary_record(results)
         print(json.dumps(record), flush=True)
 
+    # lint_clean first (ISSUE 17): cheap, and a dirty tree should
+    # refuse the run before any scenario burns wall clock
+    run_lint_leg(results)
     results["kill_one_replica_under_load"] = scenario_kill_replica(
         params, n_heads, max_len, prompts, n_new, expect)
     stream()

@@ -247,8 +247,10 @@ def _builtin_records():
 
 
 def _lint_records():
-    """veles_lint's streamed record (ISSUE 15): the empty-results
-    worst case plus a populated run — no jax import, <1s."""
+    """veles_lint's streamed records (ISSUE 15/17): the empty-results
+    worst case, a populated run, and both faces of the bench-leg
+    ``lint_clean`` record (lm_bench/chaos_bench stream it before
+    their first real leg) — no jax import, <1s."""
     import veles_lint
     return [
         ("veles_lint.summary_record({})",
@@ -257,6 +259,10 @@ def _lint_records():
          veles_lint.summary_record(
              {"findings": 2, "stats": {"files": 11,
                                        "suppressions": 3}})[0]),
+        ("veles_lint.clean_record(clean)",
+         veles_lint.clean_record(0, {"files": 11, "wall_s": 0.5})[0]),
+        ("veles_lint.clean_record(dirty)",
+         veles_lint.clean_record(3, {"files": 11, "wall_s": 0.5})[0]),
     ]
 
 

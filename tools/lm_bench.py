@@ -542,6 +542,26 @@ def replica_scaling_comparison(params, n_heads, max_len, chunk, n_new,
     }
 
 
+def run_lint_leg(results):
+    """The dispatch-hygiene assertion leg (ISSUE 17): run every
+    ``tools/veles_lint.py`` pass over the shipped tree BEFORE the
+    serving legs — a hot path that regressed into an implicit host
+    sync or a silently-compiled twin program would make every number
+    below describe a slower engine than the one the repo ships, so
+    the bench refuses to report on a dirty tree.  Streams the
+    bench-schema ``lint_clean`` record (``check_stream_records.py
+    --tool veles_lint`` validates the shape) and ASSERTS zero
+    findings."""
+    import veles_lint
+    findings, _, stats = veles_lint.run_check()
+    record = veles_lint.clean_record(findings, stats)[0]
+    print(json.dumps(record), flush=True)
+    assert not findings, (
+        "lint_clean leg: %d finding(s) on the shipped tree — %s"
+        % (len(findings), "; ".join(str(f) for f in findings[:5])))
+    results["lint_clean"] = record["configs"]
+
+
 def bench_max_len(smoke):
     """THE bench max_len — main()'s --chunk divisibility pre-check and
     run_bench() must read the same value, or the check validates a
@@ -647,6 +667,10 @@ def run_bench(smoke=False, slots=4, chunk=16, cache=256, spec_k=4,
         record, _ = summary_record(results)
         print(json.dumps(record), flush=True)
 
+    # the lint_clean assertion leg first (ISSUE 17): cheap (<1s, no
+    # engine), and a dirty tree should refuse the run up front rather
+    # than after minutes of legs
+    run_lint_leg(results)
     # the single-lane repetitive workload ISOLATES speculation: with
     # one slot the baseline is exactly 1 dispatch/token, so any value
     # below 1 is the draft acceptance and nothing else (multi-slot

@@ -32,7 +32,7 @@ from concurrent.futures import Future
 import numpy
 
 from veles_tpu.logger import Logger
-from veles_tpu.serving import lockcheck, tracing
+from veles_tpu.serving import lockcheck, tracing, xfer
 from veles_tpu.serving.metrics import ServingMetrics
 
 
@@ -233,7 +233,7 @@ class MicroBatcher(Logger):
         return item
 
     # ------------------------------------------------------------------ worker
-    def _take_batch(self):
+    def _take_batch(self):   # hot-path
         """Pop a coalescible batch: the oldest request plus whatever else
         fits within max_batch, lingering ``batch_wait_s`` for stragglers
         while short.  Returns (items, expired) — expired are already past
@@ -284,7 +284,7 @@ class MicroBatcher(Logger):
             self.metrics.set_gauge("queue_depth", len(self._queue))
         return items, expired
 
-    def _dispatch(self, items):
+    def _dispatch(self, items):   # hot-path
         """Concatenate, pad to a bucket, forward ONCE, scatter rows back.
         A single oversized request (rows > max_batch) is chunked over
         several max_batch dispatches."""
@@ -316,15 +316,24 @@ class MicroBatcher(Logger):
                                   chunk.dtype)
                 chunk = numpy.concatenate([chunk, pad])
             t0 = time.monotonic()
-            out = numpy.asarray(self.forward(chunk))
+            # explicit boundary both ways (ISSUE 17): stage the padded
+            # chunk via device_put, read the result back via
+            # device_get — the old `numpy.asarray(self.forward(...))`
+            # was an implicit device→host sync (host-sync lint find).
+            # forward itself is USER code (a jitted model, or a plain
+            # host function) — its internal transfer policy is the
+            # user's, so it runs inside the declared xfer.boundary()
+            # while the batcher's own loop stays under the witness
+            with xfer.boundary():
+                out = xfer.to_host(self.forward(xfer.to_device(chunk)))
             with self._cond:
                 # the admission path reads this EWMA for Retry-After:
                 # the update must not race it (ISSUE 15 lint find)
                 self._dispatch_ewma = (0.8 * self._dispatch_ewma
                                        + 0.2 * (time.monotonic() - t0))
             if self._tracer is not None:
-                # numpy.asarray above already forced the result — no
-                # extra fence needed on this path
+                # xfer.to_host above already fenced the result — no
+                # extra block_until_ready needed on this path
                 self._tracer.add_many(
                     [it.trace for it in items], "batch.dispatch",
                     "batch", t0, time.monotonic(),
@@ -350,6 +359,12 @@ class MicroBatcher(Logger):
             offset += n
 
     def _worker(self):
+        # the transfer-guard witness is entered ON this thread (JAX
+        # guard state is thread-local); a null context when unarmed
+        with xfer.guard():
+            self._serve_batches()
+
+    def _serve_batches(self):   # hot-path
         while True:
             items, expired = self._take_batch()
             for it in expired:

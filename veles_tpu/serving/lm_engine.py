@@ -186,7 +186,7 @@ from concurrent.futures import Future
 import numpy
 
 from veles_tpu.logger import Logger
-from veles_tpu.serving import lockcheck, tracing
+from veles_tpu.serving import lockcheck, tracing, xfer
 from veles_tpu.serving.batcher import (DeadlineExceeded, Overloaded,
                                        PoolExhausted)
 from veles_tpu.serving.kv_pool import KVPagePool
@@ -778,7 +778,10 @@ class LMEngine(Logger):
                 params, lm_param_specs(params))
         if self._device is not None:
             return jax.device_put(params, self._device)
-        return params
+        # single-device default: an EXPLICIT one-time placement — host
+        # numpy weights left in place would re-transfer implicitly on
+        # every dispatch (and trip the armed transfer guard)
+        return jax.device_put(params)
 
     def _place_kv(self, arr):
         """Place one KV array per the engine's layout: head-sharded
@@ -863,10 +866,13 @@ class LMEngine(Logger):
         if self._mesh is not None:
             kv_tree, repl = self._out_shard_trees()
         step_all = jax.vmap(step_one, in_axes=(None, 0, 0, 0))
+        # programs: prefill
         self._prefill_jit = self._jit(
             prefill_one,
             (repl, kv_tree) if self._mesh is not None else None)
+        # programs: install
         self._install_jit = self._jit(install, kv_tree)
+        # programs: step
         self._step_jit = self._jit(
             step_all,
             (kv_tree, repl) if self._mesh is not None else None)
@@ -924,10 +930,13 @@ class LMEngine(Logger):
                                                   (slot, 0, start, 0)))
                     for (kc, vc), (rk, rv) in zip(caches, rows)]
 
+            # programs: chunk
             self._chunk_jit = self._jit(
                 chunk_slot,
                 (kv_tree, repl) if self._mesh is not None else None)
+            # programs: chunk_extract
             self._chunk_extract_jit = self._jit(chunk_extract, kv_tree)
+            # programs: chunk_install
             self._chunk_install_jit = self._jit(chunk_install, kv_tree)
 
         self._verify_jit = None
@@ -948,6 +957,7 @@ class LMEngine(Logger):
                 return [(kc[0], vc[0]) for kc, vc in rows], out
 
             verify_all = jax.vmap(verify_one, in_axes=(None, 0, 0, 0))
+            # programs: verify
             self._verify_jit = self._jit(
                 verify_all,
                 (kv_tree, repl) if self._mesh is not None else None)
@@ -1022,8 +1032,11 @@ class LMEngine(Logger):
         if self._mesh is not None:
             kv_tree, repl = self._out_shard_trees()
         pair = (kv_tree, repl) if self._mesh is not None else None
+        # programs: chunk
         self._chunk_jit = self._jit(chunk_slot, pair)
+        # programs: step
         self._step_jit = self._jit(step_all, pair)
+        # programs: page_copy
         self._page_copy_jit = self._jit(page_copy, kv_tree)
         self._prefill_jit = None
         self._install_jit = None
@@ -1042,6 +1055,7 @@ class LMEngine(Logger):
                 return pools, jnp.argmax(
                     logits, axis=-1).astype(jnp.int32)
 
+            # programs: verify
             self._verify_jit = self._jit(verify_all, pair)
 
         # decode megastep (ISSUE 13): the fused K-iteration program —
@@ -1065,6 +1079,7 @@ class LMEngine(Logger):
         n_out = 5 if self.spec_k else 4
         out_sh = ((kv_tree,) + (repl,) * (n_out - 1)
                   if self._mesh is not None else None)
+        # programs: megastep
         self._megastep_jit = self._jit(mega, out_sh)
 
     def _make_megastep_body(self, step_all=None, verify_all=None):
@@ -1116,14 +1131,14 @@ class LMEngine(Logger):
         # positions never reach it (admission reserves n_new + spec_k
         # headroom), and a finished lane's garbage verify window
         # [pos, pos+k] must stay inside [0, max_len)
-        cap = jnp.asarray(L - 1 - k, jnp.int32)
+        cap = xfer.to_device(L - 1 - k, numpy.int32)
 
         if k:
             from veles_tpu.ops.transformer import propose_draft_in_graph
             ngram = self.spec_ngram
             propose_all = jax.vmap(
                 lambda h, hl: propose_draft_in_graph(h, hl, k, ngram))
-            cols = jnp.arange(k + 1)[None, :]
+            cols = xfer.to_device(numpy.arange(k + 1)[None, :])
 
             def spec_iter(params, storage, ptabs, carry):
                 last, pos, left, hist, hlen = carry
@@ -1223,18 +1238,19 @@ class LMEngine(Logger):
             params, storage, None, last, pos, left)
 
     # --------------------------------------------------------------- lifecycle
-    def start(self):
-        import jax.numpy as jnp
-        # warm every program before traffic: the discarded warmup
-        # writes land at positions of free slots (paged: the scratch
-        # page) that the next prefill/chunk overwrites — or a live
-        # mask excludes — before they are ever attended
+    def _warmup(self):
+        """Compile every program family before traffic, with every
+        dispatch argument an explicit transfer (xfer shims) — the
+        first code to run under the armed transfer guard."""
+        zero = xfer.to_device(0, numpy.int32)
+        zeros = xfer.to_device(numpy.zeros(self.slots, numpy.int32))
         if self._paged:
-            zero = jnp.asarray(0, jnp.int32)
-            ptabs = jnp.zeros((self.slots, self._max_pages), jnp.int32)
+            ptabs = numpy.zeros((self.slots, self._max_pages),
+                                numpy.int32)
             self._kv_pools, _ = self._chunk_jit(
-                self.params, self._kv_pools, ptabs[0],
-                jnp.zeros(self.prefill_chunk, jnp.int32), zero, zero)
+                self.params, self._kv_pools, xfer.to_device(ptabs[0]),
+                xfer.to_device(numpy.zeros(self.prefill_chunk,
+                                           numpy.int32)), zero, zero)
             self._kv_pools = self._page_copy_jit(self._kv_pools, zero,
                                                  zero)
             # step/verify (or the fused megastep, which REPLACES them
@@ -1242,40 +1258,38 @@ class LMEngine(Logger):
             # ladder entry (ISSUE 7) — warm EVERY entry now, or the
             # first request to cross each width boundary pays its
             # compile inside the serving loop
-            zeros = jnp.zeros(self.slots, jnp.int32)
             for w in self._width_ladder:
+                wtab = xfer.to_device(ptabs[:, :w])
                 if self._megastep_jit is not None:
-                    args = [self.params, self._kv_pools, ptabs[:, :w],
+                    args = [self.params, self._kv_pools, wtab,
                             zeros, zeros, zeros]
                     if self.spec_k:
-                        args += [jnp.zeros((self.slots, self.max_len),
-                                           jnp.int32), zeros]
+                        args += [xfer.to_device(numpy.zeros(
+                            (self.slots, self.max_len), numpy.int32)),
+                            zeros]
                     out = self._megastep_jit(*args)
                     self._kv_pools = out[0]
                     continue
                 if self._verify_jit is not None:
                     self._kv_pools, _ = self._verify_jit(
-                        self.params, self._kv_pools, ptabs[:, :w],
-                        jnp.zeros((self.slots, self.spec_k + 1),
-                                  jnp.int32),
-                        jnp.zeros(self.slots, jnp.int32))
+                        self.params, self._kv_pools, wtab,
+                        xfer.to_device(numpy.zeros(
+                            (self.slots, self.spec_k + 1),
+                            numpy.int32)), zeros)
                 self._kv_pools, _ = self._step_jit(
-                    self.params, self._kv_pools, ptabs[:, :w],
-                    jnp.zeros(self.slots, jnp.int32),
-                    jnp.zeros(self.slots, jnp.int32))
+                    self.params, self._kv_pools, wtab, zeros, zeros)
         else:
             tok, rows = self._prefill_jit(
                 self.params,
-                jnp.zeros((1, prompt_bucket(1, self.max_len)),
-                          jnp.int32),
-                jnp.asarray(1, jnp.int32))
-            self._caches = self._install_jit(self._caches, rows,
-                                             jnp.asarray(0, jnp.int32))
+                xfer.to_device(numpy.zeros(
+                    (1, prompt_bucket(1, self.max_len)), numpy.int32)),
+                xfer.to_device(1, numpy.int32))
+            self._caches = self._install_jit(self._caches, rows, zero)
             if self._chunk_jit is not None:
-                zero = jnp.asarray(0, jnp.int32)
                 self._caches, _ = self._chunk_jit(
                     self.params, self._caches,
-                    jnp.zeros(self.prefill_chunk, jnp.int32), zero,
+                    xfer.to_device(numpy.zeros(self.prefill_chunk,
+                                               numpy.int32)), zero,
                     zero, zero)
                 crows = self._chunk_extract_jit(self._caches, zero,
                                                 zero)
@@ -1283,23 +1297,33 @@ class LMEngine(Logger):
                                                        crows, zero,
                                                        zero)
             if self._megastep_jit is not None:
-                zeros = jnp.zeros(self.slots, jnp.int32)
                 args = [self.params, self._caches, zeros, zeros, zeros]
                 if self.spec_k:
-                    args += [jnp.zeros((self.slots, self.max_len),
-                                       jnp.int32), zeros]
+                    args += [xfer.to_device(numpy.zeros(
+                        (self.slots, self.max_len), numpy.int32)),
+                        zeros]
                 self._caches = self._megastep_jit(*args)[0]
             else:
                 if self._verify_jit is not None:
                     self._caches, _ = self._verify_jit(
                         self.params, self._caches,
-                        jnp.zeros((self.slots, self.spec_k + 1),
-                                  jnp.int32),
-                        jnp.zeros(self.slots, jnp.int32))
+                        xfer.to_device(numpy.zeros(
+                            (self.slots, self.spec_k + 1),
+                            numpy.int32)), zeros)
                 self._caches, _ = self._step_jit(
-                    self.params, self._caches,
-                    jnp.zeros(self.slots, jnp.int32),
-                    jnp.ones(self.slots, jnp.int32))
+                    self.params, self._caches, zeros,
+                    xfer.to_device(numpy.ones(self.slots,
+                                              numpy.int32)))
+
+    def start(self):
+        # warm every program before traffic: the discarded warmup
+        # writes land at positions of free slots (paged: the scratch
+        # page) that the next prefill/chunk overwrites — or a live
+        # mask excludes — before they are ever attended.  Warmup runs
+        # under the transfer-guard witness (dispatch arguments built
+        # through the explicit xfer shims, like the worker loop).
+        with xfer.guard():
+            self._warmup()
         with self._cond:
             self._stop = False
         self._thread = threading.Thread(target=self._worker, daemon=True,
@@ -1830,7 +1854,7 @@ class LMEngine(Logger):
                 "pinned_pages": self._pool.pinned_pages}
 
     # ------------------------------------------------------------------ worker
-    def _admit(self):
+    def _admit(self):   # hot-path
         """Move queued prompts into free slots.  Feature-off requests
         (and chunked-ineligible ones) prefill whole at a power-of-two
         bucket as before; with ``prefill_chunk`` the lane only LOOKS UP
@@ -1840,7 +1864,6 @@ class LMEngine(Logger):
         when the pool cannot cover them the request goes BACK to the
         queue head (FIFO — retried next tick as lanes free pages, shed
         at its deadline) instead of wedging or being skipped."""
-        import jax.numpy as jnp
         # lint: allow(lock-discipline): racy worker peek; _maybe_apply_swap claims under _cond
         if self._pending_swap is not None:
             # a finish-on-old swap is quiescing: admitting now would
@@ -1906,10 +1929,12 @@ class LMEngine(Logger):
             try:
                 self._fault("engine.prefill")
                 tok, rows = self._prefill_jit(
-                    self.params, jnp.asarray(prompt[None], jnp.int32),
-                    jnp.asarray(req.true_len, jnp.int32))
+                    self.params,
+                    xfer.to_device(prompt[None], numpy.int32),
+                    xfer.to_device(req.true_len, numpy.int32))
                 self._caches = self._install_jit(
-                    self._caches, rows, jnp.asarray(slot, jnp.int32))
+                    self._caches, rows,
+                    xfer.to_device(slot, numpy.int32))
                 self._tfence(self._caches, req.trace is not None)
             except Exception as e:   # noqa: BLE001 — fails THIS request
                 # a prefill fault (bad bucket compile, device error)
@@ -1936,14 +1961,13 @@ class LMEngine(Logger):
                            "backend": self._backend})
             lane = _Slot(req)
             self._lanes[slot] = lane
-            self._emit_first(slot, lane, int(tok))
+            self._emit_first(slot, lane, int(xfer.to_host(tok)))
 
-    def _admit_chunked(self, slot, req):
+    def _admit_chunked(self, slot, req):   # hot-path
         """Chunked admission: match the prefix cache (full chunks only,
         never the chunk holding the last prompt token — the tail must
         run to produce the first token's logits), COPY hits into the
         lane's cache rows, and queue the rest as per-tick chunk work."""
-        import jax.numpy as jnp
         C = self.prefill_chunk
         n_full = (req.true_len - 1) // C
         self._trace_admitted(req)
@@ -1959,8 +1983,8 @@ class LMEngine(Logger):
                 for i, node in enumerate(nodes):
                     self._caches = self._chunk_install_jit(
                         self._caches, node.rows,
-                        jnp.asarray(slot, jnp.int32),
-                        jnp.asarray(i * C, jnp.int32))
+                        xfer.to_device(slot, numpy.int32),
+                        xfer.to_device(i * C, numpy.int32))
             except Exception as e:   # noqa: BLE001 — fails THIS request
                 self.metrics.record_error()
                 self.warning("prefix-cache install failed: %s", e)
@@ -1994,7 +2018,7 @@ class LMEngine(Logger):
         self._pos[slot] = lane.pending[0][1]
 
     # -------------------------------------------------------------- paged mode
-    def _admit_paged(self, slot, req):
+    def _admit_paged(self, slot, req):   # hot-path
         """Paged admission: reserve the lane's WORST-CASE page span up
         front (no mid-decode allocation, so decode can never deadlock
         on pages), with prefix-cache hits substituting page REFERENCES
@@ -2072,7 +2096,7 @@ class LMEngine(Logger):
                 pages = self._pool.alloc(n)
         return pages
 
-    def _cow_guard(self, slot, lane, lo, hi):
+    def _cow_guard(self, slot, lane, lo, hi):   # hot-path
         """COPY-ON-WRITE: before a device write covering linear
         positions [lo, hi), replace any SHARED page in that range with
         a private copy (one page-copy dispatch) so the other referents
@@ -2088,7 +2112,6 @@ class LMEngine(Logger):
         an exhausted lane and masks its writes to scratch), so pages
         past the reservation need no copy — and indexing them would be
         out of range."""
-        import jax.numpy as jnp
         P = self.prefill_chunk
         hi = min(hi, len(lane.pages) * P)
         if hi <= lo:
@@ -2105,8 +2128,8 @@ class LMEngine(Logger):
             try:
                 self._fault("engine.cow")
                 self._kv_pools = self._page_copy_jit(
-                    self._kv_pools, jnp.asarray(p, jnp.int32),
-                    jnp.asarray(q, jnp.int32))
+                    self._kv_pools, xfer.to_device(p, numpy.int32),
+                    xfer.to_device(q, numpy.int32))
                 self._tfence(self._kv_pools,
                              lane.request.trace is not None)
             except Exception:
@@ -2186,12 +2209,11 @@ class LMEngine(Logger):
                                else self._caches) for a in pair]
         return sum(a.size * a.dtype.itemsize for a in arrs)
 
-    def _advance_prefill(self, slot):
+    def _advance_prefill(self, slot):   # hot-path
         """Run ONE pending prompt chunk for this lane (a tick's worth of
         prefill — decode lanes step in between, so a long prompt never
         head-of-line-blocks them).  Computed full chunks feed the prefix
         cache; the tail chunk yields the first generated token."""
-        import jax.numpy as jnp
         lane = self._lanes[slot]
         req = lane.request
         if req.cancelled:
@@ -2216,8 +2238,8 @@ class LMEngine(Logger):
                 try:
                     self._caches = self._chunk_install_jit(
                         self._caches, node.rows,
-                        jnp.asarray(slot, jnp.int32),
-                        jnp.asarray(start, jnp.int32))
+                        xfer.to_device(slot, numpy.int32),
+                        xfer.to_device(start, numpy.int32))
                 except Exception as e:   # noqa: BLE001 — this request
                     self._trie.release([node])
                     self.metrics.record_error()
@@ -2241,15 +2263,15 @@ class LMEngine(Logger):
             self._fault("engine.chunk")
             self._caches, tok = self._chunk_jit(
                 self.params, self._caches,
-                jnp.asarray(tokens, jnp.int32),
-                jnp.asarray(slot, jnp.int32),
-                jnp.asarray(start, jnp.int32),
-                jnp.asarray(last_idx, jnp.int32))
+                xfer.to_device(tokens, numpy.int32),
+                xfer.to_device(slot, numpy.int32),
+                xfer.to_device(start, numpy.int32),
+                xfer.to_device(last_idx, numpy.int32))
             if not is_tail and self._trie is not None \
                     and lane.cursor is not None:
                 rows = self._chunk_extract_jit(
-                    self._caches, jnp.asarray(slot, jnp.int32),
-                    jnp.asarray(start, jnp.int32))
+                    self._caches, xfer.to_device(slot, numpy.int32),
+                    xfer.to_device(start, numpy.int32))
                 node = self._trie.insert(
                     lane.cursor, tuple(int(t) for t in tokens), rows)
                 if node is not None:
@@ -2276,6 +2298,7 @@ class LMEngine(Logger):
         self.metrics.inc("prefill_tokens",
                          (req.true_len - start) if is_tail
                          else len(tokens))
+        # lint: allow(host-sync): enqueue-time EWMA by design; device wall rides traced spans (_tfence)
         self.metrics.record_decode_step(time.monotonic() - t0)
         if req.trace is not None:
             req.trace.tracer.add(
@@ -2285,17 +2308,16 @@ class LMEngine(Logger):
                        "bucket": self.prefill_chunk,
                        "backend": self._backend})
         if is_tail:
-            self._emit_first(slot, lane, int(tok))
+            self._emit_first(slot, lane, int(xfer.to_host(tok)))
         else:
             self._pos[slot] = lane.pending[0][1]
 
-    def _advance_prefill_paged(self, slot, lane, req):
+    def _advance_prefill_paged(self, slot, lane, req):   # hot-path
         """One pending prompt chunk, paged: a LATE HIT swaps the lane's
         reserved page for a REFERENCE to the sibling's page (release
         one, retain the other — still zero device work); a computed
         full chunk SHARES the lane's own page with the trie (retain —
         the insert itself copies nothing)."""
-        import jax.numpy as jnp
         C = self.prefill_chunk
         tokens, start, is_tail = lane.pending.pop(0)
         page_idx = start // C
@@ -2333,10 +2355,10 @@ class LMEngine(Logger):
             self._cow_guard(slot, lane, start, start + C)
             self._kv_pools, tok = self._chunk_jit(
                 self.params, self._kv_pools,
-                jnp.asarray(self._page_tables[slot]),
-                jnp.asarray(tokens, jnp.int32),
-                jnp.asarray(start, jnp.int32),
-                jnp.asarray(last_idx, jnp.int32))
+                xfer.to_device(self._page_tables[slot]),
+                xfer.to_device(tokens, numpy.int32),
+                xfer.to_device(start, numpy.int32),
+                xfer.to_device(last_idx, numpy.int32))
             if not is_tail and self._trie is not None \
                     and lane.cursor is not None:
                 page = lane.pages[page_idx]
@@ -2369,6 +2391,7 @@ class LMEngine(Logger):
         self.metrics.inc("prefill_tokens",
                          (req.true_len - start) if is_tail
                          else len(tokens))
+        # lint: allow(host-sync): enqueue-time EWMA by design; device wall rides traced spans (_tfence)
         self.metrics.record_decode_step(time.monotonic() - t0)
         if req.trace is not None:
             req.trace.tracer.add(
@@ -2378,7 +2401,7 @@ class LMEngine(Logger):
                        "bucket": self.prefill_chunk, "paged": True,
                        "backend": self._backend})
         if is_tail:
-            self._emit_first(slot, lane, int(tok))
+            self._emit_first(slot, lane, int(xfer.to_host(tok)))
         else:
             self._pos[slot] = lane.pending[0][1]
 
@@ -2454,13 +2477,12 @@ class LMEngine(Logger):
         for slot in active:
             self._teardown_slot(slot, self._lanes[slot], exc)
 
-    def _step_plain(self, active):
+    def _step_plain(self, active):   # hot-path
         """ONE dispatch advances every active lane by one token;
         inactive lanes step too (their writes land at a frozen position
         that the next prefill/chunk overwrites before attending — see
         the module docstring), so the step program never respecializes
         on the active set."""
-        import jax.numpy as jnp
         if self._paged:
             active = self._cow_guard_active(active, 1)
             if not active:
@@ -2478,13 +2500,15 @@ class LMEngine(Logger):
                 w = self._live_width(1)
                 self._kv_pools, toks = self._step_jit(
                     self.params, self._kv_pools,
-                    jnp.asarray(self._page_tables[:, :w]),
-                    jnp.asarray(self._last), jnp.asarray(self._pos))
+                    xfer.to_device(self._page_tables[:, :w]),
+                    xfer.to_device(self._last),
+                    xfer.to_device(self._pos))
             else:
                 self._caches, toks = self._step_jit(
                     self.params, self._caches,
-                    jnp.asarray(self._last), jnp.asarray(self._pos))
-            toks = numpy.asarray(toks)
+                    xfer.to_device(self._last),
+                    xfer.to_device(self._pos))
+            toks = xfer.to_host(toks)
             self._tfence(self._kv_pools if self._paged
                          else self._caches,
                          any(c is not None for c in tctxs))
@@ -2516,7 +2540,7 @@ class LMEngine(Logger):
             if lane.remaining == 0 or lane.request.cancelled:
                 self._finish(slot)
 
-    def _step_speculative(self, active):
+    def _step_speculative(self, active):   # hot-path
         """ONE verify dispatch advances every active lane by 1..k+1
         tokens: each lane feeds [last, draft…] (draft = prompt-lookup
         n-gram continuation, zeros when none) and accepts the longest
@@ -2524,7 +2548,6 @@ class LMEngine(Logger):
         the correction/bonus token after it — bit-identical to plain
         greedy decode by construction, at < 1 dispatch/token whenever
         drafts hit."""
-        import jax.numpy as jnp
         k = self.spec_k
         if self._paged:
             active = self._cow_guard_active(active, k + 1)
@@ -2563,13 +2586,13 @@ class LMEngine(Logger):
                 w = self._live_width(k + 1)
                 self._kv_pools, out = self._verify_jit(
                     self.params, self._kv_pools,
-                    jnp.asarray(self._page_tables[:, :w]),
-                    jnp.asarray(toks_in), jnp.asarray(self._pos))
+                    xfer.to_device(self._page_tables[:, :w]),
+                    xfer.to_device(toks_in), xfer.to_device(self._pos))
             else:
                 self._caches, out = self._verify_jit(
-                    self.params, self._caches, jnp.asarray(toks_in),
-                    jnp.asarray(self._pos))
-            out = numpy.asarray(out)
+                    self.params, self._caches, xfer.to_device(toks_in),
+                    xfer.to_device(self._pos))
+            out = xfer.to_host(out)
             self._tfence(self._kv_pools if self._paged
                          else self._caches,
                          any(c is not None for c in tctxs))
@@ -2617,7 +2640,7 @@ class LMEngine(Logger):
             if lane.remaining == 0 or lane.request.cancelled:
                 self._finish(slot)
 
-    def _step_megastep(self, active):
+    def _step_megastep(self, active):   # hot-path
         """ONE fused dispatch advances every active lane by up to K
         tokens (up to K·(spec_k+1) speculative): the ``lax.scan``
         program from :meth:`_make_megastep_body`.  The host's only
@@ -2625,7 +2648,6 @@ class LMEngine(Logger):
         the BOUNDARY — admission, completion, deadline shedding, swap
         application and tracing all happen once per megastep, not per
         token, which is the whole point (ISSUE 13)."""
-        import jax.numpy as jnp
         K, k = self.megastep, self.spec_k
         # worst-case per-lane span this dispatch can write (the cow
         # guard and the live-width slice must cover every real write;
@@ -2652,7 +2674,7 @@ class LMEngine(Logger):
                      numpy.asarray(lane.emitted, numpy.int32)])
                 hist[slot, :len(row)] = row
                 hlen[slot] = len(row)
-            extra = (jnp.asarray(hist), jnp.asarray(hlen))
+            extra = (xfer.to_device(hist), xfer.to_device(hlen))
         w = None
         tctxs = ()
         if self._tracer is not None:
@@ -2664,19 +2686,20 @@ class LMEngine(Logger):
                 w = self._live_width(span)
                 out = self._megastep_jit(
                     self.params, self._kv_pools,
-                    jnp.asarray(self._page_tables[:, :w]),
-                    jnp.asarray(self._last), jnp.asarray(self._pos),
-                    jnp.asarray(left), *extra)
+                    xfer.to_device(self._page_tables[:, :w]),
+                    xfer.to_device(self._last),
+                    xfer.to_device(self._pos),
+                    xfer.to_device(left), *extra)
                 self._kv_pools = out[0]
             else:
                 out = self._megastep_jit(
-                    self.params, self._caches, jnp.asarray(self._last),
-                    jnp.asarray(self._pos), jnp.asarray(left), *extra)
+                    self.params, self._caches,
+                    xfer.to_device(self._last),
+                    xfer.to_device(self._pos),
+                    xfer.to_device(left), *extra)
                 self._caches = out[0]
-            last, pos, emitted = (numpy.asarray(out[1]),
-                                  numpy.asarray(out[2]),
-                                  numpy.asarray(out[3]))
-            accs = numpy.asarray(out[4]) if k else None
+            last, pos, emitted = xfer.to_host((out[1], out[2], out[3]))
+            accs = xfer.to_host(out[4]) if k else None
             self._tfence(self._kv_pools if self._paged
                          else self._caches,
                          any(c is not None for c in tctxs))
@@ -2781,6 +2804,12 @@ class LMEngine(Logger):
                 % (time.monotonic() - req.t_enq)))
 
     def _worker(self):
+        # the transfer-guard witness must be entered ON this thread
+        # (JAX guard state is thread-local); a null context unarmed
+        with xfer.guard():
+            self._serve_loop()
+
+    def _serve_loop(self):   # hot-path
         rr = 0
         while True:
             # per-tick fault site (latency spikes / replica freezes —

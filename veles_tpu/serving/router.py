@@ -455,7 +455,7 @@ class Router(Logger):
             raise
         return job.future
 
-    def _place(self, job, exclude=(), hedge=False):
+    def _place(self, job, exclude=(), hedge=False):   # hot-path
         """Place one attempt for ``job``.  ``exclude`` replicas are
         tried last (retry-on-a-different-replica) — or not at all when
         ``hedge`` (a duplicate on the same replica hedges nothing).
