@@ -385,3 +385,54 @@ class TestEngineLifecycle:
         finally:
             engine._step_jit = real_step
             engine.stop()
+
+    def test_standby_ring_faults_and_cancel_leak_no_pages(self):
+        """ISSUE 19: standby-ring occupants hold pool pages exactly
+        like lanes — a faulted standby prefill (the engine.chunk site
+        firing on a ring entry) and a cancelled occupant both return
+        their pages immediately, and after the traffic drains the
+        pool refills whole with the engine's cross-check clean."""
+        from veles_tpu.serving import FaultPlan, InjectedFault, LMEngine
+        params = _params(max_len=128)
+        # armed only after fa's admission prefill is observed, so the
+        # one-shot rule deterministically lands on fb's standby-ring
+        # prefill no matter how the serve loop interleaves ticks
+        plan = FaultPlan()
+        engine = LMEngine(params, n_heads=2, max_len=128, slots=1,
+                          megastep=4, megastep_mode="while",
+                          paged_kv=True, prefill_chunk=8,
+                          refill_ring=2, faults=plan,
+                          name="kv_ring").start()
+        real = engine._whilestep_jit
+
+        def slow(*a):
+            time.sleep(0.05)
+            return real(*a)
+
+        engine._whilestep_jit = slow
+        try:
+            fa = engine.submit([1, 2, 3], 24)    # occupies the slot
+            deadline = time.monotonic() + 30.0
+            while plan.calls("engine.chunk") < 1:
+                assert time.monotonic() < deadline
+                time.sleep(0.01)
+            plan.arm("engine.chunk", kind="error", times=1)
+            fb = engine.submit([2, 4, 6], 6)     # standby prefill faults
+            with pytest.raises(InjectedFault):
+                fb.result(timeout=60)
+            engine.verify_pool_invariants()      # fb's pages came back
+            fc = engine.submit([4, 4, 4], 6)     # ring-prefilled, then
+            engine._cancel(fc.request)           # withdrawn in the ring
+            assert len(fa.result(timeout=120)) == 24
+            deadline = time.monotonic() + 30.0
+            while engine.metrics.snapshot()["gauges"].get(
+                    "standby_ring_occupancy", 0):
+                assert time.monotonic() < deadline
+                time.sleep(0.01)
+            assert fc.cancelled()
+            assert engine._pool.pinned_pages == 0
+            assert engine._pool.free_pages == engine._pool.num_pages
+            engine.verify_pool_invariants()
+        finally:
+            engine._whilestep_jit = real
+            engine.stop()

@@ -67,6 +67,11 @@ def jit_guard():
                 # — ONE megastep program per (live-width ladder entry
                 # × K) family, K fixed per engine
                 progs["megastep"] = (engine._megastep_jit, widths)
+            if engine._whilestep_jit is not None:
+                # ISSUE 19: the while-loop megastep keeps the SAME
+                # bound — the iteration count is carry data, so early
+                # exit adds zero program variants
+                progs["whilestep"] = (engine._whilestep_jit, widths)
             for name, (fn, bound) in progs.items():
                 size = fn._cache_size()
                 assert size <= bound, (
@@ -86,6 +91,8 @@ def jit_guard():
             progs["verify"] = (engine._verify_jit, 1)
         if engine._megastep_jit is not None:
             progs["megastep"] = (engine._megastep_jit, 1)
+        if engine._whilestep_jit is not None:
+            progs["whilestep"] = (engine._whilestep_jit, 1)
         for name, (fn, bound) in progs.items():
             size = fn._cache_size()
             assert size <= bound, (
@@ -1087,6 +1094,382 @@ class TestMegastep:
             assert "xK4" in str(span["attrs"]["bucket"])
         finally:
             engine.stop()
+
+
+#: ISSUE 19 while-megastep matrix: one tier-1 representative per
+#: family (contiguous while, the full paged+chunk+cache+spec stack,
+#: the refill ring, tp=2); redundant K × feature geometries ride the
+#: slow suite (the PR 3/8 watchdog-headroom discipline).
+WHILESTEP_SETS = [
+    (4, {}),
+    (8, {"paged_kv": True, "prefill_chunk": 8, "prefix_cache": 32,
+         "spec_k": 3}),
+    (4, {"paged_kv": True, "prefill_chunk": 8, "refill_ring": 2}),
+    (4, {"tp": 2, "paged_kv": True, "prefill_chunk": 8, "spec_k": 3}),
+    pytest.param(4, {"prefill_chunk": 8}, marks=pytest.mark.slow),
+    pytest.param(8, {}, marks=pytest.mark.slow),
+    pytest.param(4, {"spec_k": 3}, marks=pytest.mark.slow),
+    pytest.param(8, {"paged_kv": True, "prefill_chunk": 8},
+                 marks=pytest.mark.slow),
+    pytest.param(8, {"paged_kv": True, "prefill_chunk": 8,
+                     "refill_ring": 2, "spec_k": 3},
+                 marks=pytest.mark.slow),
+    pytest.param(8, {"tp": 2, "paged_kv": True, "prefill_chunk": 8},
+                 marks=pytest.mark.slow),
+]
+
+
+class TestWhilestep:
+    """ISSUE 19: the persistent while-loop decode megastep — greedy
+    parity across the K × feature matrix (early exit must be invisible
+    in outputs), the one-program-per-ladder-entry compile bound,
+    realized-iteration early exit (the scan waste tail gone), in-graph
+    refill from the standby ring, ring deadline semantics (a
+    pre-prefilled request never 503s), and fault isolation including
+    ring occupants."""
+
+    @pytest.mark.parametrize("K,features", WHILESTEP_SETS,
+                             ids=lambda v: str(v) if isinstance(v, int)
+                             else "+".join(sorted(v)) or "plain")
+    def test_bit_identical_across_matrix(self, K, features, jit_guard,
+                                         serving_mesh):
+        """4 prompts through 2 slots (forced reuse) at while-megastep
+        cap K: output equals the direct greedy generate bit for bit,
+        and the jit cache holds the one-program-per-ladder-entry bound
+        — the realized iteration count is carry DATA, so early exit
+        adds zero variants."""
+        from veles_tpu.serving import LMEngine
+        if features.get("tp"):
+            serving_mesh(features["tp"])
+        params = _params()
+        prompts = [[1, 2, 3], [2, 4, 6, 8, 10], [7, 7],
+                   [5, 1, 5, 1, 5, 1, 5, 1, 5]]
+        n_new = 7
+        expected = [_greedy(params, p, n_new, 96) for p in prompts]
+        engine = LMEngine(params, n_heads=2, max_len=96, slots=2,
+                          megastep=K, megastep_mode="while",
+                          name="ws_par", **features).start()
+        try:
+            assert engine._whilestep_jit is not None
+            assert engine._megastep_jit is None
+            futures = [engine.submit(p, n_new) for p in prompts]
+            for p, f, exp in zip(prompts, futures, expected):
+                got = numpy.concatenate([p, f.result(timeout=300)])
+                numpy.testing.assert_array_equal(got, exp)
+            if features.get("prefill_chunk"):
+                buckets = 1
+            else:
+                from veles_tpu.serving import prompt_bucket
+                buckets = len({prompt_bucket(n, 96)
+                               for n in [1] + [len(p) for p in prompts]})
+            jit_guard(engine, prefill_buckets=buckets)
+            c = engine.metrics.snapshot()["counters"]
+            assert c["megastep_dispatches"] >= 1
+            assert c["decode_dispatches"] == c["megastep_dispatches"]
+        finally:
+            engine.stop()
+
+    def test_validation_and_alias(self):
+        from veles_tpu.serving import LMEngine
+        params = _params()
+        with pytest.raises(ValueError, match="megastep_mode"):
+            LMEngine(params, n_heads=2, max_len=96, slots=1,
+                     megastep=4, megastep_mode="unroll", name="ws_bad")
+        with pytest.raises(ValueError, match="iteration cap"):
+            LMEngine(params, n_heads=2, max_len=96, slots=1,
+                     megastep_mode="while", name="ws_cap")
+        with pytest.raises(ValueError, match="refill_ring"):
+            LMEngine(params, n_heads=2, max_len=96, slots=1,
+                     megastep=4, refill_ring=2, name="ws_ring")
+        # megastep='while' is the K=16 while-mode shorthand
+        alias = LMEngine(params, n_heads=2, max_len=96, slots=1,
+                         megastep="while", name="ws_alias")
+        assert alias.megastep == 16
+        assert alias.megastep_mode == "while"
+        assert alias._whilestep_jit is not None
+        assert alias._megastep_jit is None
+
+    def test_early_exit_kills_waste_tail(self):
+        """THE point of the while loop: a single lane with n_new far
+        under the cap exits after its realized iterations — zero
+        wasted lane iterations and a truthful `iters` span attr —
+        where the scan megastep at the same K burns the full fixed
+        window (the 0.225 waste record this PR retires)."""
+        from veles_tpu.serving import LMEngine, SpanTracer
+        params = _params(max_len=128)
+        prompt, n_new = [1, 2, 3], 6
+        tracer = SpanTracer(mode="all", last=16)
+        engine = LMEngine(params, n_heads=2, max_len=128, slots=1,
+                          megastep=16, megastep_mode="while",
+                          paged_kv=True, prefill_chunk=8,
+                          tracer=tracer, name="ws_exit").start()
+        try:
+            got = numpy.concatenate(
+                [prompt, engine.submit(prompt, n_new).result(timeout=120)])
+            numpy.testing.assert_array_equal(
+                got, _greedy(params, prompt, n_new, 128))
+            c = engine.metrics.snapshot()["counters"]
+            # prefill emits the first token; the loop exits after the
+            # remaining 5 — no masked tail up to K=16
+            assert c["megastep_dispatches"] == 1
+            assert c["megastep_tokens"] == n_new - 1
+            assert c["megastep_wasted_iterations"] == 0
+            assert c["megastep_lane_iterations"] == n_new - 1
+            span = next(s for r in tracer.requests()
+                        for s in r["spans"]
+                        if s["name"] == "decode.megastep")
+            assert span["attrs"]["K"] == 16
+            assert span["attrs"]["iters"] == n_new - 1
+        finally:
+            engine.stop()
+        scan = LMEngine(params, n_heads=2, max_len=128, slots=1,
+                        megastep=16, paged_kv=True, prefill_chunk=8,
+                        name="ws_scan").start()
+        try:
+            scan.submit(prompt, n_new).result(timeout=120)
+            sc = scan.metrics.snapshot()["counters"]
+            # the scan twin burns the whole fixed-K window
+            assert sc["megastep_lane_iterations"] == 16
+            assert sc["megastep_wasted_iterations"] == 16 - (n_new - 1)
+        finally:
+            scan.stop()
+
+    def test_refill_ring_rearm_in_graph(self):
+        """5 prompts through ONE slot with a 2-deep standby ring:
+        every output exactly greedy, at least one lane re-armed
+        inside the loop (megastep_refills > 0), the occupancy gauge
+        drains to zero and the pool closes leak-free."""
+        from veles_tpu.serving import LMEngine
+        params = _params(max_len=128)
+        prompts = [[1, 2, 3], [2, 4, 6, 8], [7, 7], [3, 1, 4, 1, 5],
+                   [9, 8, 7]]
+        n_new = 6
+        expected = [_greedy(params, p, n_new, 128) for p in prompts]
+        engine = LMEngine(params, n_heads=2, max_len=128, slots=1,
+                          megastep=8, megastep_mode="while",
+                          paged_kv=True, prefill_chunk=8,
+                          refill_ring=2, name="ws_ring").start()
+        try:
+            futures = [engine.submit(p, n_new) for p in prompts]
+            for p, f, exp in zip(prompts, futures, expected):
+                got = numpy.concatenate([p, f.result(timeout=300)])
+                numpy.testing.assert_array_equal(got, exp)
+            c = engine.metrics.snapshot()["counters"]
+            assert c["megastep_refills"] >= 1
+            g = engine.metrics.snapshot()["gauges"]
+            assert g["standby_ring_occupancy"] == 0
+            assert g["standby_ring_peak"] >= 1
+            summary = engine.verify_pool_invariants()
+            assert summary["used_pages"] == 0
+        finally:
+            engine.stop()
+
+    def test_ring_occupant_never_shed(self):
+        """DEADLINE SEMANTICS (ISSUE 19 fix): a request sitting
+        pre-prefilled in the standby ring past its deadline is
+        ADMITTED work — it must complete, never 503 — while a request
+        still in the queue sheds at the boundary with the shed window
+        quoted from the while-loop's iteration cap."""
+        import time as time_mod
+        from veles_tpu.serving import LMEngine
+        from veles_tpu.serving.batcher import DeadlineExceeded
+        params = _params(max_len=128)
+        engine = LMEngine(params, n_heads=2, max_len=128, slots=1,
+                          megastep=4, megastep_mode="while",
+                          paged_kv=True, prefill_chunk=8,
+                          refill_ring=1, deadline_s=0.35,
+                          name="ws_dead").start()
+        real = engine._whilestep_jit
+
+        def slow(*a):
+            time_mod.sleep(0.25)
+            return real(*a)
+
+        engine._whilestep_jit = slow
+        try:
+            fa = engine.submit([1, 2, 3], 12)     # occupies the slot
+            time_mod.sleep(0.05)
+            fb = engine.submit([4, 5, 6], 4)      # ring-prefilled
+            fc = engine.submit([6, 5, 4], 4)      # stays queued
+            assert len(fa.result(timeout=60)) == 12
+            # fb sat in the ring well past deadline_s — it finishes
+            assert len(fb.result(timeout=60)) == 4
+            with pytest.raises(DeadlineExceeded, match="window"):
+                fc.result(timeout=60)
+            assert engine.metrics.snapshot()["shed"] == 1
+        finally:
+            engine._whilestep_jit = real
+            engine.stop()
+
+    def test_fault_fails_participants_including_ring(self):
+        """CHAOS: an engine.step fault during a while-megastep with a
+        published standby-ring occupant fails exactly the
+        participating lanes — the decoding lane AND the ring occupant
+        — returns their pages leak-free, keeps sound span trees, and
+        the engine serves the next request exactly greedy."""
+        import time as time_mod
+        from veles_tpu.serving import FaultPlan, LMEngine, SpanTracer
+        from veles_tpu.serving.faults import InjectedFault
+        from veles_tpu.serving.tracing import verify_integrity
+        params = _params(max_len=128)
+        plan = FaultPlan()
+        tracer = SpanTracer(mode="all", last=32)
+        engine = LMEngine(params, n_heads=2, max_len=128, slots=1,
+                          megastep=4, megastep_mode="while",
+                          paged_kv=True, prefill_chunk=8,
+                          refill_ring=1, faults=plan, tracer=tracer,
+                          name="ws_chaos").start()
+        real = engine._whilestep_jit
+
+        def slow(*a):
+            time_mod.sleep(0.05)
+            return real(*a)
+
+        engine._whilestep_jit = slow
+        try:
+            fa = engine.submit([1, 2, 3], 40)
+            fb = engine.submit([2, 4, 6, 8], 6)
+            deadline = time_mod.monotonic() + 30.0
+            while not any(e.ready for e in engine._ring):
+                assert time_mod.monotonic() < deadline, \
+                    "standby entry never became ready"
+                time_mod.sleep(0.005)
+            plan.arm("engine.step", kind="error", times=1)
+            with pytest.raises(InjectedFault):
+                fa.result(timeout=60)
+            with pytest.raises(InjectedFault):
+                fb.result(timeout=60)
+            fc = engine.submit([9, 9, 9], 5)
+            got = numpy.concatenate([[9, 9, 9], fc.result(timeout=120)])
+            numpy.testing.assert_array_equal(
+                got, _greedy(params, [9, 9, 9], 5, 128))
+            summary = engine.verify_pool_invariants()
+            assert summary["used_pages"] == 0
+            recs = tracer.requests()
+            verify_integrity(recs)
+            errs = [r for r in recs if r["error"]]
+            assert len(errs) == 2
+            # the ring occupant's copy of the failed megastep span is
+            # marked standby — its timeline shows WHERE it died
+            assert any(s["name"] == "decode.megastep"
+                       and s["attrs"].get("standby")
+                       for r in errs for s in r["spans"])
+        finally:
+            plan.release()
+            engine._whilestep_jit = real
+            engine.stop()
+
+
+#: ISSUE 19 seeded-sampling parity matrix: every fast-path feature
+#: must sample the SAME token at the same (lane seed, position) —
+#: the counter-based prng stream is keyed by coordinates, not by how
+#: the engine happened to batch, chunk, speculate or fuse the step.
+#: tier-1 keeps one representative per family (chunk, scan-vs-while,
+#: paged, the full paged+spec while stack, the refill ring); the
+#: single-feature legs the supersets subsume ride the slow suite
+#: (watchdog-headroom discipline).
+SEEDED_SETS = [
+    {"prefill_chunk": 8},
+    {"megastep": 4},
+    {"megastep": 4, "megastep_mode": "while"},
+    {"paged_kv": True, "prefill_chunk": 8},
+    {"paged_kv": True, "prefill_chunk": 8, "spec_k": 3,
+     "megastep": 4, "megastep_mode": "while"},
+    {"paged_kv": True, "prefill_chunk": 8, "refill_ring": 2,
+     "megastep": 4, "megastep_mode": "while"},
+    pytest.param({"spec_k": 3}, marks=pytest.mark.slow),
+    pytest.param({"paged_kv": True, "prefill_chunk": 8,
+                  "prefix_cache": 32}, marks=pytest.mark.slow),
+]
+
+
+class TestSeededSampling:
+    """ISSUE 19: in-graph temperature/top-k sampling with
+    counter-based streams keyed by (lane seed, position) —
+    bit-reproducible given sample_seed, identical across the whole
+    fast-path matrix, and invisible when off (greedy stays the
+    default and stays bit-identical to generate)."""
+
+    SEED_KW = dict(temperature=0.8, top_k=5, sample_seed=123)
+
+    def _run(self, params, features, prompts, n_new,
+             name, seed_kw=None):
+        from veles_tpu.serving import LMEngine
+        engine = LMEngine(params, n_heads=2, max_len=96, slots=2,
+                          name=name, **dict(self.SEED_KW,
+                                            **(seed_kw or {})),
+                          **features).start()
+        try:
+            futures = [engine.submit(p, n_new) for p in prompts]
+            return [list(f.result(timeout=300)) for f in futures]
+        finally:
+            engine.stop()
+
+    @pytest.mark.parametrize("features", SEEDED_SETS,
+                             ids=lambda f: "+".join(sorted(f)))
+    def test_identical_across_fastpath_matrix(self, features):
+        """The per-tick engine with no features is the reference:
+        every feature combination must sample the identical
+        continuation for the same (sample_seed, submission order)."""
+        params = _params()
+        prompts = [[1, 2, 3], [2, 4, 6, 8, 10], [7, 7],
+                   [5, 1, 5, 1, 5, 1, 5, 1, 5]]
+        n_new = 7
+        ref = self._run(params, {}, prompts, n_new, "sd_ref")
+        got = self._run(params, features, prompts, n_new, "sd_leg")
+        assert got == ref
+
+    def test_tp2_identical(self, serving_mesh):
+        """The sharded engine samples the same tokens — the sampling
+        key is replicated data, not a per-device stream."""
+        serving_mesh(2)
+        params = _params()
+        prompts = [[1, 2, 3], [2, 4, 6, 8, 10]]
+        ref = self._run(params, {}, prompts, 6, "sd_tp_ref")
+        got = self._run(params, {"tp": 2}, prompts, 6, "sd_tp")
+        assert got == ref
+
+    def test_reproducible_and_seed_sensitive(self):
+        """Same seed → the identical stream on a FRESH engine; a
+        different seed → a different stream (the knob is live)."""
+        params = _params()
+        prompts = [[1, 2, 3], [4, 5, 6, 7]]
+        a = self._run(params, {}, prompts, 8, "sd_a")
+        b = self._run(params, {}, prompts, 8, "sd_b")
+        assert a == b
+        c = self._run(params, {}, prompts, 8, "sd_c",
+                      seed_kw={"sample_seed": 321})
+        assert c != a
+
+    def test_greedy_default_unchanged(self):
+        """temperature=0 (the default) must not even thread the key:
+        outputs stay bit-identical to generate and no sampling knob
+        leaks into the dispatch signature."""
+        from veles_tpu.serving import LMEngine
+        params = _params()
+        engine = LMEngine(params, n_heads=2, max_len=96, slots=2,
+                          megastep=4, megastep_mode="while",
+                          paged_kv=True, prefill_chunk=8,
+                          name="sd_greedy").start()
+        try:
+            assert engine._sample_key_host is None
+            p = [1, 2, 3]
+            got = numpy.concatenate(
+                [p, engine.submit(p, 7).result(timeout=120)])
+            numpy.testing.assert_array_equal(
+                got, _greedy(params, p, 7, 96))
+        finally:
+            engine.stop()
+
+    def test_sampling_validation(self):
+        from veles_tpu.serving import LMEngine
+        params = _params()
+        with pytest.raises(ValueError, match="sample_seed"):
+            LMEngine(params, n_heads=2, max_len=96, slots=1,
+                     temperature=0.8, name="sd_bad")
+        with pytest.raises(ValueError, match=">= 0"):
+            LMEngine(params, n_heads=2, max_len=96, slots=1,
+                     temperature=-1.0, sample_seed=1, name="sd_neg")
 
 
 class TestAdmissionTokenBudget:

@@ -83,3 +83,49 @@ def test_pinned_streams_survive_snapshot_restore():
     prng.reset()
     prng.load_state_dict(saved["streams"])
     assert prng.get("synth_data").initial_seed == 1
+
+
+def test_base_key_is_stateless():
+    """ISSUE 19: base_key never advances the counter — interleaved
+    key() calls by other consumers must not shift a counter-based
+    sampling stream."""
+    s = prng.RandomGenerator("sampler", 7)
+    a = numpy.asarray(s.base_key())
+    s.key()
+    s.key()
+    b = numpy.asarray(s.base_key())
+    numpy.testing.assert_array_equal(a, b)
+    # and key() itself still never repeats
+    assert not numpy.array_equal(numpy.asarray(s.key()),
+                                 numpy.asarray(s.key()))
+
+
+def test_key_at_deterministic_and_order_independent():
+    """key_at(lane, pos) is a pure function of the coordinates: the
+    same key whenever (and in whatever order) it is asked for — what
+    lets a fused device loop and a per-tick host loop sample
+    bit-identical tokens at the same (lane seed, position)."""
+    s = prng.RandomGenerator("sampler", 7)
+    grid = [(lane, pos) for lane in (0, 1, 5) for pos in (0, 3, 17)]
+    first = {c: numpy.asarray(s.key_at(*c)) for c in grid}
+    for c in reversed(grid):          # revisit in a different order
+        numpy.testing.assert_array_equal(
+            numpy.asarray(s.key_at(*c)), first[c])
+
+
+def test_key_at_independent_per_lane_and_position():
+    """Counter-stream independence: every (lane, position) coordinate
+    owns a distinct key, keys differ across stream seeds, and the
+    coordinate fold is order-sensitive (key_at(a, b) != key_at(b, a))."""
+    s = prng.RandomGenerator("sampler", 7)
+    keys = {}
+    for lane in range(4):
+        for pos in range(8):
+            keys[(lane, pos)] = tuple(
+                numpy.asarray(s.key_at(lane, pos)).tolist())
+    assert len(set(keys.values())) == len(keys)
+    assert keys[(1, 2)] != tuple(
+        numpy.asarray(s.key_at(2, 1)).tolist())
+    other = prng.RandomGenerator("sampler", 8)
+    assert tuple(numpy.asarray(other.key_at(1, 2)).tolist()) \
+        != keys[(1, 2)]

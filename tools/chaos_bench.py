@@ -870,6 +870,112 @@ def scenario_slo_burn_alert(params, n_heads, max_len, prompts, n_new,
         store.stop()
 
 
+def scenario_whilestep_fault(params, n_heads, max_len, prompts, n_new,
+                             expect):
+    """Mid-loop fault in the persistent while-megastep (ISSUE 19): a
+    single ``engine.step`` fault fired inside the while-loop dispatch
+    must fail EXACTLY the participants — the active lane AND the
+    published standby-ring occupant riding the same program — with
+    their pool pages home immediately, sound span trees for both, and
+    bit-exact greedy parity for every survivor served afterwards
+    through the same ring."""
+    from veles_tpu.serving import (FaultPlan, InjectedFault, LMEngine,
+                                   ServingMetrics, SpanTracer,
+                                   verify_integrity)
+
+    # max_len=64 / chunk=16 / slots=1 puts the DEFAULT paged pool at 4
+    # pages — exactly one lane, zero ring headroom, so standby entries
+    # would bounce forever on the all-or-nothing reservation.  Size the
+    # pool for the lane plus both ring occupants explicitly.
+    pool_pages = 3 * (max_len // 16)
+    plan = FaultPlan(seed=0)                  # armed mid-flight below
+    tracer = SpanTracer(mode="all", last=4 * len(prompts) + 16)
+    engine = LMEngine(params, n_heads=n_heads, max_len=max_len,
+                      slots=1, megastep=4, megastep_mode="while",
+                      paged_kv=pool_pages, prefill_chunk=16,
+                      refill_ring=2, faults=plan, tracer=tracer,
+                      metrics=ServingMetrics("chaos_whilestep"),
+                      name="chaos_whilestep").start()
+    real = engine._whilestep_jit
+
+    def slow(*a):
+        # hold each megastep open long enough that the ring occupant
+        # is published before the victim lane drains
+        time.sleep(0.05)
+        return real(*a)
+
+    engine._whilestep_jit = slow
+    t0 = time.monotonic()
+    try:
+        fa = engine.submit(prompts[0], max(n_new, 24))
+        fb = engine.submit(prompts[1], n_new)
+        deadline = time.monotonic() + 30.0
+        while not any(e.ready for e in engine._ring):
+            if time.monotonic() > deadline:
+                raise AssertionError(
+                    "standby-ring occupant never became ready")
+            time.sleep(0.005)
+        # fb is prefilled and published into every while-megastep now;
+        # the next dispatch carries both lanes and dies mid-loop
+        plan.arm("engine.step", kind="error", times=1)
+        for fut, who in ((fa, "active lane"), (fb, "ring occupant")):
+            try:
+                fut.result(timeout=60)
+            except InjectedFault:
+                continue
+            raise AssertionError(
+                "%s survived the mid-megastep fault" % who)
+        engine._whilestep_jit = real
+        inv = engine.verify_pool_invariants()  # pages home, cross-checked
+        if inv["used_pages"] != 0 or inv["pinned_pages"] != 0:
+            raise AssertionError(
+                "faulted participants leaked pages: %r" % (inv,))
+        survivors = [(p, engine.submit(p, n_new))
+                     for p in prompts[2:]]
+        for i, (p, f) in enumerate(survivors, start=2):
+            out = f.result(timeout=120)
+            if not numpy.array_equal(numpy.concatenate([p, out]),
+                                     expect[i]):
+                raise AssertionError(
+                    "survivor after the while-megastep fault diverged "
+                    "from greedy generate")
+    finally:
+        engine._whilestep_jit = real
+        plan.release()
+        engine.stop()
+    recs = tracer.requests()
+    verify_integrity(recs)                  # raises on a broken tree
+    errs = [r for r in recs
+            if r["error"] and "InjectedFault" in r["error"]]
+    if len(errs) != 2:
+        raise AssertionError(
+            "one engine.step fault must fail exactly the 2 "
+            "participants, got %d errored traces" % len(errs))
+    for r in errs:
+        if not any(s["name"] == "decode.megastep"
+                   and "error" in s["attrs"] for s in r["spans"]):
+            raise AssertionError(
+                "a faulted participant's trace is missing the errored "
+                "decode.megastep span")
+    snap = engine.metrics.snapshot()
+    if engine._pool.free_pages != engine._pool.num_pages:
+        raise AssertionError("pool did not refill whole after drain")
+    return {
+        "scenario": "whilestep_fault",
+        "requests": len(prompts),
+        "faulted_participants": len(errs),
+        "survivor_parity_vs_generate": True,
+        "pool_pages": pool_pages,
+        "pages_leaked": 0,
+        "standby_ring_peak": int(
+            snap["gauges"].get("standby_ring_peak", 0)),
+        "megastep_refills": int(
+            snap["counters"].get("megastep_refills", 0)),
+        "span_trees_sound": True,
+        "wall_s": round(time.monotonic() - t0, 3),
+    }
+
+
 # ------------------------------------------------------------------- bench
 def summary_record(results):
     """(record, exit_code) in the bench.py shape — metric priority in
@@ -879,13 +985,14 @@ def summary_record(results):
                         "weight_swap_under_load",
                         "traced_flight_recorder",
                         "slo_burn_alert",
+                        "whilestep_fault",
                         "fault_free_overhead") if k in results]
     if done:
         return {
             "metric": "chaos_scenarios_passed",
             "value": len(done),
             "unit": "scenarios",
-            "vs_baseline": 7,
+            "vs_baseline": 8,
             "configs": results,
         }, 0
     return {"metric": "chaos_no_scenarios_completed", "value": None,
@@ -952,6 +1059,10 @@ def run_bench(smoke=False, n_new=16, requests=12, seed=0):
     stream()
     results["slo_burn_alert"] = scenario_slo_burn_alert(
         params, n_heads, max_len, prompts[:max(4, requests // 2)],
+        n_new, expect)
+    stream()
+    results["whilestep_fault"] = scenario_whilestep_fault(
+        params, n_heads, max_len, prompts[:max(6, requests // 2)],
         n_new, expect)
     stream()
     results["fault_free_overhead"] = scenario_overhead(

@@ -518,6 +518,24 @@ def _make_sampler(greedy, top_k, temperature):
     return sample, next_key
 
 
+def sample_token(key, logits, temperature, top_k=0):
+    """Sample ONE token id from a ``(vocab,)`` (or batched ``(...,
+    vocab)``) logits row under the shared top-k/temperature rule of
+    :func:`_make_sampler` — the serving engine's in-graph seeded
+    sampling (ISSUE 19) calls this with a counter-derived key per
+    (lane seed, position), so a fused device loop, a per-tick loop and
+    :func:`generate` all draw the identical token given the same key.
+    ``temperature`` must be > 0 (greedy stays argmax, outside this)."""
+    import jax
+    import jax.numpy as jnp
+    lg = logits
+    if top_k:
+        vals = jax.lax.top_k(lg, top_k)[0]
+        lg = jnp.where(lg >= vals[..., -1:], lg, NEG_INF_LOGIT)
+    return jax.random.categorical(key, lg / temperature,
+                                  axis=-1).astype(jnp.int32)
+
+
 def _generate_impl(params, prompt, rng, temperature, true_len, n_new,
                    n_heads, greedy, max_len, top_k, rope, window,
                    sinks):

@@ -79,6 +79,30 @@ class RandomGenerator:
             jax.random.PRNGKey(self._derived_seed % (2 ** 63)),
             self._key_counter)
 
+    def base_key(self):
+        """Stateless root ``jax.random`` key for counter-based streams:
+        unlike :meth:`key` it never advances ``_key_counter``, so a
+        consumer deriving per-coordinate keys via :meth:`key_at` is
+        reproducible independently of how many :meth:`key` calls other
+        code made."""
+        import jax  # deferred so host-only code paths never touch jax
+
+        return jax.random.PRNGKey(self._derived_seed % (2 ** 63))
+
+    def key_at(self, *coords):
+        """Counter-based key at integer coordinates — fold each coord
+        into :meth:`base_key` in order.  Deterministic and call-order
+        independent: ``key_at(lane, pos)`` is the same key whenever it
+        is asked for, which is what lets a fused device loop and a
+        per-tick host loop sample bit-identical tokens at the same
+        (lane seed, position)."""
+        import jax
+
+        key = self.base_key()
+        for c in coords:
+            key = jax.random.fold_in(key, int(c))
+        return key
+
     # -- snapshot support ----------------------------------------------------
     def state_dict(self):
         return {"seed": self._seed, "numpy_state": self.state.get_state(),

@@ -195,7 +195,8 @@ from veles_tpu.serving.metrics import ServingMetrics
 
 class _Request:
     __slots__ = ("prompt", "true_len", "n_new", "future", "t_enq",
-                 "deadline", "cancelled", "pages", "trace", "tspan")
+                 "deadline", "cancelled", "pages", "trace", "tspan",
+                 "seed")
 
     def __init__(self, prompt, n_new, deadline_s, pages=0):
         self.prompt = prompt          # (s,) int32, unpadded
@@ -213,6 +214,10 @@ class _Request:
         #: attributes its dispatch spans to the right request
         self.trace = None
         self.tspan = None
+        #: seeded-sampling lane seed (ISSUE 19): the admission id —
+        #: deterministic per submission order, so the same workload
+        #: samples identically whatever engine configuration serves it
+        self.seed = 0
 
 
 class _Slot:
@@ -235,6 +240,27 @@ class _Slot:
         #: paged mode: page ids backing this lane's table row, in
         #: lane-local order (owned AND referenced; released at finish)
         self.pages = []
+
+
+class _Standby:
+    """One standby-ring entry (ISSUE 19): a host-prefilled lane parked
+    OUTSIDE the slot array, waiting to be published into the while-loop
+    megastep's carry so a finishing slot can be re-armed in-graph.  It
+    owns its pages (reserved and pinned like a live lane's) and its
+    request's first token is already delivered — the entry is admitted
+    work, never deadline-shed."""
+
+    __slots__ = ("lane", "table", "pos", "last", "ready")
+
+    def __init__(self, lane, table):
+        self.lane = lane
+        #: (max_pages,) int32 page-table row backing this entry
+        self.table = table
+        #: decode frontier after the tail prefill chunk
+        self.pos = 0
+        self.last = 0
+        #: tail chunk done — publishable into the megastep carry
+        self.ready = False
 
 
 def prompt_bucket(true_len, max_len, floor=16):
@@ -483,7 +509,9 @@ class LMEngine(Logger):
                  prefix_cache=0, spec_k=0, spec_ngram=3,
                  queue_tokens=0, paged_kv=0, attn_kernel=None,
                  tp=0, devices=None, faults=None, version=0,
-                 tracer=None, megastep=0):
+                 tracer=None, megastep=0, megastep_mode=None,
+                 refill_ring=0, temperature=0.0, top_k=0,
+                 sample_seed=None):
         import jax
         import jax.numpy as jnp
         if slots < 1:
@@ -563,11 +591,59 @@ class LMEngine(Logger):
             raise ValueError("spec_ngram must be >= 1")
         #: decode megastep (ISSUE 13): K >= 2 fuses K decode (or
         #: propose/verify) iterations into one lax.scan dispatch;
-        #: 0/1 = the per-tick path, bit-identical and unchanged
+        #: 0/1 = the per-tick path, bit-identical and unchanged.
+        #: ISSUE 19: megastep='while' (or megastep_mode='while') swaps
+        #: the fixed-K scan for a lax.while_loop whose cond exits as
+        #: soon as every live lane finished its n_new — K stays the
+        #: HARD iteration cap, so termination stays provable and the
+        #: program family stays one per live-width ladder entry.
+        if megastep == "while":
+            megastep, megastep_mode = 16, "while"
+        if megastep_mode not in (None, "scan", "while"):
+            raise ValueError("megastep_mode must be 'scan' or 'while' "
+                             "(got %r)" % (megastep_mode,))
         self.megastep = int(megastep or 0)
+        self.megastep_mode = megastep_mode or "scan"
         if self.megastep < 0:
             raise ValueError("megastep must be >= 0 (got %d)"
                              % self.megastep)
+        if self.megastep_mode == "while" and self.megastep < 2:
+            raise ValueError("megastep_mode='while' needs megastep >= 2 "
+                             "(the iteration cap)")
+        #: standby refill ring (ISSUE 19): host-prefilled lanes the
+        #: while-loop re-arms finishing slots from, in-graph
+        self.refill_ring = int(refill_ring or 0)
+        if self.refill_ring < 0:
+            raise ValueError("refill_ring must be >= 0 (got %d)"
+                             % self.refill_ring)
+        if self.refill_ring and not (self._paged and
+                                     self.megastep_mode == "while"):
+            raise ValueError("refill_ring needs paged_kv and "
+                             "megastep_mode='while' (the ring is "
+                             "published into the while-loop carry as "
+                             "page-table rows)")
+        #: in-graph seeded sampling (ISSUE 19): temperature > 0 samples
+        #: with counter-based prng streams keyed by (lane seed,
+        #: position); 0 keeps greedy argmax and byte-identical programs
+        self.temperature = float(temperature or 0.0)
+        self.top_k = int(top_k or 0)
+        if self.temperature < 0 or self.top_k < 0:
+            raise ValueError("temperature and top_k must be >= 0")
+        self._sampling = self.temperature > 0
+        if self._sampling and sample_seed is None:
+            raise ValueError("temperature > 0 needs sample_seed — "
+                             "seeded reproducibility is the contract")
+        self.sample_seed = (None if sample_seed is None
+                            else int(sample_seed))
+        self._sample_key_host = None
+        if self._sampling:
+            from veles_tpu.prng import RandomGenerator
+            # FIXED stream name: the key derivation folds the stream
+            # name into the seed, and sampled outputs must depend on
+            # sample_seed alone — never on what the engine (or its
+            # replica twin on another host) happens to be called
+            self._sample_key_host = numpy.asarray(RandomGenerator(
+                "lm-sample", self.sample_seed).base_key())
         if self._paged and self.max_len % self.prefill_chunk:
             # the paged lane view must tile max_len exactly: a partial
             # tail page would either truncate placeable rows or attend
@@ -702,6 +778,9 @@ class LMEngine(Logger):
         self._last = numpy.zeros(self.slots, numpy.int32)
         self._lanes = [None] * self.slots
         self._free = list(range(self.slots))
+        #: standby refill ring (ISSUE 19): _Standby entries prefilled
+        #: between boundaries, published into the while-loop carry
+        self._ring = []
 
         self._queue = collections.deque()
         self._queued_tokens = 0
@@ -813,6 +892,49 @@ class LMEngine(Logger):
         kv_pair = (self._kv_shard, self._kv_shard)
         return [kv_pair] * len(self.params["blocks"]), self._repl_shard
 
+    def _make_pick(self):
+        """In-graph seeded sampler (ISSUE 19), or None when greedy —
+        the greedy programs keep their argmax bodies byte-identical to
+        the pre-sampling build.  ``pick1(logits, seed, p)`` draws ONE
+        token from a (vocab,) row with a counter-derived key folded
+        from (engine sample stream, lane seed, absolute position p):
+        the key depends on nothing else, so the tick, scan and while
+        decode paths — spec or not, chunked or not — sample the
+        identical token at the same position given the same seed."""
+        if not self._sampling:
+            return None
+        import jax
+        from veles_tpu.ops.transformer import sample_token
+        base = xfer.to_device(self._sample_key_host)
+        temp, topk = self.temperature, self.top_k
+
+        def pick1(logits, seed, p):
+            key = jax.random.fold_in(jax.random.fold_in(base, seed), p)
+            return sample_token(key, logits, temp, topk)
+
+        return pick1
+
+    def _seed_args(self, seed):
+        """Trailing scalar seed argument for a one-lane sampling
+        dispatch (prefill/chunk) — empty when greedy, so the greedy
+        program signatures stay exactly the pre-sampling ones."""
+        if not self._sampling:
+            return ()
+        return (xfer.to_device(seed, numpy.int32),)
+
+    def _seed_vec(self):
+        """Trailing (slots,) lane-seed vector for the batched decode
+        dispatches: each admitted lane's request seed, 0 for
+        free/prefilling slots (their sampled garbage lands in masked or
+        soon-overwritten writes, so the value never matters)."""
+        if not self._sampling:
+            return ()
+        seeds = numpy.zeros(self.slots, numpy.int32)
+        for slot, lane in enumerate(self._lanes):
+            if lane is not None:
+                seeds[slot] = lane.request.seed
+        return (xfer.to_device(seeds),)
+
     # ------------------------------------------------------------- jitted core
     def _build_jits(self):
         import jax
@@ -826,8 +948,9 @@ class LMEngine(Logger):
         if self._paged:
             self._build_paged_jits()
             return
+        pick1 = self._make_pick()
 
-        def prefill_one(params, prompt, true_len):
+        def prefill_one(params, prompt, true_len, *sargs):
             # prompt (1, bucket) int32, true_len traced: positions
             # < true_len are exact under causal attention regardless of
             # pad content (see transformer._generate_impl), so one
@@ -836,7 +959,10 @@ class LMEngine(Logger):
                                 rope=rope, window=window, sinks=sinks)
             logits = head_logits(params, jax.lax.dynamic_slice_in_dim(
                 h, true_len - 1, 1, axis=1))[:, 0, :]
-            tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)[0]
+            if pick1 is None:
+                tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)[0]
+            else:
+                tok = pick1(logits[0], sargs[0], true_len)
             return tok, caches
 
         def install(caches, rows, slot):
@@ -845,7 +971,7 @@ class LMEngine(Logger):
             return [(k.at[slot].set(rk[0]), v.at[slot].set(rv[0]))
                     for (k, v), (rk, rv) in zip(caches, rows)]
 
-        def step_one(params, cache_rows, tok, pos):
+        def step_one(params, cache_rows, tok, pos, seed=None):
             # one lane, one token: feed ``tok`` at ``pos`` against this
             # lane's cache rows; vmapped below over the slot axis so
             # every lane advances in ONE dispatch at its own position
@@ -860,12 +986,16 @@ class LMEngine(Logger):
                     window=window, sinks=sinks)
                 new_rows.append((kc[0], vc[0]))
             logits = head_logits(params, x)[0, 0, :]
-            return new_rows, jnp.argmax(logits).astype(jnp.int32)
+            if pick1 is None:
+                return new_rows, jnp.argmax(logits).astype(jnp.int32)
+            return new_rows, pick1(logits, seed, pos + 1)
 
         kv_tree = repl = None
         if self._mesh is not None:
             kv_tree, repl = self._out_shard_trees()
-        step_all = jax.vmap(step_one, in_axes=(None, 0, 0, 0))
+        step_all = jax.vmap(
+            step_one, in_axes=(None, 0, 0, 0) if pick1 is None
+            else (None, 0, 0, 0, 0))
         # programs: prefill
         self._prefill_jit = self._jit(
             prefill_one,
@@ -883,7 +1013,7 @@ class LMEngine(Logger):
         self._page_copy_jit = None
         if C:
             def chunk_slot(params, caches, tokens, slot, start,
-                           last_idx):
+                           last_idx, *sargs):
                 # one prompt chunk for ONE lane, straight into the
                 # shared caches at a TRACED (slot, start): positions
                 # [start, start+C) computed against everything already
@@ -906,7 +1036,12 @@ class LMEngine(Logger):
                 logits = head_logits(
                     params, jax.lax.dynamic_slice_in_dim(
                         h, last_idx, 1, axis=1))[:, 0, :]
-                tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)[0]
+                if pick1 is None:
+                    tok = jnp.argmax(logits,
+                                     axis=-1).astype(jnp.int32)[0]
+                else:
+                    tok = pick1(logits[0], sargs[0],
+                                start + last_idx + 1)
                 return caches, tok
 
             def chunk_extract(caches, slot, start):
@@ -942,21 +1077,28 @@ class LMEngine(Logger):
         self._verify_jit = None
         verify_all = None
         if self.spec_k:
-            def verify_one(params, cache_rows, toks, pos):
+            def verify_one(params, cache_rows, toks, pos, seed=None):
                 # toks (k+1,) = [last committed, draft…] fed at
                 # positions [pos, pos+k]; returns the greedy argmax
+                # (or the seeded sample at each absolute position)
                 # AFTER each fed token — the host accepts the longest
-                # draft prefix that matches its own argmax, so output
-                # is greedy-exact by construction
+                # draft prefix that matches the verifier's own pick, so
+                # output is exact by construction in both modes
                 rows = [(kc[None], vc[None]) for kc, vc in cache_rows]
                 h, rows = chunk_apply(params, toks[None], rows, pos,
                                       n_heads, rope=rope, window=window,
                                       sinks=sinks)
                 logits = head_logits(params, h)[0]      # (k+1, vocab)
-                out = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                if pick1 is None:
+                    out = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                else:
+                    out = jax.vmap(pick1, in_axes=(0, None, 0))(
+                        logits, seed, pos + 1 + jnp.arange(k1))
                 return [(kc[0], vc[0]) for kc, vc in rows], out
 
-            verify_all = jax.vmap(verify_one, in_axes=(None, 0, 0, 0))
+            verify_all = jax.vmap(
+                verify_one, in_axes=(None, 0, 0, 0) if pick1 is None
+                else (None, 0, 0, 0, 0))
             # programs: verify
             self._verify_jit = self._jit(
                 verify_all,
@@ -998,20 +1140,25 @@ class LMEngine(Logger):
         n_heads = self.n_heads
         rope, window, sinks = self.rope, self.window, self.sinks
         kern = self._kernel_active
+        pick1 = self._make_pick()
 
-        def chunk_slot(params, pools, ptab, tokens, start, last_idx):
+        def chunk_slot(params, pools, ptab, tokens, start, last_idx,
+                       *sargs):
             # one lane's prompt chunk through its page table; returns
-            # the argmax after ``last_idx`` (read on the tail chunk)
+            # the pick after ``last_idx`` (read on the tail chunk)
             h, pools = paged_chunk_apply(
                 params, tokens[None], pools, ptab[None], start[None],
                 n_heads, rope=rope, window=window, sinks=sinks,
                 attn_kernel="prefill" if kern else None)
             logits = head_logits(params, jax.lax.dynamic_slice_in_dim(
                 h, last_idx, 1, axis=1))[:, 0, :]
-            tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)[0]
+            if pick1 is None:
+                tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)[0]
+            else:
+                tok = pick1(logits[0], sargs[0], start + last_idx + 1)
             return pools, tok
 
-        def step_all(params, pools, ptabs, toks, pos):
+        def step_all(params, pools, ptabs, toks, pos, *sargs):
             # ONE dispatch advances every lane by one token at its own
             # position through its own page table
             h, pools = paged_chunk_apply(
@@ -1019,7 +1166,10 @@ class LMEngine(Logger):
                 rope=rope, window=window, sinks=sinks,
                 attn_kernel="decode" if kern else None)
             logits = head_logits(params, h)[:, 0, :]
-            return pools, jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            if pick1 is None:
+                return pools, jnp.argmax(logits,
+                                         axis=-1).astype(jnp.int32)
+            return pools, jax.vmap(pick1)(logits, sargs[0], pos + 1)
 
         def page_copy(pools, src, dst):
             # copy-on-write: duplicate one page across every block so
@@ -1044,16 +1194,23 @@ class LMEngine(Logger):
         self._chunk_extract_jit = None
         self._verify_jit = None
         if self.spec_k:
-            def verify_all(params, pools, ptabs, toks, pos):
+            def verify_all(params, pools, ptabs, toks, pos, *sargs):
                 # toks (slots, k+1) = [last committed, draft…] per lane;
-                # returns the greedy argmax AFTER each fed position
+                # returns the greedy argmax (or the seeded sample at
+                # each absolute position) AFTER each fed position
                 h, pools = paged_chunk_apply(
                     params, toks, pools, ptabs, pos, n_heads, rope=rope,
                     window=window, sinks=sinks,
                     attn_kernel="decode" if kern else None)
                 logits = head_logits(params, h)      # (slots, k+1, v)
-                return pools, jnp.argmax(
-                    logits, axis=-1).astype(jnp.int32)
+                if pick1 is None:
+                    return pools, jnp.argmax(
+                        logits, axis=-1).astype(jnp.int32)
+                pp = pos[:, None] + 1 \
+                    + jnp.arange(toks.shape[1])[None, :]
+                return pools, jax.vmap(
+                    jax.vmap(pick1, in_axes=(0, None, 0)))(
+                        logits, sargs[0], pp)
 
             # programs: verify
             self._verify_jit = self._jit(verify_all, pair)
@@ -1070,12 +1227,25 @@ class LMEngine(Logger):
         """Build and jit the fused megastep program (or leave it None
         below K=2) — THE one wiring both layout builders share, so the
         output arity and the tp-mesh out_shardings pin (storage, last,
-        pos, emitted[, accs]) can never drift between them."""
+        pos, emitted[, accs]) can never drift between them.  ISSUE 19:
+        megastep_mode='while' wires the early-exit lax.while_loop
+        variant into ``_whilestep_jit`` instead — its own jit-guard
+        census family (``whilestep``), its own output arity (storage,
+        last, pos, emitted, iters[, accs][, assign])."""
         self._megastep_jit = None
+        self._whilestep_jit = None
         if self.megastep < 2:
             return
         mega = self._make_megastep_body(step_all=step_all,
                                         verify_all=verify_all)
+        if self.megastep_mode == "while":
+            n_out = 5 + (1 if self.spec_k else 0) \
+                + (1 if self.refill_ring else 0)
+            out_sh = ((kv_tree,) + (repl,) * (n_out - 1)
+                      if self._mesh is not None else None)
+            # programs: whilestep
+            self._whilestep_jit = self._jit(mega, out_sh)
+            return
         n_out = 5 if self.spec_k else 4
         out_sh = ((kv_tree,) + (repl,) * (n_out - 1)
                   if self._mesh is not None else None)
@@ -1124,6 +1294,10 @@ class LMEngine(Logger):
         rope, window, sinks = self.rope, self.window, self.sinks
         kern = self._kernel_active
         L = self.max_len
+        slots = self.slots
+        pick1 = self._make_pick()
+        sampling = pick1 is not None
+        R = self.refill_ring if self.megastep_mode == "while" else 0
         if paged:
             from veles_tpu.ops.transformer import (head_logits,
                                                    paged_chunk_apply)
@@ -1140,7 +1314,7 @@ class LMEngine(Logger):
                 lambda h, hl: propose_draft_in_graph(h, hl, k, ngram))
             cols = xfer.to_device(numpy.arange(k + 1)[None, :])
 
-            def spec_iter(params, storage, ptabs, carry):
+            def spec_iter(params, storage, ptabs, seeds, carry):
                 last, pos, left, hist, hlen = carry
                 active = left > 0
                 draft, _found = propose_all(hist, hlen)
@@ -1151,11 +1325,20 @@ class LMEngine(Logger):
                         rope=rope, window=window, sinks=sinks,
                         attn_kernel="decode" if kern else None,
                         write_mask=active)
-                    out = jnp.argmax(head_logits(params, h),
-                                     axis=-1).astype(jnp.int32)
-                else:
+                    logits = head_logits(params, h)
+                    if pick1 is None:
+                        out = jnp.argmax(logits,
+                                         axis=-1).astype(jnp.int32)
+                    else:
+                        out = jax.vmap(jax.vmap(
+                            pick1, in_axes=(0, None, 0)))(
+                            logits, seeds, pos[:, None] + 1 + cols)
+                elif pick1 is None:
                     storage, out = verify_all(params, storage, toks,
                                               pos)
+                else:
+                    storage, out = verify_all(params, storage, toks,
+                                              pos, seeds)
                 # leading draft/argmax matches; accepted tokens ARE
                 # out[:acc], so the emit window is simply out[:take]
                 matches = (draft == out[:, :k]).astype(jnp.int32)
@@ -1183,59 +1366,197 @@ class LMEngine(Logger):
                 return storage, (last, pos, left, hist, hlen), \
                     (emit, jnp.where(active, acc, -1))
 
-            def mega_spec(params, storage, ptabs, last, pos, left,
-                          hist, hlen):
-                def body(carry, _):
-                    storage, rest = carry
-                    storage, rest, out = spec_iter(params, storage,
-                                                   ptabs, rest)
-                    return (storage, rest), out
+            if self.megastep_mode != "while":
+                def mega_spec(params, storage, ptabs, last, pos, left,
+                              hist, hlen, *sargs):
+                    seeds = sargs[0] if sampling else None
 
-                (storage, rest), (emitted, accs) = jax.lax.scan(
-                    body, (storage, (last, pos, left, hist, hlen)),
-                    None, length=K)
-                return storage, rest[0], rest[1], emitted, accs
+                    def body(carry, _):
+                        storage, rest = carry
+                        storage, rest, out = spec_iter(
+                            params, storage, ptabs, seeds, rest)
+                        return (storage, rest), out
 
-            if paged:
-                return mega_spec
-            return lambda params, storage, last, pos, left, hist, \
-                hlen: mega_spec(params, storage, None, last, pos,
-                                left, hist, hlen)
+                    (storage, rest), (emitted, accs) = jax.lax.scan(
+                        body, (storage, (last, pos, left, hist, hlen)),
+                        None, length=K)
+                    return storage, rest[0], rest[1], emitted, accs
 
-        def plain_iter(params, storage, ptabs, carry):
-            last, pos, left = carry
-            active = left > 0
-            if paged:
-                h, storage = paged_chunk_apply(
-                    params, last[:, None], storage, ptabs, pos,
-                    n_heads, rope=rope, window=window, sinks=sinks,
-                    attn_kernel="decode" if kern else None,
-                    write_mask=active)
-                toks = jnp.argmax(head_logits(params, h)[:, 0, :],
-                                  axis=-1).astype(jnp.int32)
-            else:
-                storage, toks = step_all(params, storage, last, pos)
-            emit = jnp.where(active, toks, -1)
-            last = jnp.where(active, toks, last)
-            pos = jnp.where(active, pos + 1, pos)
-            left = left - jnp.where(active, 1, 0)
-            return storage, (last, pos, left), emit
+                if paged:
+                    return mega_spec
+                return lambda params, storage, *a: mega_spec(
+                    params, storage, None, *a)
 
-        def mega_plain(params, storage, ptabs, last, pos, left):
-            def body(carry, _):
-                storage, rest = carry
-                storage, rest, emit = plain_iter(params, storage,
-                                                 ptabs, rest)
-                return (storage, rest), emit
+        if not k:
+            def plain_iter(params, storage, ptabs, seeds, carry):
+                last, pos, left = carry
+                active = left > 0
+                if paged:
+                    h, storage = paged_chunk_apply(
+                        params, last[:, None], storage, ptabs, pos,
+                        n_heads, rope=rope, window=window, sinks=sinks,
+                        attn_kernel="decode" if kern else None,
+                        write_mask=active)
+                    logits = head_logits(params, h)[:, 0, :]
+                    if pick1 is None:
+                        toks = jnp.argmax(logits,
+                                          axis=-1).astype(jnp.int32)
+                    else:
+                        toks = jax.vmap(pick1)(logits, seeds, pos + 1)
+                elif pick1 is None:
+                    storage, toks = step_all(params, storage, last,
+                                             pos)
+                else:
+                    storage, toks = step_all(params, storage, last,
+                                             pos, seeds)
+                emit = jnp.where(active, toks, -1)
+                last = jnp.where(active, toks, last)
+                pos = jnp.where(active, pos + 1, pos)
+                left = left - jnp.where(active, 1, 0)
+                return storage, (last, pos, left), emit
 
-            (storage, rest), emitted = jax.lax.scan(
-                body, (storage, (last, pos, left)), None, length=K)
-            return storage, rest[0], rest[1], emitted
+            if self.megastep_mode != "while":
+                def mega_plain(params, storage, ptabs, last, pos, left,
+                               *sargs):
+                    seeds = sargs[0] if sampling else None
+
+                    def body(carry, _):
+                        storage, rest = carry
+                        storage, rest, emit = plain_iter(
+                            params, storage, ptabs, seeds, rest)
+                        return (storage, rest), emit
+
+                    (storage, rest), emitted = jax.lax.scan(
+                        body, (storage, (last, pos, left)), None,
+                        length=K)
+                    return storage, rest[0], rest[1], emitted
+
+                if paged:
+                    return mega_plain
+                return lambda params, storage, *a: mega_plain(
+                    params, storage, None, *a)
+
+        # ---- ISSUE 19: the persistent-loop variant — same iteration
+        # body, but driven by lax.while_loop so the program EXITS as
+        # soon as every live lane (and the published standby ring) is
+        # drained instead of burning masked iterations to the K
+        # boundary.  Stacked per-iteration outputs land in a fixed
+        # (K, ...) buffer via dynamic_update_slice (while_loop has no
+        # scan-style stacking), so the output shapes — and the program
+        # family — stay exactly the scan megastep's.  Idle slots enter
+        # with left = -1 so only a slot that DRAINED (left hit 0 from
+        # a positive value, or was published as re-armable) can take a
+        # standby entry.
+        def mega_while(params, storage, ptabs, last, pos, left, *rest):
+            rest = list(rest)
+            if k:
+                hist, hlen = rest.pop(0), rest.pop(0)
+            seeds = rest.pop(0) if sampling else None
+            if R:
+                ring_tabs, ring_last = rest.pop(0), rest.pop(0)
+                ring_pos, ring_left = rest.pop(0), rest.pop(0)
+                if k:
+                    ring_hist, ring_hlen = rest.pop(0), rest.pop(0)
+                if sampling:
+                    ring_seeds = rest.pop(0)
+                count = rest.pop(0)
+            c = {"storage": storage, "ptabs": ptabs, "last": last,
+                 "pos": pos, "left": left, "i": jnp.int32(0),
+                 "emitted": jnp.full((K, slots, k + 1) if k
+                                     else (K, slots), -1, jnp.int32)}
+            if k:
+                c["hist"], c["hlen"] = hist, hlen
+                c["accs"] = jnp.full((K, slots), -1, jnp.int32)
+            if sampling:
+                c["seeds"] = seeds
+            if R:
+                c["head"] = jnp.int32(0)
+                c["assign"] = jnp.full((R,), -1, jnp.int32)
+
+            def cond(c):
+                live = jnp.any(c["left"] > 0)
+                if R:
+                    live = live | (c["head"] < count)
+                return (c["i"] < K) & live
+
+            def body(c):
+                c = dict(c)
+                if R:
+                    # in-graph re-arm: each drained slot (left == 0)
+                    # takes the next unconsumed ring entry — frontier,
+                    # page-table row, history and seed all swap in one
+                    # masked select; ``assign`` records entry -> slot
+                    # so the host can attribute the emitted rows at
+                    # the boundary.  Unrolled over the small slot
+                    # count; at most one entry arms per slot per
+                    # iteration, which is exact (a slot drains at most
+                    # once per iteration).
+                    for s in range(slots):
+                        idx = jnp.minimum(c["head"], R - 1)
+                        take = (c["left"][s] == 0) & \
+                            (c["head"] < count)
+                        c["ptabs"] = jnp.where(
+                            take,
+                            c["ptabs"].at[s].set(ring_tabs[idx]),
+                            c["ptabs"])
+                        c["last"] = c["last"].at[s].set(jnp.where(
+                            take, ring_last[idx], c["last"][s]))
+                        c["pos"] = c["pos"].at[s].set(jnp.where(
+                            take, ring_pos[idx], c["pos"][s]))
+                        c["left"] = c["left"].at[s].set(jnp.where(
+                            take, ring_left[idx], c["left"][s]))
+                        if k:
+                            c["hist"] = jnp.where(
+                                take,
+                                c["hist"].at[s].set(ring_hist[idx]),
+                                c["hist"])
+                            c["hlen"] = c["hlen"].at[s].set(
+                                jnp.where(take, ring_hlen[idx],
+                                          c["hlen"][s]))
+                        if sampling:
+                            c["seeds"] = c["seeds"].at[s].set(
+                                jnp.where(take, ring_seeds[idx],
+                                          c["seeds"][s]))
+                        c["assign"] = c["assign"].at[idx].set(
+                            jnp.where(take, s, c["assign"][idx]))
+                        c["head"] = c["head"] + take.astype(jnp.int32)
+                if k:
+                    carry = (c["last"], c["pos"], c["left"],
+                             c["hist"], c["hlen"])
+                    c["storage"], carry, (emit, acc) = spec_iter(
+                        params, c["storage"], c["ptabs"],
+                        c.get("seeds"), carry)
+                    (c["last"], c["pos"], c["left"], c["hist"],
+                     c["hlen"]) = carry
+                    c["accs"] = jax.lax.dynamic_update_slice(
+                        c["accs"], acc[None], (c["i"], 0))
+                    c["emitted"] = jax.lax.dynamic_update_slice(
+                        c["emitted"], emit[None], (c["i"], 0, 0))
+                else:
+                    carry = (c["last"], c["pos"], c["left"])
+                    c["storage"], carry, emit = plain_iter(
+                        params, c["storage"], c["ptabs"],
+                        c.get("seeds"), carry)
+                    c["last"], c["pos"], c["left"] = carry
+                    c["emitted"] = jax.lax.dynamic_update_slice(
+                        c["emitted"], emit[None], (c["i"], 0))
+                c["i"] = c["i"] + 1
+                return c
+
+            # programs: whilestep
+            c = jax.lax.while_loop(cond, body, c)
+            res = [c["storage"], c["last"], c["pos"], c["emitted"],
+                   c["i"]]
+            if k:
+                res.append(c["accs"])
+            if R:
+                res.append(c["assign"])
+            return tuple(res)
 
         if paged:
-            return mega_plain
-        return lambda params, storage, last, pos, left: mega_plain(
-            params, storage, None, last, pos, left)
+            return mega_while
+        return lambda params, storage, *a: mega_while(
+            params, storage, None, *a)
 
     # --------------------------------------------------------------- lifecycle
     def _warmup(self):
@@ -1244,30 +1565,42 @@ class LMEngine(Logger):
         first code to run under the armed transfer guard."""
         zero = xfer.to_device(0, numpy.int32)
         zeros = xfer.to_device(numpy.zeros(self.slots, numpy.int32))
+        # seeded sampling appends a trailing seed argument per program
+        # family (scalar for the one-lane programs, a lane vector for
+        # the batched ones) — warm with it or the first sampled
+        # dispatch compiles inside the serving loop
+        s1 = (zero,) if self._sampling else ()
+        sv = (zeros,) if self._sampling else ()
         if self._paged:
             ptabs = numpy.zeros((self.slots, self._max_pages),
                                 numpy.int32)
             self._kv_pools, _ = self._chunk_jit(
                 self.params, self._kv_pools, xfer.to_device(ptabs[0]),
                 xfer.to_device(numpy.zeros(self.prefill_chunk,
-                                           numpy.int32)), zero, zero)
+                                           numpy.int32)), zero, zero,
+                *s1)
             self._kv_pools = self._page_copy_jit(self._kv_pools, zero,
                                                  zero)
-            # step/verify (or the fused megastep, which REPLACES them
-            # on the decode loop) compile one program per live-width
-            # ladder entry (ISSUE 7) — warm EVERY entry now, or the
-            # first request to cross each width boundary pays its
-            # compile inside the serving loop
+            # step/verify (or the fused megastep / whilestep, which
+            # REPLACES them on the decode loop) compile one program per
+            # live-width ladder entry (ISSUE 7) — warm EVERY entry now,
+            # or the first request to cross each width boundary pays
+            # its compile inside the serving loop
             for w in self._width_ladder:
                 wtab = xfer.to_device(ptabs[:, :w])
-                if self._megastep_jit is not None:
+                fused = self._whilestep_jit or self._megastep_jit
+                if fused is not None:
                     args = [self.params, self._kv_pools, wtab,
                             zeros, zeros, zeros]
                     if self.spec_k:
                         args += [xfer.to_device(numpy.zeros(
                             (self.slots, self.max_len), numpy.int32)),
                             zeros]
-                    out = self._megastep_jit(*args)
+                    args += sv
+                    if self._whilestep_jit is not None \
+                            and self.refill_ring:
+                        args += self._ring_zero_args(w)
+                    out = fused(*args)
                     self._kv_pools = out[0]
                     continue
                 if self._verify_jit is not None:
@@ -1275,45 +1608,48 @@ class LMEngine(Logger):
                         self.params, self._kv_pools, wtab,
                         xfer.to_device(numpy.zeros(
                             (self.slots, self.spec_k + 1),
-                            numpy.int32)), zeros)
+                            numpy.int32)), zeros, *sv)
                 self._kv_pools, _ = self._step_jit(
-                    self.params, self._kv_pools, wtab, zeros, zeros)
+                    self.params, self._kv_pools, wtab, zeros, zeros,
+                    *sv)
         else:
             tok, rows = self._prefill_jit(
                 self.params,
                 xfer.to_device(numpy.zeros(
                     (1, prompt_bucket(1, self.max_len)), numpy.int32)),
-                xfer.to_device(1, numpy.int32))
+                xfer.to_device(1, numpy.int32), *s1)
             self._caches = self._install_jit(self._caches, rows, zero)
             if self._chunk_jit is not None:
                 self._caches, _ = self._chunk_jit(
                     self.params, self._caches,
                     xfer.to_device(numpy.zeros(self.prefill_chunk,
                                                numpy.int32)), zero,
-                    zero, zero)
+                    zero, zero, *s1)
                 crows = self._chunk_extract_jit(self._caches, zero,
                                                 zero)
                 self._caches = self._chunk_install_jit(self._caches,
                                                        crows, zero,
                                                        zero)
-            if self._megastep_jit is not None:
+            fused = self._whilestep_jit or self._megastep_jit
+            if fused is not None:
                 args = [self.params, self._caches, zeros, zeros, zeros]
                 if self.spec_k:
                     args += [xfer.to_device(numpy.zeros(
                         (self.slots, self.max_len), numpy.int32)),
                         zeros]
-                self._caches = self._megastep_jit(*args)[0]
+                args += sv
+                self._caches = fused(*args)[0]
             else:
                 if self._verify_jit is not None:
                     self._caches, _ = self._verify_jit(
                         self.params, self._caches,
                         xfer.to_device(numpy.zeros(
                             (self.slots, self.spec_k + 1),
-                            numpy.int32)), zeros)
+                            numpy.int32)), zeros, *sv)
                 self._caches, _ = self._step_jit(
                     self.params, self._caches, zeros,
                     xfer.to_device(numpy.ones(self.slots,
-                                              numpy.int32)))
+                                              numpy.int32)), *sv)
 
     def start(self):
         # warm every program before traffic: the discarded warmup
@@ -1436,6 +1772,15 @@ class LMEngine(Logger):
         self.weights_version = int(version)
         self.metrics.set_gauge("weights_version", self.weights_version)
 
+    def _peek_swap(self):
+        """Racy worker peek at the pending weight swap.  Read-only:
+        every consumer that acts on the result re-checks (and claims)
+        under ``_cond`` — ``_admit``/``_admit_ring``/``_advance_ring``/
+        ``_step_while`` only use it to hold work back for a tick, and
+        ``_maybe_apply_swap`` re-validates identity before claiming."""
+        # lint: allow(lock-discipline): racy worker peek; claim re-checked under _cond
+        return self._pending_swap
+
     def _maybe_apply_swap(self):
         """Worker-side swap application (one is-None check per tick).
         Finish-on-old waits for the active lanes (admission is held in
@@ -1443,8 +1788,7 @@ class LMEngine(Logger):
         drain mode re-queues them whole first.  The apply itself is a
         pointer assignment — the tree was placed on the caller's
         thread."""
-        # lint: allow(lock-discipline): racy worker peek; claim re-checked under _cond
-        swap = self._pending_swap
+        swap = self._peek_swap()
         if swap is None:
             return
         active = [i for i, lane in enumerate(self._lanes)
@@ -1461,6 +1805,10 @@ class LMEngine(Logger):
             self._pending_swap = None
         if active:
             self._requeue_active(active)
+        if self._ring:
+            # standby prefill ran on the OLD weights — stale KV the
+            # moment the new tree installs
+            self._requeue_ring()
         t0a = time.monotonic()
         try:
             self._fault("engine.swap")
@@ -1623,6 +1971,13 @@ class LMEngine(Logger):
             # checkpoint never iterates a mutating dict.
             self._rid += 1
             rid = self._rid
+            # seeded-sampling lane seed (ISSUE 19): the admission id is
+            # deterministic per submission order, so the same traffic
+            # replayed against any engine config (tick/scan/while,
+            # paged or contiguous, tp=1/2) folds the SAME (seed, pos)
+            # coordinates into the sampling stream — that is what the
+            # seeded-parity matrix asserts
+            req.seed = rid
             self._journal[rid] = req
             req.future.add_done_callback(
                 lambda f, rid=rid: self._journal_pop(rid))
@@ -1833,6 +2188,12 @@ class LMEngine(Logger):
             for p in lane.pages:
                 want_refs[p] += 1
                 want_pins[p] += 1
+        for entry in self._ring:
+            # standby-ring occupants hold pages exactly like lanes
+            # (ISSUE 19) — a leaked ring page is a violation here too
+            for p in entry.lane.pages:
+                want_refs[p] += 1
+                want_pins[p] += 1
         if self._trie is not None:
             stack = list(self._trie.root.children.values())
             while stack:
@@ -1864,8 +2225,7 @@ class LMEngine(Logger):
         when the pool cannot cover them the request goes BACK to the
         queue head (FIFO — retried next tick as lanes free pages, shed
         at its deadline) instead of wedging or being skipped."""
-        # lint: allow(lock-discipline): racy worker peek; _maybe_apply_swap claims under _cond
-        if self._pending_swap is not None:
+        if self._peek_swap() is not None:
             # a finish-on-old swap is quiescing: admitting now would
             # extend old-weights serving indefinitely — the queue
             # waits the (bounded) remaining lane ticks instead
@@ -1931,7 +2291,8 @@ class LMEngine(Logger):
                 tok, rows = self._prefill_jit(
                     self.params,
                     xfer.to_device(prompt[None], numpy.int32),
-                    xfer.to_device(req.true_len, numpy.int32))
+                    xfer.to_device(req.true_len, numpy.int32),
+                    *self._seed_args(req.seed))
                 self._caches = self._install_jit(
                     self._caches, rows,
                     xfer.to_device(slot, numpy.int32))
@@ -2178,15 +2539,20 @@ class LMEngine(Logger):
         self.metrics.set_gauge("kv_pages_pinned",
                                self._pool.pinned_pages)
 
-    def _live_width(self, span):
+    def _live_width(self, span, floor=0):
         """Ladder-bucketed page-table width for a decode/verify step
         writing ``span`` positions per lane: the smallest power-of-two
         (capped at max_pages) covering EVERY slot's frontier —
         ``_pos`` includes prefilling lanes' parked frontiers and the
         inactive lanes' 0, so the batched step's garbage writes always
         land inside the sliced table (take_along_axis would otherwise
-        CLAMP an out-of-range page lookup onto a live page)."""
-        need = -(-(int(self._pos.max()) + span) // self.prefill_chunk)
+        CLAMP an out-of-range page lookup onto a live page).  ``floor``
+        raises the covered frontier past the slots' own — the while
+        megastep passes its published standby lanes' positions so a
+        ring entry armed mid-loop writes inside the sliced width
+        too."""
+        need = -(-(max(int(self._pos.max()), floor) + span)
+                 // self.prefill_chunk)
         for w in self._width_ladder:
             if w >= need:
                 return w
@@ -2266,7 +2632,8 @@ class LMEngine(Logger):
                 xfer.to_device(tokens, numpy.int32),
                 xfer.to_device(slot, numpy.int32),
                 xfer.to_device(start, numpy.int32),
-                xfer.to_device(last_idx, numpy.int32))
+                xfer.to_device(last_idx, numpy.int32),
+                *self._seed_args(req.seed))
             if not is_tail and self._trie is not None \
                     and lane.cursor is not None:
                 rows = self._chunk_extract_jit(
@@ -2358,7 +2725,8 @@ class LMEngine(Logger):
                 xfer.to_device(self._page_tables[slot]),
                 xfer.to_device(tokens, numpy.int32),
                 xfer.to_device(start, numpy.int32),
-                xfer.to_device(last_idx, numpy.int32))
+                xfer.to_device(last_idx, numpy.int32),
+                *self._seed_args(req.seed))
             if not is_tail and self._trie is not None \
                     and lane.cursor is not None:
                 page = lane.pages[page_idx]
@@ -2502,12 +2870,12 @@ class LMEngine(Logger):
                     self.params, self._kv_pools,
                     xfer.to_device(self._page_tables[:, :w]),
                     xfer.to_device(self._last),
-                    xfer.to_device(self._pos))
+                    xfer.to_device(self._pos), *self._seed_vec())
             else:
                 self._caches, toks = self._step_jit(
                     self.params, self._caches,
                     xfer.to_device(self._last),
-                    xfer.to_device(self._pos))
+                    xfer.to_device(self._pos), *self._seed_vec())
             toks = xfer.to_host(toks)
             self._tfence(self._kv_pools if self._paged
                          else self._caches,
@@ -2587,11 +2955,12 @@ class LMEngine(Logger):
                 self._kv_pools, out = self._verify_jit(
                     self.params, self._kv_pools,
                     xfer.to_device(self._page_tables[:, :w]),
-                    xfer.to_device(toks_in), xfer.to_device(self._pos))
+                    xfer.to_device(toks_in), xfer.to_device(self._pos),
+                    *self._seed_vec())
             else:
                 self._caches, out = self._verify_jit(
                     self.params, self._caches, xfer.to_device(toks_in),
-                    xfer.to_device(self._pos))
+                    xfer.to_device(self._pos), *self._seed_vec())
             out = xfer.to_host(out)
             self._tfence(self._kv_pools if self._paged
                          else self._caches,
@@ -2675,6 +3044,7 @@ class LMEngine(Logger):
                 hist[slot, :len(row)] = row
                 hlen[slot] = len(row)
             extra = (xfer.to_device(hist), xfer.to_device(hlen))
+        extra = extra + self._seed_vec()
         w = None
         tctxs = ()
         if self._tracer is not None:
@@ -2763,6 +3133,485 @@ class LMEngine(Logger):
             if lane.remaining == 0 or lane.request.cancelled:
                 self._finish(slot)
 
+    # ---------------------------------------------- ISSUE 19: while megastep
+    def _ring_args(self, pub, w):
+        """Device arguments publishing ``pub`` (the READY standby
+        entries) into the while-megastep carry, zero-padded to the
+        fixed ring size R — the program family depends on R and the
+        page-table width, never on occupancy (count=0 simply arms
+        nothing).  Padding table rows park on SCRATCH like a free
+        slot's."""
+        R = self.refill_ring
+        tabs = numpy.full((R, w), KVPagePool.SCRATCH, numpy.int32)
+        last = numpy.zeros(R, numpy.int32)
+        pos = numpy.zeros(R, numpy.int32)
+        left = numpy.zeros(R, numpy.int32)
+        if self.spec_k:
+            hist = numpy.zeros((R, self.max_len), numpy.int32)
+            hlen = numpy.zeros(R, numpy.int32)
+        seeds = numpy.zeros(R, numpy.int32)
+        for j, entry in enumerate(pub):
+            lane = entry.lane
+            tabs[j] = entry.table[:w]
+            last[j] = entry.last
+            pos[j] = entry.pos
+            left[j] = lane.remaining
+            if self.spec_k:
+                row = numpy.concatenate(
+                    [lane.request.prompt,
+                     numpy.asarray(lane.emitted, numpy.int32)])
+                hist[j, :len(row)] = row
+                hlen[j] = len(row)
+            seeds[j] = lane.request.seed
+        args = [xfer.to_device(tabs), xfer.to_device(last),
+                xfer.to_device(pos), xfer.to_device(left)]
+        if self.spec_k:
+            args += [xfer.to_device(hist), xfer.to_device(hlen)]
+        if self._sampling:
+            args.append(xfer.to_device(seeds))
+        args.append(xfer.to_device(len(pub), numpy.int32))
+        return args
+
+    def _ring_zero_args(self, w):
+        """Empty-ring dispatch arguments at width ``w`` (warmup)."""
+        return self._ring_args([], w)
+
+    def _step_while(self, active):   # hot-path
+        """ONE early-exit fused dispatch (ISSUE 19): the
+        ``lax.while_loop`` megastep advances every active lane until
+        ALL are drained — or the K-iteration cap lands — instead of
+        burning masked iterations to a fixed-K boundary, and arms
+        published standby-ring lanes into slots that drain mid-loop.
+        The host's boundary work mirrors :meth:`_step_megastep` plus:
+        read back the REALIZED iteration count (the span/ledger and
+        waste metering quote it, not the cap), split each slot's
+        emitted stream between the outgoing lane and its in-graph
+        replacements (sequential by construction: a lane only stops
+        emitting when drained, and ring entries arm in ring order),
+        resolve replacements that finished inside the loop, and
+        install the last unfinished replacement as the slot's lane."""
+        K, k = self.megastep, self.spec_k
+        span = K * (k + 1) + k if k else K
+        if self._paged:
+            active = self._cow_guard_active(active, span)
+            if not active:
+                return
+        left = numpy.full(self.slots, -1, numpy.int32)
+        for slot in active:
+            left[slot] = self._lanes[slot].remaining
+        pub = []
+        if self.refill_ring:
+            # a free slot enters at left=0: rearm-eligible from
+            # iteration 0 (a mid-loop drain is just the common case,
+            # not a precondition); prefilling slots stay at -1 so the
+            # in-graph arm can NEVER clobber a host-side prefill
+            for slot in self._free:
+                left[slot] = 0
+            if self._peek_swap() is None:
+                # quiescing swap: entries prefilled on the old weights
+                # must not arm now and decode past the apply
+                pub = [e for e in self._ring
+                       if e.ready and not e.lane.request.cancelled]
+        extra = ()
+        if k:
+            hist = numpy.zeros((self.slots, self.max_len), numpy.int32)
+            hlen = numpy.zeros(self.slots, numpy.int32)
+            for slot in active:
+                lane = self._lanes[slot]
+                row = numpy.concatenate(
+                    [lane.request.prompt,
+                     numpy.asarray(lane.emitted, numpy.int32)])
+                hist[slot, :len(row)] = row
+                hlen[slot] = len(row)
+            extra = (xfer.to_device(hist), xfer.to_device(hlen))
+        extra = extra + self._seed_vec()
+        w = None
+        tctxs = ()
+        if self._tracer is not None:
+            # standby occupants participate in this dispatch: the span
+            # lands in THEIR trace trees too (sound trees under chaos)
+            tctxs = [self._lanes[s].request.trace for s in active] \
+                + [e.lane.request.trace for e in pub]
+        t0 = time.monotonic()
+        try:
+            self._fault("engine.step")
+            if self._paged:
+                floor = max([e.pos for e in pub] or [0])
+                w = self._live_width(span, floor)
+                args = [self.params, self._kv_pools,
+                        xfer.to_device(self._page_tables[:, :w]),
+                        xfer.to_device(self._last),
+                        xfer.to_device(self._pos),
+                        xfer.to_device(left)] + list(extra)
+                if self.refill_ring:
+                    args += self._ring_args(pub, w)
+                out = self._whilestep_jit(*args)
+                self._kv_pools = out[0]
+            else:
+                out = self._whilestep_jit(
+                    self.params, self._caches,
+                    xfer.to_device(self._last),
+                    xfer.to_device(self._pos),
+                    xfer.to_device(left), *extra)
+                self._caches = out[0]
+            last, pos, emitted, iters = xfer.to_host(
+                (out[1], out[2], out[3], out[4]))
+            accs = xfer.to_host(out[5]) if k else None
+            assign = (xfer.to_host(out[5 + (1 if k else 0)])
+                      if self.refill_ring else None)
+            self._tfence(self._kv_pools if self._paged
+                         else self._caches,
+                         any(c is not None for c in tctxs))
+        except Exception as e:   # noqa: BLE001 — fails the lanes
+            if self._tracer is not None:
+                self._tracer.add_many(
+                    tctxs, "decode.megastep", "decode", t0,
+                    time.monotonic(),
+                    attrs={"batch": len(active) + len(pub), "K": K,
+                           "error": str(e)})
+            self._fail_active(active, e)
+            for entry in pub:
+                # a mid-loop fault fails exactly the participants —
+                # published ring occupants included, their pages home
+                self._fail_standby(entry, e)
+            return
+        t1 = time.monotonic()
+        iters = int(iters)
+        self._pos = numpy.array(pos, numpy.int32)
+        self._last = numpy.array(last, numpy.int32)
+        armed = {}                      # slot -> entries, in arm order
+        if assign is not None:
+            for j, entry in enumerate(pub):
+                s = int(assign[j])
+                if s >= 0:
+                    armed.setdefault(s, []).append(entry)
+                    self._ring.remove(entry)
+        participants = sorted(set(active) | set(armed))
+        lane_tokens = {}
+        wasted = 0
+        total = 0
+        for slot in participants:
+            rows = (emitted[:iters, slot, :] if k
+                    else emitted[:iters, slot][:, None])
+            toks = [int(t) for t in rows[rows >= 0]]
+            wasted += int((rows[:, 0] < 0).sum())
+            total += len(toks)
+            lane_tokens[slot] = len(toks)
+            owners = ([self._lanes[slot]] if slot in active else []) \
+                + [e.lane for e in armed.get(slot, ())]
+            for lane in owners:
+                take = min(lane.remaining, len(toks))
+                lane.emitted.extend(toks[:take])
+                lane.remaining -= take
+                toks = toks[take:]
+            self.metrics.inc("tokens_out", lane_tokens[slot])
+        if accs is not None:
+            live_iters = int((accs[:iters] >= 0).sum())
+            self.metrics.inc("draft_tokens", k * live_iters)
+            self.metrics.inc("draft_accepted",
+                             int(numpy.clip(accs[:iters], 0, k).sum()))
+        n_armed = sum(len(v) for v in armed.values())
+        if n_armed:
+            self.metrics.inc("megastep_refills", n_armed)
+        self.metrics.set_gauge("standby_ring_occupancy",
+                               len(self._ring))
+        self.metrics.record_dispatch(len(participants))
+        self.metrics.record_decode_step(t1 - t0)
+        self.metrics.inc("decode_dispatches")
+        # REALIZED iterations, not the cap: the waste gauge must read
+        # what the early exit actually saved
+        self.metrics.record_megastep(iters, len(participants), total,
+                                     wasted)
+        self._note_attn_dispatch()
+        if self._tracer is not None:
+            self._tracer.add_many(
+                tctxs, "decode.megastep", "decode", t0, t1,
+                attrs={"batch": len(participants), "K": K,
+                       "iters": iters, "tokens": total,
+                       "bucket": "%sxK%d" % (w if w is not None
+                                             else self.slots, K),
+                       "backend": self._backend},
+                each_attrs=[{"lane_tokens": lane_tokens.get(s, 0)}
+                            for s in active]
+                + [{"standby": True} for _ in pub])
+        for slot in participants:
+            if slot in active:
+                lane = self._lanes[slot]
+                if lane.remaining == 0 or lane.request.cancelled:
+                    self._finish(slot)
+            for entry in armed.get(slot, ()):
+                lane = entry.lane
+                if lane.remaining == 0 or lane.request.cancelled:
+                    self._resolve_standby(entry)
+                else:
+                    # still decoding at the cap: the entry BECOMES the
+                    # slot's lane — restore the frontier that
+                    # _finish's vacate reset, and the full-width page
+                    # table row from the entry's own reservation
+                    self._lanes[slot] = lane
+                    if slot in self._free:
+                        self._free.remove(slot)
+                    self._page_tables[slot] = entry.table
+                    self._pos[slot] = int(pos[slot])
+                    self._last[slot] = int(last[slot])
+            if self._lanes[slot] is None:
+                # every owner drained: park the freed slot's frontier
+                # back at the garbage-write discipline's 0
+                self._pos[slot] = 0
+                self._last[slot] = 0
+                if self._paged:
+                    self._page_tables[slot, :] = KVPagePool.SCRATCH
+
+    # --------------------------------------------- ISSUE 19: standby ring
+    def _admit_ring(self):   # hot-path
+        """Install READY standby lanes into free slots HOST-side: the
+        ring's fast path is the in-graph arm, but when a slot frees at
+        a boundary (or lanes drained while the ring was still
+        prefilling) the entry must not wait for a mid-loop drain that
+        can never come."""
+        if not self.refill_ring or self._peek_swap() is not None:
+            return
+        while self._free and self._ring:
+            entry = next((e for e in self._ring if e.ready), None)
+            if entry is None:
+                return
+            self._ring.remove(entry)
+            lane = entry.lane
+            if lane.request.cancelled:
+                self._drop_standby(entry)
+                continue
+            slot = self._free.pop()
+            self._lanes[slot] = lane
+            self._page_tables[slot] = entry.table
+            self._pos[slot] = entry.pos
+            self._last[slot] = entry.last
+            self.metrics.set_gauge("standby_ring_occupancy",
+                                   len(self._ring))
+
+    def _advance_ring(self):   # hot-path
+        """One tick of standby-ring work (ISSUE 19): advance ONE
+        pending standby prefill chunk, or — when every slot is busy,
+        the ring has room and no swap is quiescing — pull the queue
+        head into a fresh standby entry.  Pages are reserved
+        all-or-nothing exactly like :meth:`_admit_paged`, but with NO
+        prefix-cache interaction: a standby page is never shared, so
+        the in-graph arm needs no COW guard."""
+        if not self.refill_ring:
+            return
+        for entry in list(self._ring):
+            # withdrawn entries give their pages home NOW, not at some
+            # future boundary
+            if entry.lane.request.cancelled:
+                self._drop_standby(entry)
+        if self._peek_swap() is not None:
+            return
+        entry = next((e for e in self._ring if not e.ready), None)
+        if entry is not None:
+            self._advance_standby_chunk(entry)
+            return
+        if self._free or len(self._ring) >= self.refill_ring:
+            return
+        with self._cond:
+            req = self._queue.popleft() if self._queue else None
+            if req is not None:
+                self._queued_tokens -= req.true_len
+                self._queued_pages -= req.pages
+                self.metrics.set_gauge("queue_depth", len(self._queue))
+                self.metrics.set_gauge("queue_tokens",
+                                       self._queued_tokens)
+                self.metrics.set_gauge("queue_pages",
+                                       self._queued_pages)
+        if req is None:
+            return
+        if req.cancelled:
+            self._trace_queue_end(req, "cancelled")
+            req.future.cancel()
+            return
+        if time.monotonic() > req.deadline:
+            self.metrics.record_shed()
+            self._trace_queue_end(req, "shed")
+            req.future.set_exception(DeadlineExceeded(
+                "prompt shed after %.3fs in queue" % (
+                    time.monotonic() - req.t_enq)))
+            return
+        pages = self._alloc_pages(req.pages)
+        if pages is None:
+            # pool pressure: back to the HEAD, exactly like _admit
+            with self._cond:
+                self._queue.appendleft(req)
+                self._queued_tokens += req.true_len
+                self._queued_pages += req.pages
+                self.metrics.set_gauge("queue_depth", len(self._queue))
+                self.metrics.set_gauge("queue_tokens",
+                                       self._queued_tokens)
+                self.metrics.set_gauge("queue_pages",
+                                       self._queued_pages)
+            return
+        lane = _Slot(req)
+        for p in pages:
+            self._pool.pin(p)
+        lane.pages.extend(pages)
+        table = numpy.full(self._max_pages, KVPagePool.SCRATCH,
+                           numpy.int32)
+        table[:len(pages)] = pages
+        C = self.prefill_chunk
+        n_full = (req.true_len - 1) // C
+        for i in range(n_full):
+            lane.pending.append((req.prompt[i * C:(i + 1) * C], i * C,
+                                 False))
+        tail = req.prompt[n_full * C:]
+        if len(tail) < C:
+            tail = numpy.pad(tail, (0, C - len(tail)))
+        lane.pending.append((tail, n_full * C, True))
+        self.metrics.record_queue_wait(time.monotonic() - req.t_enq)
+        self._trace_admitted(req)
+        entry = _Standby(lane, table)
+        self._ring.append(entry)
+        self._update_pool_gauges()
+        self.metrics.set_gauge("standby_ring_occupancy",
+                               len(self._ring))
+        self.metrics.set_gauge_max("standby_ring_peak",
+                                   len(self._ring))
+        # the creation tick does its first chunk of prefill work too —
+        # otherwise a C-chunk prompt takes C+1 boundaries to become
+        # publishable and a one-boundary handoff window is always
+        # missed by exactly the creation tick
+        self._advance_standby_chunk(entry)
+
+    def _advance_standby_chunk(self, entry):   # hot-path
+        """One prompt chunk for a standby lane, into its own reserved
+        pages; the tail chunk yields the entry's first token and marks
+        it ready for publication."""
+        lane = entry.lane
+        req = lane.request
+        tokens, start, is_tail = lane.pending.pop(0)
+        last_idx = (req.true_len - 1 - start) if is_tail else 0
+        t0 = time.monotonic()
+        try:
+            self._fault("engine.chunk")
+            self._kv_pools, tok = self._chunk_jit(
+                self.params, self._kv_pools,
+                xfer.to_device(entry.table),
+                xfer.to_device(tokens, numpy.int32),
+                xfer.to_device(start, numpy.int32),
+                xfer.to_device(last_idx, numpy.int32),
+                *self._seed_args(req.seed))
+            self._tfence(self._kv_pools, req.trace is not None)
+        except Exception as e:   # noqa: BLE001 — fails THIS request
+            self.metrics.record_error()
+            self.warning("standby prefill failed: %s", e)
+            if req.trace is not None:
+                req.trace.tracer.add(
+                    req.trace, "prefill.chunk", "prefill", t0,
+                    time.monotonic(),
+                    attrs={"start": start, "standby": True,
+                           "error": str(e)})
+            self._fail_standby(entry, e)
+            return
+        self.metrics.inc("prefill_dispatches")
+        self._note_attn_dispatch()
+        self.metrics.inc("prefill_tokens",
+                         (req.true_len - start) if is_tail
+                         else len(tokens))
+        # lint: allow(host-sync): enqueue-time EWMA by design; device wall rides traced spans (_tfence)
+        self.metrics.record_decode_step(time.monotonic() - t0)
+        if req.trace is not None:
+            req.trace.tracer.add(
+                req.trace, "prefill.chunk", "prefill", t0,
+                time.monotonic(),
+                attrs={"start": start, "tail": is_tail,
+                       "standby": True,
+                       "bucket": self.prefill_chunk, "paged": True,
+                       "backend": self._backend})
+        if not is_tail:
+            entry.pos = lane.pending[0][1]
+            return
+        tok = int(xfer.to_host(tok))
+        lane.emitted.append(tok)
+        lane.remaining -= 1
+        self.metrics.inc("tokens_out")
+        self.metrics.record_ttft(time.monotonic() - req.t_enq)
+        entry.pos = req.true_len
+        entry.last = tok
+        if lane.remaining == 0 or req.cancelled:
+            self._ring.remove(entry)
+            self._resolve_standby(entry)
+            self.metrics.set_gauge("standby_ring_occupancy",
+                                   len(self._ring))
+            return
+        entry.ready = True
+
+    def _resolve_standby(self, entry):
+        """A standby lane that FINISHED while never holding a slot
+        (n_new=1 at the prefill tail, or armed and drained between two
+        boundaries): pages home, future resolved — the ring twin of
+        :meth:`_finish`."""
+        self._release_lane(entry.lane)
+        fut = entry.lane.request.future
+        if not fut.cancelled():
+            fut.version = self.weights_version
+            fut.set_result(numpy.asarray(entry.lane.emitted,
+                                         numpy.int32))
+
+    def _drop_standby(self, entry):
+        """Withdrawn standby entry: pages home, future cancelled."""
+        if entry in self._ring:
+            self._ring.remove(entry)
+        self._release_lane(entry.lane)
+        entry.lane.request.future.cancel()
+        self.metrics.set_gauge("standby_ring_occupancy",
+                               len(self._ring))
+
+    def _fail_standby(self, entry, exc):
+        """Fail one standby entry to its client: pages back to the
+        pool leak-free, future resolved — ring occupants participate
+        in a faulted dispatch exactly like lanes (the chaos
+        fault-isolation discipline)."""
+        if entry in self._ring:
+            self._ring.remove(entry)
+        self._release_lane(entry.lane)
+        fut = entry.lane.request.future
+        if not fut.cancelled():
+            fut.set_exception(exc)
+        self.metrics.set_gauge("standby_ring_occupancy",
+                               len(self._ring))
+
+    def _requeue_ring(self):
+        """Swap application: standby entries were prefilled on the OLD
+        weights — their KV is stale the moment the new tree installs,
+        so they go back to the queue head WHOLE (fresh deadline, like
+        :meth:`_requeue_active`: the wait was spent on work the deploy
+        threw away — a pre-prefilled request must never 503 for it)
+        and re-prefill on the new weights."""
+        reqs = []
+        fresh_deadline = time.monotonic() + self.deadline_s
+        for entry in self._ring:
+            lane = entry.lane
+            self._release_lane(lane)
+            req = lane.request
+            if req.cancelled:
+                req.future.cancel()
+                continue
+            req.deadline = max(req.deadline, fresh_deadline)
+            if req.trace is not None:
+                req.trace.tracer.instant(
+                    req.trace, "swap.requeue", cat="engine")
+                req.tspan = req.trace.tracer.begin(
+                    req.trace, "queue.wait", cat="queue",
+                    attrs={"engine": self.name, "requeued": True})
+            reqs.append(req)
+        self._ring = []
+        self.metrics.set_gauge("standby_ring_occupancy", 0)
+        with self._cond:
+            for req in reversed(reqs):
+                self._queue.appendleft(req)
+                self._queued_tokens += req.true_len
+                self._queued_pages += req.pages
+            self.metrics.set_gauge("queue_depth", len(self._queue))
+            self.metrics.set_gauge("queue_tokens", self._queued_tokens)
+            self.metrics.set_gauge("queue_pages", self._queued_pages)
+        self.metrics.inc("requests_requeued_for_swap", len(reqs))
+
     def _boundary_shed(self):
         """Deadline shedding at the MEGASTEP BOUNDARY (ISSUE 13
         satellite): one sweep of the whole queue per boundary, instead
@@ -2773,8 +3622,21 @@ class LMEngine(Logger):
         deadline only ever governed queue wait), and a request whose
         tokens completed inside the megastep resolves its future before
         this sweep can ever see it.  Queue-token/page gauges re-read
-        once per sweep, at the boundary, not per pop."""
+        once per sweep, at the boundary, not per pop.
+
+        ISSUE 19 window semantics: the worst-case shed LATENCY is one
+        dispatch window, quoted from the megastep iteration CAP — the
+        while mode realizes fewer iterations and exits early, so the
+        cap bounds both modes (a fixed-K scan simply realizes the cap).
+        The sweep also covers the standby ring: a pre-prefilled entry
+        is ADMITTED work whose deadline only ever governed queue wait,
+        so sitting in the ring past it must never 503 — its deadline is
+        bumped forward (idempotent) so even a later swap requeue cannot
+        shed work the engine already paid to prefill."""
         now = time.monotonic()
+        for entry in self._ring:
+            entry.lane.request.deadline = max(
+                entry.lane.request.deadline, now + self.deadline_s)
         shed = []
         with self._cond:
             if not self._queue:
@@ -2796,12 +3658,14 @@ class LMEngine(Logger):
             if self._paged:
                 self.metrics.set_gauge("queue_pages",
                                        self._queued_pages)
+        window = self.megastep if self.megastep >= 2 else 1
         for req in shed:
             self.metrics.record_shed()
             self._trace_queue_end(req, "shed")
             req.future.set_exception(DeadlineExceeded(
-                "prompt shed after %.3fs in queue (boundary sweep)"
-                % (time.monotonic() - req.t_enq)))
+                "prompt shed after %.3fs in queue (boundary sweep, "
+                "window <= %d iterations)"
+                % (time.monotonic() - req.t_enq, window)))
 
     def _worker(self):
         # the transfer-guard witness must be entered ON this thread
@@ -2832,7 +3696,9 @@ class LMEngine(Logger):
             # queued request now, not just those the admission loop
             # happens to pop
             self._boundary_shed()
+            self._admit_ring()
             self._admit()
+            self._advance_ring()
             busy = [i for i, lane in enumerate(self._lanes)
                     if lane is not None]
             self.metrics.set_gauge("slots_busy", len(busy))
@@ -2841,7 +3707,11 @@ class LMEngine(Logger):
                 with self._cond:
                     if self._stop:
                         break
-                    if not self._queue:
+                    if self._ring:
+                        # standby prefill still has host work — keep
+                        # ticking so the ring drains/installs promptly
+                        pass
+                    elif not self._queue:
                         self._cond.wait(0.5)
                     elif self._pool_blocked:
                         # head request waiting on pages with no lane
@@ -2863,7 +3733,9 @@ class LMEngine(Logger):
                       if lane is not None and not lane.pending]
             if not active:
                 continue
-            if self._megastep_jit is not None:
+            if self._whilestep_jit is not None:
+                self._step_while(active)
+            elif self._megastep_jit is not None:
                 self._step_megastep(active)
             elif self._verify_jit is not None:
                 self._step_speculative(active)
@@ -2885,6 +3757,9 @@ class LMEngine(Logger):
         for req in pending:
             self._trace_queue_end(req, "engine stopped")
             req.future.set_exception(RuntimeError("LM engine stopped"))
+        for entry in list(self._ring):
+            self._fail_standby(entry,
+                               RuntimeError("LM engine stopped"))
         for slot, lane in enumerate(self._lanes):
             if lane is not None:
                 lane.request.future.set_exception(
